@@ -1,0 +1,25 @@
+"""tensorflow_examples_tpu — a TPU-native training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+repo ``manigoswami/tensorflow-examples`` (see SURVEY.md; the reference's
+capability spec is BASELINE.json): five end-to-end workloads — MNIST MLP,
+CIFAR-10 ResNet-20, ImageNet ResNet-50, BERT-base GLUE, GPT-2 124M — on a
+shared layered core:
+
+- ``core``     — device mesh + sharding rules + precision policy + RNG
+- ``ops``      — Pallas TPU kernels (fused cross-entropy, flash attention)
+- ``parallel`` — collectives, ring attention, tensor parallelism
+- ``data``     — grain/tf.data input pipelines with device prefetch
+- ``train``    — the single shared training loop (jit step, ckpt, metrics)
+- ``models``   — flax model definitions + HF weight importers
+- ``utils``    — profiling, logging, failure handling
+
+Where the reference used ``tf.distribute`` + NCCL all-reduce, this framework
+uses ``jax.jit`` over a ``jax.sharding.Mesh`` and lets XLA emit collectives
+over ICI/DCN. Where the reference used CUDA custom ops, this framework uses
+Pallas (Mosaic) TPU kernels. Where the reference used the tf.data C++
+runtime, this framework uses grain plus a native C++ prefetching loader
+(``native/``).
+"""
+
+__version__ = "0.1.0"

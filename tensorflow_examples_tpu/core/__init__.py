@@ -1,0 +1,22 @@
+"""Core layer: device mesh, sharding rules, precision policy, RNG.
+
+Replaces the reference's ``tf.distribute`` strategy layer (BASELINE.json
+north_star: MirroredStrategy / MultiWorkerMirroredStrategy + NCCL) with the
+TPU-native equivalent: a ``jax.sharding.Mesh`` with named axes and
+``NamedSharding`` annotations; XLA inserts the collectives over ICI/DCN.
+"""
+
+from tensorflow_examples_tpu.core.mesh import (
+    AxisNames,
+    MeshConfig,
+    create_mesh,
+    local_batch_size,
+)
+from tensorflow_examples_tpu.core.sharding import (
+    ShardingRules,
+    named_sharding,
+    shard_params,
+    shardings_for_params,
+)
+from tensorflow_examples_tpu.core.precision import Precision, PrecisionPolicy
+from tensorflow_examples_tpu.core.rng import named_rngs, step_rng

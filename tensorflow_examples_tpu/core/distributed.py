@@ -1,0 +1,61 @@
+"""Multi-host bootstrap.
+
+The reference bootstrapped multi-worker training from a ``TF_CONFIG`` env
+var through a cluster resolver and gRPC collective setup (SURVEY.md §3(5),
+for BERT's MultiWorkerMirroredStrategy). The TPU-native equivalent is a
+single call: ``jax.distributed.initialize()`` — on Cloud TPU the
+coordinator address, process count, and process index are discovered from
+the TPU metadata automatically; collectives then ride ICI within a slice
+and DCN across slices with no user-space transport to configure.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Idempotent multi-host init. Safe to call in single-process runs.
+
+    Explicit args (or JAX_COORDINATOR_ADDRESS etc.) are only needed
+    off-cloud; on TPU VMs everything is auto-discovered.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    multi_process = (
+        num_processes is not None
+        or coordinator_address is not None
+        or os.environ.get("JAX_NUM_PROCESSES")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if multi_process:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        log.info(
+            "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.local_device_count(),
+            jax.device_count(),
+        )
+    _INITIALIZED = True
+
+
+def is_primary() -> bool:
+    """True on the process that should write checkpoints/summaries."""
+    return jax.process_index() == 0

@@ -1,0 +1,119 @@
+"""Device mesh construction and axis conventions.
+
+The reference's distribution layer was per-example ``tf.distribute``
+strategies (MirroredStrategy for single-host DP, MultiWorkerMirroredStrategy
+for BERT's multi-host DP; BASELINE.json:north_star). TPU-native, all of that
+collapses into ONE concept: a ``jax.sharding.Mesh`` with named axes. Data
+parallelism is "shard the batch over the ``data`` axis"; tensor parallelism
+is "shard weight matrices over ``model``"; sequence/context parallelism is
+"shard the sequence over ``context``". XLA emits psum/all-gather/ppermute
+over ICI for whatever sharding is requested — there is no user-space NCCL
+equivalent to manage.
+
+Axis conventions (used by every model and sharding rule in the framework):
+
+- ``data``    — pure data parallelism (batch dim). Gradients are all-reduced
+                over this axis by XLA when params are replicated across it.
+- ``fsdp``    — batch AND parameter sharding (ZeRO-3 style). Params are
+                sharded over this axis and all-gathered just-in-time.
+- ``model``   — tensor parallelism (hidden/heads dims).
+- ``context`` — sequence/context parallelism (ring attention).
+
+A single-chip run is simply a 1×1×1×1 mesh; code written against the mesh
+runs unchanged from 1 chip to a multi-host slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class AxisNames:
+    """Canonical mesh axis names."""
+
+    DATA = "data"
+    FSDP = "fsdp"
+    MODEL = "model"
+    CONTEXT = "context"
+
+    ALL = (DATA, FSDP, MODEL, CONTEXT)
+
+    # The batch dimension of activations is sharded over every
+    # batch-like axis.
+    BATCH_AXES = (DATA, FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. -1 for ``data`` means "all remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    context: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        fixed = self.fsdp * self.model * self.context
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*model*context={fixed}"
+                )
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.fsdp}x{self.model}x{self.context}={total} "
+                f"!= available devices {n_devices}"
+            )
+        return (data, self.fsdp, self.model, self.context)
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the framework-standard 4-axis mesh.
+
+    ``jax.experimental.mesh_utils`` is used when available so the mesh
+    layout follows the physical ICI topology (keeps the fastest-varying
+    logical axis on the torus); on CPU / single chip it degenerates to a
+    simple reshape.
+    """
+    if devices is None:
+        devices = jax.devices()
+    config = config or MeshConfig()
+    shape = config.resolve(len(devices))
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except Exception:
+        device_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(device_array, axis_names=AxisNames.ALL)
+
+
+def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:
+    """Per-host batch size for input pipelines (tf.data ``shard()`` analogue).
+
+    The reference sharded input per worker via
+    ``dataset.shard(num_workers, index)`` inside
+    MultiWorkerMirroredStrategy (SURVEY.md §3(5)); here each host feeds the
+    slice of the global batch that lands on its addressable devices.
+    """
+    n_batch = math.prod(mesh.shape[a] for a in AxisNames.BATCH_AXES)
+    if global_batch_size % n_batch:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by batch mesh size {n_batch}"
+        )
+    per_shard = global_batch_size // n_batch
+    local_shards = max(1, n_batch // jax.process_count())
+    return per_shard * local_shards

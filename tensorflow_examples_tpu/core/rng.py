@@ -1,0 +1,24 @@
+"""RNG handling.
+
+JAX's explicit threaded PRNG replaces TF's stateful global RNG. Step keys
+are derived by folding the step count into a root key inside the compiled
+step, so dropout etc. are deterministic given (seed, step) — which also
+makes checkpoint resume bit-exact (the reference could not guarantee this
+with stateful ``tf.random``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def step_rng(root_key: jax.Array, step: jax.Array) -> jax.Array:
+    """Per-step key, usable inside jit (step may be traced)."""
+    return jax.random.fold_in(root_key, step)
+
+
+def named_rngs(
+    key: jax.Array, names: tuple[str, ...] = ("dropout",)
+) -> dict[str, jax.Array]:
+    """Split one key into a flax ``rngs`` dict with stable per-name streams."""
+    return {n: jax.random.fold_in(key, i) for i, n in enumerate(names)}

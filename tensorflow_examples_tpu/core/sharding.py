@@ -1,0 +1,103 @@
+"""Rules-based parameter sharding.
+
+The reference never sharded parameters (pure DP: every replica held a full
+copy, NCCL all-reduced gradients — BASELINE.json:north_star). A TPU-native
+framework shards by annotation instead: each model ships a small table of
+``(param-path regex → PartitionSpec)`` rules; ``shard_params`` applies them
+and ``jax.jit`` compiles the collectives. Unmatched params are replicated,
+which reproduces the reference's DP behavior as the degenerate case.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) table; first match wins.
+
+    Paths are '/'-joined pytree key paths, e.g.
+    ``"transformer/h_3/attn/c_attn/kernel"``.
+    """
+
+    def __init__(self, rules: Sequence[tuple[str, P]] = ()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return P()  # replicate
+
+    def __add__(self, other: "ShardingRules") -> "ShardingRules":
+        out = ShardingRules()
+        out.rules = list(self.rules) + list(other.rules)
+        return out
+
+
+REPLICATED = ShardingRules()
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes of size 1 from a spec (cheaper layouts, same math)."""
+
+    def keep(axis):
+        if axis is None:
+            return None
+        if isinstance(axis, (tuple, list)):
+            kept = tuple(a for a in axis if mesh.shape[a] > 1)
+            return kept if kept else None
+        return axis if mesh.shape[axis] > 1 else None
+
+    return P(*(keep(a) for a in spec))
+
+
+def shardings_for_params(
+    params: Pytree, mesh: Mesh, rules: ShardingRules | None = None
+) -> Pytree:
+    """Pytree of NamedSharding matching ``params``' structure."""
+    rules = rules or REPLICATED
+
+    def one(path, leaf):
+        spec = _filter_spec(rules.spec_for(_path_str(path)), mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_params(
+    params: Pytree, mesh: Mesh, rules: ShardingRules | None = None
+) -> Pytree:
+    """Place (device_put) a param pytree according to the rules."""
+    shardings = shardings_for_params(params, mesh, rules)
+    return jax.device_put(params, shardings)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, _filter_spec(P(*spec), mesh))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [global_batch, ...] array: batch over data+fsdp axes."""
+    from tensorflow_examples_tpu.core.mesh import AxisNames
+
+    axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
+    return NamedSharding(mesh, P(axes if axes else None))

@@ -1,0 +1,25 @@
+"""Input pipelines.
+
+TPU-native replacement for the reference's per-example ``tf.data`` graphs
+(SURVEY.md §3(4): TFRecord → shuffle → map(decode+augment) → batch →
+prefetch(device)). Design:
+
+- Small datasets (MNIST/CIFAR) live in host RAM as numpy arrays; a
+  deterministic shuffling iterator feeds the mesh. No graph runtime needed.
+- Large datasets (ImageNet) stream TFRecord shards — via grain or the
+  native C++ loader (``native/``) — sharded per host, decoded/augmented on
+  host CPU, with device prefetch overlapping the step (the tf.data
+  ``prefetch(AUTOTUNE)``-to-device equivalent).
+- Every iterator is deterministic given (seed, step) and checkpointable,
+  which the reference's tf.data shuffle was not.
+- With no dataset on disk (``data_dir=""``) each workload falls back to a
+  seeded synthetic dataset with the real shapes/dtypes, so every example
+  and test runs hermetically.
+"""
+
+from tensorflow_examples_tpu.data.memory import (
+    InMemoryDataset,
+    eval_batches,
+    train_iterator,
+)
+from tensorflow_examples_tpu.data.prefetch import device_prefetch

@@ -1,0 +1,86 @@
+"""In-memory datasets: deterministic shuffling train iterator + eval batches.
+
+Covers MNIST/CIFAR-scale data (the reference loaded these fully into memory
+via ``tf.keras.datasets`` too). The iterator is stateless-resumable: batch
+order is a pure function of (seed, epoch), so resuming from step N
+reproduces the exact batch sequence the un-interrupted run would have seen
+— stronger than the reference's stateful tf.data shuffle buffer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Mapping
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class InMemoryDataset:
+    """A dict of equally-long numpy arrays (e.g. {'image': …, 'label': …})."""
+
+    arrays: Mapping[str, np.ndarray]
+
+    def __post_init__(self):
+        sizes = {k: len(v) for k, v in self.arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"ragged dataset: {sizes}")
+
+    @property
+    def size(self) -> int:
+        return len(next(iter(self.arrays.values())))
+
+
+def train_iterator(
+    ds: InMemoryDataset,
+    batch_size: int,
+    *,
+    seed: int = 0,
+    start_step: int = 0,
+    augment=None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Infinite shuffled batches; order is a pure function of (seed, epoch)."""
+    n = ds.size
+    if batch_size > n:
+        raise ValueError(f"batch {batch_size} > dataset {n}")
+    steps_per_epoch = n // batch_size
+    step = start_step
+    while True:
+        epoch = step // steps_per_epoch
+        order = np.random.default_rng(seed + epoch).permutation(n)
+        while step // steps_per_epoch == epoch:
+            i = (step % steps_per_epoch) * batch_size
+            idx = order[i : i + batch_size]
+            batch = {k: v[idx] for k, v in ds.arrays.items()}
+            if augment is not None:
+                batch = augment(batch, np.random.default_rng((seed, step)))
+            yield batch
+            step += 1
+
+
+def eval_batches(
+    ds: InMemoryDataset, batch_size: int, *, drop_remainder: bool = False
+) -> Iterator[dict[str, np.ndarray]]:
+    """One sequential pass; final partial batch is padded with weight=0.
+
+    Padding (instead of a ragged final batch) keeps eval shapes static so
+    the jitted eval step compiles exactly once (SURVEY.md: no dynamic
+    shapes under jit).
+    """
+    n = ds.size
+    for i in range(0, n, batch_size):
+        batch = {k: v[i : i + batch_size] for k, v in ds.arrays.items()}
+        actual = len(next(iter(batch.values())))
+        if actual < batch_size:
+            if drop_remainder:
+                return
+            pad = batch_size - actual
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                for k, v in batch.items()
+            }
+            mask = np.concatenate([np.ones(actual), np.zeros(pad)])
+        else:
+            mask = np.ones(actual)
+        batch["mask"] = mask.astype(np.float32)
+        yield batch

@@ -1,0 +1,37 @@
+"""Device prefetch: overlap host input with device compute.
+
+Equivalent of the reference's ``dataset.prefetch`` + device prefetch into
+HBM (BASELINE.json:north_star). A small look-ahead queue of batches is
+``device_put`` ahead of time with the mesh batch sharding; transfers are
+async in JAX, so batch N+1 streams into HBM while step N runs.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+
+def device_prefetch(it: Iterator, sharding, *, depth: int = 2) -> Iterator:
+    queue = collections.deque()
+
+    def put(batch):
+        return jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), sharding), batch
+        )
+
+    try:
+        for _ in range(depth):
+            queue.append(put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,9 @@
+"""Model zoo: flax (linen) definitions of the five reference workload models.
+
+MLP (MNIST), ResNet-20/50 (CIFAR/ImageNet), BERT-base (GLUE), GPT-2 124M
+(LM) — BASELINE.json:configs. Pure-functional modules so every model
+composes with jit/shard_map/remat; params are plain pytrees sharded by
+the core rules tables each model exports.
+"""
+
+from tensorflow_examples_tpu.models.mlp import MLP

@@ -1,0 +1,30 @@
+"""Dense MLP classifier — the MNIST "hello world" workload.
+
+Capability parity with the reference's ``tf.keras.Sequential([Flatten,
+Dense(relu)…, Dense(10)])`` MNIST example (BASELINE.json:configs[0]).
+Single dense stack; no sharding rules needed (params replicate — the
+reference's MirroredStrategy behavior falls out as the default).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 128)
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, name="head")(x)

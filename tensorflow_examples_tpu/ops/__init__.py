@@ -1,0 +1,13 @@
+"""Ops layer: losses/metrics + Pallas TPU kernels.
+
+The reference relied on CUDA ``tf.custom_op`` kernels for its fused ops
+(BASELINE.json:north_star). The TPU-native equivalents here are Pallas
+(Mosaic) kernels — fused cross-entropy and blockwise flash attention —
+each paired with a pure-XLA reference implementation of identical
+signature used for numerics tests (SURVEY.md §4) and as the CPU fallback.
+"""
+
+from tensorflow_examples_tpu.ops.losses import (
+    accuracy_metrics,
+    softmax_cross_entropy,
+)

@@ -1,0 +1,49 @@
+"""Losses and classification metrics (pure-XLA reference implementations).
+
+Mirrors the reference's ``SparseCategoricalCrossentropy`` /
+``keras.metrics`` usage (SURVEY.md §2a). The fused Pallas cross-entropy in
+``ops.cross_entropy`` shares these signatures; tests compare the two.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    label_smoothing: float = 0.0,
+    weights: jax.Array | None = None,
+) -> jax.Array:
+    """Mean cross-entropy over (optionally weighted) examples.
+
+    logits: [..., C] float; labels: [...] int. Computed in f32 regardless
+    of input dtype (bf16 logits are fine; the logsumexp runs in f32).
+    """
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(log_probs, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    if weights is not None:
+        return jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.mean(nll)
+
+
+def accuracy_metrics(
+    logits: jax.Array, labels: jax.Array, weights: jax.Array | None = None
+) -> dict[str, jax.Array]:
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    if weights is not None:
+        denom = jnp.maximum(jnp.sum(weights), 1.0)
+        return {
+            "accuracy": jnp.sum(correct * weights) / denom,
+            "weight": jnp.sum(weights),
+        }
+    return {"accuracy": jnp.mean(correct)}

@@ -1,0 +1,14 @@
+"""Training layer: the ONE shared loop all five workloads run through.
+
+The reference repeated ~150 lines of custom training loop per example
+(iterate dist dataset → strategy.run(step) → reduce → log → ckpt;
+SURVEY.md §2b/§3). Here that machinery exists once: a jitted train step
+(forward/backward/collectives/update in a single XLA program), an eval
+loop, orbax checkpointing, and clu metric writers, parameterized by a
+``Task`` (model + loss + metrics) and a ``TrainConfig``.
+"""
+
+from tensorflow_examples_tpu.train.config import TrainConfig
+from tensorflow_examples_tpu.train.state import TrainState
+from tensorflow_examples_tpu.train.task import Task
+from tensorflow_examples_tpu.train.loop import Trainer

@@ -1,0 +1,68 @@
+"""Checkpoint/resume on orbax.
+
+Replaces the reference's per-example ``tf.train.CheckpointManager``
+(SURVEY.md §2b/§5d) with orbax: async saves (the step never blocks on
+filesystem IO), sharded arrays saved/restored directly to the live mesh
+layout, and automatic latest-checkpoint resume.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    def __init__(self, workdir: str, *, max_to_keep: int = 3, async_save: bool = True):
+        import os
+
+        self._mngr = ocp.CheckpointManager(
+            os.path.abspath(os.path.join(workdir, "checkpoints")),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(_as_dict(state)))
+
+    def restore_latest(self, state: Any) -> tuple[Any, int] | None:
+        """Restore into ``state``'s structure/shardings; None if no ckpt."""
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        target = _as_dict(state)
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(target))
+        merged = _merge_arrays(state, restored)
+        log.info("restored checkpoint at step %d", step)
+        return merged, step
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
+def _as_dict(state: Any) -> dict:
+    """Array-only view of TrainState (fns/optimizer objects are rebuilt by
+    the caller, orbax stores just the arrays)."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+    }
+
+
+def _merge_arrays(state: Any, restored: dict) -> Any:
+    return state.replace(
+        step=restored["step"],
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+    )

@@ -1,0 +1,104 @@
+"""Config system: one dataclass per workload + an absl-flags CLI bridge.
+
+Contract preserved from the reference (BASELINE.json:north_star): each
+example keeps a ``python <example>/train.py --device=tpu`` CLI. Flags are
+generated from the dataclass fields, so every config knob is a CLI flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from tensorflow_examples_tpu.core.mesh import MeshConfig
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # Device / distribution
+    device: str = "tpu"  # tpu | cpu — reference contract flag
+    mesh_data: int = -1  # -1: all remaining devices on the data axis
+    mesh_fsdp: int = 1
+    mesh_model: int = 1
+    mesh_context: int = 1
+
+    # Optimization
+    global_batch_size: int = 128
+    eval_batch_size: int = 0  # 0 → global_batch_size
+    train_steps: int = 1000
+    warmup_steps: int = 0
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    grad_clip_norm: float = 0.0  # 0 disables
+    grad_accum_steps: int = 1
+    precision: str = "bf16"  # f32 | bf16 | bf16_full
+    remat: bool = False  # jax.checkpoint the model apply
+
+    # Loop cadence
+    log_every: int = 100
+    eval_every: int = 0  # 0 disables periodic eval
+    checkpoint_every: int = 1000
+    seed: int = 42
+
+    # IO
+    workdir: str = ""  # checkpoints + tensorboard; "" disables
+    data_dir: str = ""  # dataset location; "" → synthetic data
+    resume: bool = True  # restore latest checkpoint from workdir
+
+    # Profiling
+    profile: bool = False  # capture a profiler trace around steps 10-20
+
+    def mesh_config(self) -> MeshConfig:
+        return MeshConfig(
+            data=self.mesh_data,
+            fsdp=self.mesh_fsdp,
+            model=self.mesh_model,
+            context=self.mesh_context,
+        )
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def define_flags_from_config(config: Any, flags_module=None) -> None:
+    """Register one absl flag per dataclass field (name, default, type)."""
+    from absl import flags as absl_flags
+
+    fl = flags_module or absl_flags
+    for f in dataclasses.fields(config):
+        default = getattr(config, f.name)
+        if f.name in fl.FLAGS:
+            continue
+        if isinstance(default, bool):
+            fl.DEFINE_boolean(f.name, default, f.name)
+        elif isinstance(default, int):
+            fl.DEFINE_integer(f.name, default, f.name)
+        elif isinstance(default, float):
+            fl.DEFINE_float(f.name, default, f.name)
+        else:
+            fl.DEFINE_string(f.name, str(default), f.name)
+
+
+def config_from_flags(config: Any, flags_values=None) -> Any:
+    """Overlay parsed absl flag values onto a config instance."""
+    from absl import flags as absl_flags
+
+    fv = flags_values or absl_flags.FLAGS
+    updates = {}
+    for f in dataclasses.fields(config):
+        if f.name in fv:
+            updates[f.name] = getattr(fv, f.name)
+    return dataclasses.replace(config, **updates)
+
+
+def apply_device_flag(device: str) -> None:
+    """Honor the reference's ``--device`` contract.
+
+    ``--device=tpu`` is the default JAX platform selection; ``--device=cpu``
+    forces the CPU backend (useful for tests and the §7 fallback given the
+    experimental axon PJRT plugin).
+    """
+    import jax
+
+    if device == "cpu":
+        jax.config.update("jax_platforms", "cpu")

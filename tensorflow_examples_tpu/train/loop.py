@@ -1,0 +1,303 @@
+"""The shared training loop.
+
+TPU-native re-design of the reference's per-example loop (SURVEY.md §3(1)):
+
+  reference                          | here
+  -----------------------------------+----------------------------------
+  strategy.scope() model build       | params init jitted with
+                                     |   out_shardings from the rules
+  strategy.experimental_distribute_  | host batch → jax.device_put with
+    dataset + per-replica feeding    |   batch sharding on the mesh
+  strategy.run(train_step) + NCCL    | ONE jax.jit program: fwd + bwd +
+    all-reduce + optimizer.apply     |   XLA collectives + update, with
+                                     |   donated state (no HBM copies)
+  tf.summary / CheckpointManager     | clu metric_writers / orbax async
+
+The whole step — including the gradient all-reduce and optimizer — is a
+single XLA executable, so there is no per-op dispatch overhead and XLA
+overlaps the collectives with backward compute.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Iterable, Iterator, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+from tensorflow_examples_tpu.core.precision import PrecisionPolicy
+from tensorflow_examples_tpu.core.rng import step_rng
+from tensorflow_examples_tpu.core.sharding import (
+    _path_str,
+    batch_sharding,
+    shardings_for_params,
+)
+from tensorflow_examples_tpu.data.prefetch import device_prefetch
+from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
+from tensorflow_examples_tpu.train.config import TrainConfig
+from tensorflow_examples_tpu.train.state import TrainState
+from tensorflow_examples_tpu.train.task import Task
+
+log = logging.getLogger(__name__)
+
+
+class Trainer:
+    """Runs a Task under a TrainConfig on a device mesh."""
+
+    def __init__(self, task: Task, config: TrainConfig, *, mesh=None):
+        self.task = task
+        self.config = config
+        self.mesh = mesh if mesh is not None else create_mesh(config.mesh_config())
+        self.policy = PrecisionPolicy.create(config.precision)
+        self._batch_sharding = batch_sharding(self.mesh)
+        self._ckpt: CheckpointManager | None = None
+        self._writer = None
+        self.state = self._init_state()
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
+
+    # ------------------------------------------------------------- init
+
+    def _init_state(self) -> TrainState:
+        cfg = self.config
+        tx = self.task.make_optimizer(cfg)
+        rng = jax.random.PRNGKey(cfg.seed)
+
+        def make_state(rng):
+            params = self.task.init_fn(rng)
+            return TrainState.create(apply_fn=None, params=params, tx=tx)
+
+        # Evaluate shapes → shardings from the rules → jit-init directly
+        # into the sharded layout (params never materialize unsharded).
+        abstract = jax.eval_shape(make_state, rng)
+        shardings = self._state_shardings(abstract)
+        with self.mesh:
+            state = jax.jit(make_state, out_shardings=shardings)(rng)
+        state = state.replace(apply_fn=None, tx=tx)
+        n_params = sum(x.size for x in jax.tree.leaves(state.params))
+        log.info(
+            "initialized %s: %.2fM params on mesh %s",
+            self.task.name,
+            n_params / 1e6,
+            dict(self.mesh.shape),
+        )
+        return state
+
+    def _state_shardings(self, abstract_state) -> Any:
+        rules = self.task.sharding_rules
+        param_sh = shardings_for_params(abstract_state.params, self.mesh, rules)
+        replicated = NamedSharding(self.mesh, P())
+
+        # Optimizer moments (adam mu/nu, momentum traces, …) embed the param
+        # tree, so an opt-state leaf's key path ends with its param's path;
+        # match the longest such suffix (with equal shape) and inherit that
+        # param's sharding. Everything else (counts, scalars) replicates.
+        param_map: dict[str, tuple] = {}
+
+        def record(path, leaf, sh):
+            param_map[_path_str(path)] = (leaf.shape, sh)
+            return sh
+
+        jax.tree_util.tree_map_with_path(record, abstract_state.params, param_sh)
+
+        def opt_sharding(path, leaf):
+            parts = _path_str(path).split("/")
+            for i in range(len(parts)):
+                entry = param_map.get("/".join(parts[i:]))
+                if entry is not None and getattr(leaf, "shape", None) == entry[0]:
+                    return entry[1]
+            return replicated
+
+        opt_sh = jax.tree_util.tree_map_with_path(
+            opt_sharding, abstract_state.opt_state
+        )
+        return abstract_state.replace(
+            step=replicated, params=param_sh, opt_state=opt_sh
+        )
+
+    # ------------------------------------------------------------- steps
+
+    def _build_train_step(self):
+        task, policy = self.task, self.policy
+        seed_key = jax.random.PRNGKey(self.config.seed + 1)
+
+        def train_step(state: TrainState, batch):
+            rng = step_rng(seed_key, state.step)
+
+            def loss_fn(params):
+                compute_params = policy.cast_compute(params)
+                loss, metrics = task.loss_fn(
+                    compute_params, batch, rng=rng, train=True
+                )
+                return loss, metrics
+
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            new_state = state.apply_gradients(grads)
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(
+                jax.tree.map(lambda x: x.astype(jnp.float32), grads)
+            )
+            return new_state, metrics
+
+        state_sh = self._state_shardings(jax.eval_shape(lambda s: s, self.state))
+        return jax.jit(
+            train_step,
+            in_shardings=(state_sh, self._batch_sharding),
+            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,),
+        )
+
+    def _build_eval_step(self):
+        if self.task.eval_fn is None:
+            return None
+        task, policy = self.task, self.policy
+
+        def eval_step(params, batch):
+            return task.eval_fn(policy.cast_compute(params), batch)
+
+        return jax.jit(
+            eval_step,
+            in_shardings=(None, self._batch_sharding),
+            out_shardings=NamedSharding(self.mesh, P()),
+        )
+
+    # ------------------------------------------------------------- loop
+
+    def _put_batch(self, batch):
+        return jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), batch
+        )
+
+    def fit(
+        self,
+        train_data: Iterator[Mapping[str, np.ndarray]]
+        | Callable[[int], Iterator[Mapping[str, np.ndarray]]],
+        *,
+        eval_iter_fn: Callable[[], Iterable] | None = None,
+        num_steps: int | None = None,
+    ) -> dict[str, float]:
+        """Run the training loop; returns final logged metrics.
+
+        ``train_data`` may be an iterator, or — for exact resume — a
+        callable ``(start_step) -> iterator`` invoked after checkpoint
+        restore, so a resumed run consumes exactly the batches the
+        uninterrupted run would have.
+        """
+        cfg = self.config
+        num_steps = num_steps or cfg.train_steps
+        start_step = int(self.state.step)
+
+        if cfg.workdir:
+            self._ckpt = CheckpointManager(cfg.workdir)
+            if cfg.resume:
+                restored = self._ckpt.restore_latest(self.state)
+                if restored is not None:
+                    self.state, start_step = restored[0], int(restored[1])
+            self._writer = _make_writer(cfg.workdir)
+
+        if callable(train_data) and not hasattr(train_data, "__next__"):
+            train_iter = train_data(start_step)
+        else:
+            train_iter = train_data
+        # Async look-ahead transfer: batch N+1 streams into HBM while
+        # step N runs (the reference's prefetch-to-device equivalent).
+        train_iter = device_prefetch(train_iter, self._batch_sharding)
+
+        profiling = False
+        window: list[Mapping[str, jax.Array]] = []
+        last: dict[str, float] = {}
+        t_window = time.perf_counter()
+        for step in range(start_step, num_steps):
+            if cfg.profile and step == start_step + 10 and not profiling:
+                jax.profiler.start_trace(cfg.workdir or "/tmp/tpu_profile")
+                profiling = True
+            batch = next(train_iter)
+            self.state, metrics = self._train_step(self.state, batch)
+            window.append(metrics)
+            if profiling and step == start_step + 20:
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
+                profiling = False
+
+            if (step + 1) % cfg.log_every == 0 or step + 1 == num_steps:
+                jax.block_until_ready(metrics)
+                dt = time.perf_counter() - t_window
+                last = {
+                    k: float(np.mean([float(m[k]) for m in window]))
+                    for k in window[0]
+                }
+                steps_done = len(window)
+                last["steps_per_sec"] = steps_done / dt
+                last["examples_per_sec"] = (
+                    steps_done * cfg.global_batch_size / dt
+                )
+                window.clear()
+                t_window = time.perf_counter()
+                _log_metrics(self._writer, step + 1, last, prefix="train")
+
+            if cfg.eval_every and (step + 1) % cfg.eval_every == 0 and eval_iter_fn:
+                eval_metrics = self.evaluate(eval_iter_fn())
+                _log_metrics(self._writer, step + 1, eval_metrics, prefix="eval")
+
+            if self._ckpt and (step + 1) % cfg.checkpoint_every == 0:
+                self._ckpt.save(step + 1, self.state)
+
+        if profiling:
+            jax.profiler.stop_trace()
+        if eval_iter_fn is not None:
+            last.update(
+                {f"eval_{k}": v for k, v in self.evaluate(eval_iter_fn()).items()}
+            )
+        if self._ckpt:
+            self._ckpt.save(num_steps, self.state)
+            self._ckpt.close()
+        if self._writer:
+            self._writer.flush()
+        return last
+
+    def evaluate(self, eval_iter: Iterable) -> dict[str, float]:
+        """Metric-accumulating eval pass (SURVEY.md §3(3))."""
+        if self._eval_step is None:
+            return {}
+        # Accumulate on device; convert to host floats once at the end so
+        # eval steps pipeline instead of syncing per batch.
+        totals: dict[str, jax.Array] = {}
+        count = None
+        for batch in device_prefetch(iter(eval_iter), self._batch_sharding):
+            m = dict(self._eval_step(self.state.params, batch))
+            weight = m.pop("weight", None)
+            w = weight if weight is not None else jnp.float32(1.0)
+            for k, v in m.items():
+                acc = v * w
+                totals[k] = totals[k] + acc if k in totals else acc
+            count = w if count is None else count + w
+        if count is None:
+            return {}
+        return {k: float(v) / max(float(count), 1.0) for k, v in totals.items()}
+
+
+def _make_writer(workdir: str):
+    try:
+        from clu import metric_writers
+
+        return metric_writers.create_default_writer(
+            workdir, just_logging=jax.process_index() != 0
+        )
+    except Exception:  # pragma: no cover - clu is installed, but stay safe
+        return None
+
+
+def _log_metrics(writer, step: int, metrics: Mapping[str, float], prefix=""):
+    scalars = {f"{prefix}/{k}" if prefix else k: v for k, v in metrics.items()}
+    if writer is not None:
+        writer.write_scalars(step, scalars)
+    log.info("step %d: %s", step, {k: round(v, 5) for k, v in scalars.items()})

@@ -1,0 +1,103 @@
+"""Optimizers + LR schedules used by the five workloads.
+
+Covers the reference's optimizer surface (SURVEY.md §2a): plain SGD/Adam
+(MNIST), SGD+momentum with step/cosine LR (ResNets), LARS (ResNet-50 large
+batch), AdamW with warmup-linear-decay (BERT), AdamW with warmup-cosine
+(GPT-2) — all as optax chains so they compose with clipping and grad
+accumulation inside the single compiled step.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from tensorflow_examples_tpu.train.config import TrainConfig
+
+
+def warmup_cosine(cfg: TrainConfig, *, end_value: float = 0.0) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=cfg.learning_rate,
+        warmup_steps=max(cfg.warmup_steps, 1),
+        decay_steps=max(cfg.train_steps, 2),
+        end_value=end_value,
+    )
+
+
+def warmup_linear(cfg: TrainConfig) -> optax.Schedule:
+    """BERT fine-tune schedule: linear warmup then linear decay to 0."""
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, cfg.learning_rate, max(cfg.warmup_steps, 1)),
+            optax.linear_schedule(
+                cfg.learning_rate,
+                0.0,
+                max(cfg.train_steps - cfg.warmup_steps, 1),
+            ),
+        ],
+        boundaries=[max(cfg.warmup_steps, 1)],
+    )
+
+
+def _maybe_wrap(cfg: TrainConfig, tx: optax.GradientTransformation):
+    parts = []
+    if cfg.grad_clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    parts.append(tx)
+    tx = optax.chain(*parts) if len(parts) > 1 else tx
+    if cfg.grad_accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum_steps)
+    return tx
+
+
+def adam(cfg: TrainConfig) -> optax.GradientTransformation:
+    return _maybe_wrap(cfg, optax.adam(cfg.learning_rate))
+
+
+def adamw_cosine(cfg: TrainConfig) -> optax.GradientTransformation:
+    return _maybe_wrap(
+        cfg,
+        optax.adamw(
+            warmup_cosine(cfg, end_value=0.1 * cfg.learning_rate),
+            b1=0.9,
+            b2=0.95,
+            weight_decay=cfg.weight_decay,
+        ),
+    )
+
+
+def adamw_linear(cfg: TrainConfig) -> optax.GradientTransformation:
+    return _maybe_wrap(
+        cfg,
+        optax.adamw(
+            warmup_linear(cfg),
+            b1=0.9,
+            b2=0.999,
+            eps=1e-6,
+            weight_decay=cfg.weight_decay,
+        ),
+    )
+
+
+def sgd_momentum_cosine(cfg: TrainConfig, *, nesterov: bool = True):
+    return _maybe_wrap(
+        cfg,
+        optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay)
+            if cfg.weight_decay
+            else optax.identity(),
+            optax.sgd(warmup_cosine(cfg), momentum=0.9, nesterov=nesterov),
+        ),
+    )
+
+
+def lars(cfg: TrainConfig) -> optax.GradientTransformation:
+    """LARS for large-batch ResNet-50 (SURVEY.md §2a row 3)."""
+    return _maybe_wrap(
+        cfg,
+        optax.lars(
+            warmup_cosine(cfg),
+            weight_decay=cfg.weight_decay,
+            momentum=0.9,
+        ),
+    )

@@ -1,0 +1,35 @@
+"""Task: what a workload must define to run on the shared loop.
+
+A Task is the TPU-native replacement for an entire reference example
+script: the model, how to compute its loss/metrics, how its params shard,
+and its optimizer. Everything else (distribution, input feeding, stepping,
+checkpointing, logging) lives in the shared Trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import jax
+import optax
+
+from tensorflow_examples_tpu.core.sharding import REPLICATED, ShardingRules
+from tensorflow_examples_tpu.train.config import TrainConfig
+
+Batch = Mapping[str, jax.Array]
+# loss_fn(params, batch, model_apply, rng, train) -> (loss, metrics-dict)
+LossFn = Callable[..., tuple[jax.Array, Mapping[str, jax.Array]]]
+
+
+@dataclasses.dataclass
+class Task:
+    name: str
+    # init_fn(rng) -> params pytree
+    init_fn: Callable[[jax.Array], Any]
+    # apply_fn(params, batch, rng, train) -> (loss, metrics)
+    loss_fn: LossFn
+    make_optimizer: Callable[[TrainConfig], optax.GradientTransformation]
+    sharding_rules: ShardingRules = dataclasses.field(default_factory=lambda: REPLICATED)
+    # eval_step(params, batch) -> metrics dict of (sum, count) style values
+    eval_fn: Callable[..., Mapping[str, jax.Array]] | None = None

@@ -1,0 +1,7 @@
+"""Workload definitions: one module per reference example.
+
+Each module exposes ``make_task(config) -> Task`` plus dataset helpers;
+the ``examples/<name>/train.py`` CLIs are thin shells over these
+(preserving the reference's per-example entrypoint contract,
+BASELINE.json:north_star).
+"""

@@ -1,0 +1,75 @@
+"""MNIST dense-MLP workload (BASELINE.json:configs[0]).
+
+Reference behavior: ``tf.keras`` Sequential MLP, sparse categorical
+cross-entropy, single-host training with a simple eval pass. Here the
+same capability on the shared TPU loop: jitted step, bf16 compute, batch
+sharded over the mesh's data axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from tensorflow_examples_tpu.core.sharding import REPLICATED
+from tensorflow_examples_tpu.data.sources import load_mnist
+from tensorflow_examples_tpu.models.mlp import MLP
+from tensorflow_examples_tpu.ops.losses import accuracy_metrics, softmax_cross_entropy
+from tensorflow_examples_tpu.train import Task, TrainConfig
+from tensorflow_examples_tpu.train import optimizers
+
+
+@dataclasses.dataclass
+class MnistConfig(TrainConfig):
+    global_batch_size: int = 256
+    train_steps: int = 2000
+    learning_rate: float = 1e-3
+    hidden: int = 128
+    num_layers: int = 2
+    dropout: float = 0.1
+
+
+def make_task(cfg: MnistConfig) -> Task:
+    model = MLP(
+        features=(cfg.hidden,) * cfg.num_layers,
+        num_classes=10,
+        dropout_rate=cfg.dropout,
+    )
+
+    def init_fn(rng):
+        import jax.numpy as jnp
+
+        dummy = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        return model.init({"params": rng}, dummy)["params"]
+
+    def loss_fn(params, batch, *, rng, train):
+        logits = model.apply(
+            {"params": params},
+            batch["image"],
+            train=train,
+            rngs={"dropout": rng} if train else None,
+        )
+        loss = softmax_cross_entropy(logits, batch["label"])
+        return loss, accuracy_metrics(logits, batch["label"])
+
+    def eval_fn(params, batch):
+        logits = model.apply({"params": params}, batch["image"], train=False)
+        m = accuracy_metrics(logits, batch["label"], weights=batch["mask"])
+        m["loss"] = softmax_cross_entropy(
+            logits, batch["label"], weights=batch["mask"]
+        )
+        return m
+
+    return Task(
+        name="mnist_mlp",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_optimizer=optimizers.adam,
+        sharding_rules=REPLICATED,
+        eval_fn=eval_fn,
+    )
+
+
+def datasets(cfg: MnistConfig):
+    return load_mnist(cfg.data_dir, "train"), load_mnist(cfg.data_dir, "test")

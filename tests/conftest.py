@@ -1,0 +1,37 @@
+"""Test harness: 8 fake CPU devices (SURVEY.md §4).
+
+All tests run on the CPU backend with
+``--xla_force_host_platform_device_count=8`` so mesh/sharding/collective
+logic (psum, all_gather, ppermute ring attention, TP shard_map) is
+exercised multi-device without TPU hardware. Must be set before jax
+initializes — hence here, at conftest import time.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The session sitecustomize pre-imports jax and pins the experimental
+# axon TPU plugin, so the env vars above can be too late; the config
+# update path still works as long as no backend has been initialized.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) == 8, f"expected 8 fake CPU devices, got {len(d)}"
+    return d
+
+
+@pytest.fixture
+def mesh8():
+    from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+
+    return create_mesh(MeshConfig(data=8))

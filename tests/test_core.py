@@ -1,0 +1,97 @@
+"""Core layer tests: mesh construction, sharding rules, precision, rng."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorflow_examples_tpu.core.mesh import (
+    AxisNames,
+    MeshConfig,
+    create_mesh,
+    local_batch_size,
+)
+from tensorflow_examples_tpu.core.precision import PrecisionPolicy
+from tensorflow_examples_tpu.core.sharding import (
+    ShardingRules,
+    shard_params,
+    shardings_for_params,
+)
+
+
+class TestMesh:
+    def test_default_mesh_all_data(self, devices):
+        mesh = create_mesh()
+        assert mesh.shape[AxisNames.DATA] == 8
+        assert mesh.shape[AxisNames.MODEL] == 1
+
+    def test_mixed_mesh(self, devices):
+        mesh = create_mesh(MeshConfig(data=2, model=2, context=2))
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 1, "model": 2, "context": 2}
+
+    def test_bad_mesh_raises(self, devices):
+        with pytest.raises(ValueError):
+            create_mesh(MeshConfig(data=3, model=2))
+
+    def test_local_batch(self, mesh8):
+        assert local_batch_size(64, mesh8) == 64  # single process
+
+    def test_indivisible_batch_raises(self, mesh8):
+        with pytest.raises(ValueError):
+            local_batch_size(63, mesh8)
+
+
+class TestShardingRules:
+    def test_first_match_wins_and_default_replicates(self):
+        rules = ShardingRules(
+            [
+                (r"attn/kernel$", P(None, "model")),
+                (r"kernel$", P("fsdp", None)),
+            ]
+        )
+        assert rules.spec_for("h_0/attn/kernel") == P(None, "model")
+        assert rules.spec_for("h_0/mlp/kernel") == P("fsdp", None)
+        assert rules.spec_for("h_0/bias") == P()
+
+    def test_size_one_axes_dropped(self, mesh8):
+        # model axis has size 1 on a data-only mesh → spec must drop it.
+        rules = ShardingRules([(r"w", P("data", "model"))])
+        params = {"w": jnp.zeros((16, 4))}
+        sh = shardings_for_params(params, mesh8, rules)
+        assert sh["w"].spec == P("data", None)
+
+    def test_shard_params_places_data(self, mesh8):
+        rules = ShardingRules([(r"w", P("data"))])
+        params = {"w": jnp.arange(16.0).reshape(16, 1), "b": jnp.zeros((3,))}
+        out = shard_params(params, mesh8, rules)
+        assert out["w"].sharding.spec == P("data")
+        np.testing.assert_allclose(out["w"], params["w"])
+        # b unmatched → replicated
+        assert out["b"].sharding.spec == P()
+
+
+class TestPrecision:
+    def test_policies(self):
+        p = PrecisionPolicy.create("bf16")
+        assert p.param_dtype == jnp.float32
+        assert p.compute_dtype == jnp.bfloat16
+
+    def test_cast_skips_ints(self):
+        p = PrecisionPolicy.create("bf16")
+        tree = {"w": jnp.zeros((2,), jnp.float32), "i": jnp.zeros((2,), jnp.int32)}
+        out = p.cast_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["i"].dtype == jnp.int32
+
+
+class TestRng:
+    def test_step_keys_differ_and_reproduce(self):
+        from tensorflow_examples_tpu.core.rng import named_rngs, step_rng
+
+        key = jax.random.PRNGKey(0)
+        a = named_rngs(step_rng(key, jnp.int32(3)))
+        b = named_rngs(step_rng(key, jnp.int32(4)))
+        a2 = named_rngs(step_rng(key, jnp.int32(3)))
+        assert not np.array_equal(a["dropout"], b["dropout"])
+        np.testing.assert_array_equal(a["dropout"], a2["dropout"])

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Framework benchmark — prints ONE JSON line for the driver.
+
+Metric (BASELINE.md): MNIST MLP step-time on one TPU chip. The reference
+published no numbers (BASELINE.json:published == {}), so vs_baseline is
+measured against the first bring-up value recorded in BASELINE.md (the
+regression floor): vs_baseline = floor_ms / measured_ms, >1.0 == faster
+than the floor.
+"""
+
+import json
+import sys
+import time
+
+# First-measured regression floors (BASELINE.md "Measured baselines" table).
+FLOORS_MS = {
+    "mnist_mlp_step_time": 0.0702,
+}
+
+
+def bench_mnist_step(steps: int = 200, warmup: int = 20) -> dict:
+    import jax
+
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.data.sources import synthetic_images
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import mnist
+
+    cfg = mnist.MnistConfig(
+        global_batch_size=256, precision="bf16", dropout=0.0, log_every=10**9
+    )
+    ds = synthetic_images(n=4096, shape=(28, 28, 1), num_classes=10, seed=0)
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    it = train_iterator(ds, cfg.global_batch_size, seed=0)
+
+    batches = [trainer._put_batch(next(it)) for _ in range(8)]
+    state = trainer.state
+    for i in range(warmup):
+        state, m = trainer._train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = trainer._train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    step_ms = dt / steps * 1e3
+    return {
+        "metric": "mnist_mlp_step_time",
+        "value": round(step_ms, 4),
+        "unit": "ms/step",
+        "vs_baseline": round(FLOORS_MS["mnist_mlp_step_time"] / step_ms, 4),
+    }
+
+
+def main():
+    result = bench_mnist_step()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

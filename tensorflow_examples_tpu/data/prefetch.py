@@ -15,13 +15,16 @@ import jax
 import jax.numpy as jnp
 
 
+def put_batch(batch, sharding):
+    """The one host→device placement path (used by loop and prefetch)."""
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
+
+
 def device_prefetch(it: Iterator, sharding, *, depth: int = 2) -> Iterator:
     queue = collections.deque()
 
     def put(batch):
-        return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), sharding), batch
-        )
+        return put_batch(batch, sharding)
 
     try:
         for _ in range(depth):

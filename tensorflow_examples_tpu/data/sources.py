@@ -49,10 +49,15 @@ def load_mnist(data_dir: str = "", split: str = "train") -> InMemoryDataset:
     if data_dir:
         imgs = _find(data_dir, [f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"])
         lbls = _find(data_dir, [f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels.idx1-ubyte"])
-        if imgs and lbls:
-            x = _read_idx(imgs).astype(np.float32) / 255.0
-            y = _read_idx(lbls).astype(np.int32)
-            return InMemoryDataset({"image": x[..., None], "label": y})
+        if not (imgs and lbls):
+            raise FileNotFoundError(
+                f"--data_dir={data_dir} set but MNIST IDX files not found there "
+                "(expected train-images-idx3-ubyte etc.); omit --data_dir for "
+                "synthetic data"
+            )
+        x = _read_idx(imgs).astype(np.float32) / 255.0
+        y = _read_idx(lbls).astype(np.int32)
+        return InMemoryDataset({"image": x[..., None], "label": y})
     return synthetic_images(
         n=60000 if split == "train" else 10000,
         shape=(28, 28, 1),
@@ -77,26 +82,30 @@ def load_cifar10(data_dir: str = "", split: str = "train") -> InMemoryDataset:
             else ["test_batch"]
         )
         paths = [os.path.join(batch_dir, n) for n in names]
-        if all(os.path.exists(p) for p in paths):
-            xs, ys = [], []
-            for p in paths:
-                with open(p, "rb") as f:
-                    d = pickle.load(f, encoding="bytes")
-                xs.append(d[b"data"])
-                ys.append(np.asarray(d[b"labels"]))
-            x = (
-                np.concatenate(xs)
-                .reshape(-1, 3, 32, 32)
-                .transpose(0, 2, 3, 1)
-                .astype(np.float32)
-                / 255.0
+        if not all(os.path.exists(p) for p in paths):
+            raise FileNotFoundError(
+                f"--data_dir={data_dir} set but CIFAR-10 python batches not "
+                "found there; omit --data_dir for synthetic data"
             )
-            mean = np.array([0.4914, 0.4822, 0.4465], np.float32)
-            std = np.array([0.2470, 0.2435, 0.2616], np.float32)
-            x = (x - mean) / std
-            return InMemoryDataset(
-                {"image": x, "label": np.concatenate(ys).astype(np.int32)}
-            )
+        xs, ys = [], []
+        for p in paths:
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.append(np.asarray(d[b"labels"]))
+        x = (
+            np.concatenate(xs)
+            .reshape(-1, 3, 32, 32)
+            .transpose(0, 2, 3, 1)
+            .astype(np.float32)
+            / 255.0
+        )
+        mean = np.array([0.4914, 0.4822, 0.4465], np.float32)
+        std = np.array([0.2470, 0.2435, 0.2616], np.float32)
+        x = (x - mean) / std
+        return InMemoryDataset(
+            {"image": x, "label": np.concatenate(ys).astype(np.int32)}
+        )
     return synthetic_images(
         n=50000 if split == "train" else 10000,
         shape=(32, 32, 3),

@@ -38,7 +38,7 @@ from tensorflow_examples_tpu.core.sharding import (
     batch_sharding,
     shardings_for_params,
 )
-from tensorflow_examples_tpu.data.prefetch import device_prefetch
+from tensorflow_examples_tpu.data.prefetch import device_prefetch, put_batch
 from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
 from tensorflow_examples_tpu.train.config import TrainConfig
 from tensorflow_examples_tpu.train.state import TrainState
@@ -173,9 +173,7 @@ class Trainer:
     # ------------------------------------------------------------- loop
 
     def _put_batch(self, batch):
-        return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), batch
-        )
+        return put_batch(batch, self._batch_sharding)
 
     def fit(
         self,
@@ -213,6 +211,7 @@ class Trainer:
         train_iter = device_prefetch(train_iter, self._batch_sharding)
 
         profiling = False
+        evaluated_now = False
         window: list[Mapping[str, jax.Array]] = []
         last: dict[str, float] = {}
         t_window = time.perf_counter()
@@ -228,7 +227,9 @@ class Trainer:
                 jax.profiler.stop_trace()
                 profiling = False
 
-            if (step + 1) % cfg.log_every == 0 or step + 1 == num_steps:
+            if (cfg.log_every and (step + 1) % cfg.log_every == 0) or (
+                step + 1 == num_steps
+            ):
                 jax.block_until_ready(metrics)
                 dt = time.perf_counter() - t_window
                 last = {
@@ -244,16 +245,24 @@ class Trainer:
                 t_window = time.perf_counter()
                 _log_metrics(self._writer, step + 1, last, prefix="train")
 
+            evaluated_now = False
             if cfg.eval_every and (step + 1) % cfg.eval_every == 0 and eval_iter_fn:
                 eval_metrics = self.evaluate(eval_iter_fn())
                 _log_metrics(self._writer, step + 1, eval_metrics, prefix="eval")
+                evaluated_now = step + 1 == num_steps
+                if evaluated_now:
+                    last.update({f"eval_{k}": v for k, v in eval_metrics.items()})
 
-            if self._ckpt and (step + 1) % cfg.checkpoint_every == 0:
+            if (
+                self._ckpt
+                and cfg.checkpoint_every
+                and (step + 1) % cfg.checkpoint_every == 0
+            ):
                 self._ckpt.save(step + 1, self.state)
 
         if profiling:
             jax.profiler.stop_trace()
-        if eval_iter_fn is not None:
+        if eval_iter_fn is not None and not evaluated_now:
             last.update(
                 {f"eval_{k}": v for k, v in self.evaluate(eval_iter_fn()).items()}
             )

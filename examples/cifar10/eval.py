@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""CIFAR-10 ResNet-20 eval CLI: restore latest checkpoint → test metrics.
+
+    python examples/cifar10/eval.py --device=tpu --workdir=/path/to/run
+"""
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import eval_main
+from tensorflow_examples_tpu.workloads import cifar10
+
+if __name__ == "__main__":
+    app.run(eval_main(cifar10, cifar10.Cifar10Config()))

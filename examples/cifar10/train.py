@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""CIFAR-10 ResNet-20 training CLI (BASELINE.json:configs[1]).
+
+    python examples/cifar10/train.py --device=tpu [--train_steps=N ...]
+"""
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import train_main
+from tensorflow_examples_tpu.workloads import cifar10
+
+if __name__ == "__main__":
+    app.run(train_main(cifar10, cifar10.Cifar10Config()))

@@ -4,42 +4,10 @@
     python examples/mnist/eval.py --device=tpu --workdir=/path/to/run
 """
 
-from absl import app, logging
+from absl import app
 
-from tensorflow_examples_tpu.core import distributed
-from tensorflow_examples_tpu.data.memory import eval_batches
-from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
-from tensorflow_examples_tpu.train.config import (
-    apply_device_flag,
-    config_from_flags,
-    define_flags_from_config,
-)
-from tensorflow_examples_tpu.train.loop import Trainer
+from tensorflow_examples_tpu.train.cli import eval_main
 from tensorflow_examples_tpu.workloads import mnist
 
-_DEFAULT = mnist.MnistConfig()
-define_flags_from_config(_DEFAULT)
-
-
-def main(argv):
-    del argv
-    logging.set_verbosity(logging.INFO)
-    cfg = config_from_flags(_DEFAULT)
-    apply_device_flag(cfg.device)
-    distributed.initialize()
-    if not cfg.workdir:
-        raise app.UsageError("--workdir is required for eval")
-
-    _, test_ds = mnist.datasets(cfg)
-    trainer = Trainer(mnist.make_task(cfg), cfg)
-    restored = CheckpointManager(cfg.workdir).restore_latest(trainer.state)
-    if restored is None:
-        raise SystemExit(f"no checkpoint under {cfg.workdir}")
-    trainer.state = restored[0]
-    eval_bs = cfg.eval_batch_size or cfg.global_batch_size
-    metrics = trainer.evaluate(eval_batches(test_ds, eval_bs))
-    print({k: round(v, 4) for k, v in metrics.items()})
-
-
 if __name__ == "__main__":
-    app.run(main)
+    app.run(eval_main(mnist, mnist.MnistConfig()))

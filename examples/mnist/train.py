@@ -1,46 +1,14 @@
 #!/usr/bin/env python
-"""MNIST MLP training CLI.
+"""MNIST MLP training CLI (BASELINE.json:configs[0]).
 
 Usage (contract preserved from the reference — BASELINE.json:north_star):
     python examples/mnist/train.py --device=tpu [--train_steps=N ...]
 """
 
-import sys
+from absl import app
 
-from absl import app, flags, logging
-
-from tensorflow_examples_tpu.core import distributed
-from tensorflow_examples_tpu.data.memory import eval_batches, train_iterator
-from tensorflow_examples_tpu.train.config import (
-    apply_device_flag,
-    config_from_flags,
-    define_flags_from_config,
-)
-from tensorflow_examples_tpu.train.loop import Trainer
+from tensorflow_examples_tpu.train.cli import train_main
 from tensorflow_examples_tpu.workloads import mnist
 
-_DEFAULT = mnist.MnistConfig()
-define_flags_from_config(_DEFAULT)
-
-
-def main(argv):
-    del argv
-    logging.set_verbosity(logging.INFO)
-    cfg = config_from_flags(_DEFAULT)
-    apply_device_flag(cfg.device)
-    distributed.initialize()
-
-    train_ds, test_ds = mnist.datasets(cfg)
-    trainer = Trainer(mnist.make_task(cfg), cfg)
-    eval_bs = cfg.eval_batch_size or cfg.global_batch_size
-    metrics = trainer.fit(
-        lambda start: train_iterator(
-            train_ds, cfg.global_batch_size, seed=cfg.seed, start_step=start
-        ),
-        eval_iter_fn=lambda: eval_batches(test_ds, eval_bs),
-    )
-    print({k: round(v, 4) for k, v in metrics.items()})
-
-
 if __name__ == "__main__":
-    app.run(main)
+    app.run(train_main(mnist, mnist.MnistConfig()))

@@ -1,0 +1,44 @@
+"""Host-side image augmentation (numpy, vectorized over the batch).
+
+Replaces the reference's per-example ``tf.data.map(augment)`` stages
+(SURVEY.md §3(4)). Runs on host CPU threads overlapped with the device
+step via the prefetch queue; everything is driven by the iterator's
+per-step ``np.random.Generator``, so augmentation is deterministic given
+(seed, step) and exactly reproducible across resume — which a stateful
+tf.data shuffle/augment pipeline was not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_crop_flip(
+    images: np.ndarray, rng: np.random.Generator, *, pad: int = 4
+) -> np.ndarray:
+    """CIFAR-standard augmentation: reflect-pad, random crop, random h-flip.
+
+    images: [B, H, W, C] float. Vectorized: one gather per batch, no
+    per-image Python loop.
+    """
+    b, h, w, c = images.shape
+    padded = np.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+    )
+    ys = rng.integers(0, 2 * pad + 1, size=b)
+    xs = rng.integers(0, 2 * pad + 1, size=b)
+    # Gather crops via advanced indexing: rows [B, H, 1], cols [B, 1, W].
+    row_idx = ys[:, None] + np.arange(h)[None, :]
+    col_idx = xs[:, None] + np.arange(w)[None, :]
+    out = padded[
+        np.arange(b)[:, None, None], row_idx[:, :, None], col_idx[:, None, :]
+    ]
+    flip = rng.random(b) < 0.5
+    out[flip] = out[flip, :, ::-1]
+    return np.ascontiguousarray(out)
+
+
+def cifar_augment(batch: dict, rng: np.random.Generator) -> dict:
+    out = dict(batch)
+    out["image"] = random_crop_flip(batch["image"], rng, pad=4)
+    return out

@@ -1,0 +1,160 @@
+"""ResNet family: ResNet-20 (CIFAR) and ResNet-50 (ImageNet).
+
+Capability parity with the reference's CIFAR-10 ResNet-20 example
+(BASELINE.json:configs[1]: "3 stages × n blocks" builder) and the
+ResNet-50 ImageNet throughput workload (BASELINE.json:configs[2]).
+
+TPU-native choices:
+- NHWC layout end-to-end (XLA:TPU's preferred conv layout; channels land
+  on the 128-wide lane dimension of the MXU).
+- BatchNorm under ``jax.jit`` with a batch-sharded input IS sync-BN: the
+  batch is one global logical array, so XLA computes cross-replica moments
+  with an all-reduce it fuses into the normalization — no wrapper like
+  tf.keras SyncBatchNormalization needed.
+- Zero-init of each residual branch's last BN scale (the standard "zero
+  gamma" trick) so deep nets start as identity maps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+_conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-20/-18/-34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), (self.strides, self.strides), name="proj"
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1(×4) bottleneck block (ResNet-50/-101/-152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), (self.strides, self.strides), name="proj"
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Generic staged ResNet.
+
+    ``stem='cifar'``: single 3x3 conv (32x32 inputs).
+    ``stem='imagenet'``: 7x7/2 conv + 3x3/2 maxpool (224x224 inputs).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: Callable[..., nn.Module]
+    num_classes: int
+    num_filters: int = 64
+    stem: str = "imagenet"
+    bn_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, padding="SAME", kernel_init=_conv_init
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=1e-5,
+        )
+
+        if self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="stem_conv")(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**stage,
+                    conv=conv,
+                    norm=norm,
+                    strides=strides,
+                    name=f"stage{stage}_block{block}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        # Classifier in f32: the tiny matmul costs nothing and keeps the
+        # logits/loss numerics exact under bf16 compute.
+        x = nn.Dense(
+            self.num_classes,
+            name="head",
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+        )(x.astype(jnp.float32))
+        return x
+
+
+def resnet20(num_classes: int = 10) -> ResNet:
+    """CIFAR ResNet-20: 3 stages × 3 basic blocks, 16/32/64 filters."""
+    return ResNet(
+        stage_sizes=(3, 3, 3),
+        block_cls=BasicBlock,
+        num_classes=num_classes,
+        num_filters=16,
+        stem="cifar",
+    )
+
+
+def resnet50(num_classes: int = 1000) -> ResNet:
+    """ImageNet ResNet-50: 3/4/6/3 bottleneck blocks, 64-filter stem."""
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        block_cls=BottleneckBlock,
+        num_classes=num_classes,
+        num_filters=64,
+        stem="imagenet",
+    )

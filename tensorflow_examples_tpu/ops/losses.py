@@ -31,6 +31,7 @@ def softmax_cross_entropy(
         smooth = -jnp.mean(log_probs, axis=-1)
         nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
     if weights is not None:
+        weights = weights.astype(jnp.float32)
         return jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
     return jnp.mean(nll)
 
@@ -41,6 +42,7 @@ def accuracy_metrics(
     pred = jnp.argmax(logits, axis=-1)
     correct = (pred == labels).astype(jnp.float32)
     if weights is not None:
+        weights = weights.astype(jnp.float32)
         denom = jnp.maximum(jnp.sum(weights), 1.0)
         return {
             "accuracy": jnp.sum(correct * weights) / denom,

@@ -57,6 +57,7 @@ def _as_dict(state: Any) -> dict:
         "step": state.step,
         "params": state.params,
         "opt_state": state.opt_state,
+        "model_state": state.model_state,
     }
 
 
@@ -65,4 +66,5 @@ def _merge_arrays(state: Any, restored: dict) -> Any:
         step=restored["step"],
         params=restored["params"],
         opt_state=restored["opt_state"],
+        model_state=restored.get("model_state", state.model_state),
     )

@@ -1,0 +1,103 @@
+"""Shared CLI runner for example entrypoints.
+
+Each ``examples/<name>/train.py`` in the reference was a full copy-pasted
+script; here it is a thin shim over this module, preserving the CLI
+contract ``python <example>/train.py --device=tpu --flag=...``
+(BASELINE.json:north_star) while the actual loop lives in the framework.
+
+A workload module plugs in via a small protocol:
+  - ``make_task(cfg) -> Task``          (required)
+  - ``datasets(cfg) -> (train, eval)``  (required; InMemoryDataset pair or
+                                         iterator factories)
+  - ``train_augment(cfg) -> fn | None`` (optional)
+  - ``make_train_iter(cfg, start) / make_eval_iter(cfg)`` (optional full
+     override for streaming pipelines like ImageNet)
+"""
+
+from __future__ import annotations
+
+from absl import app, logging
+
+from tensorflow_examples_tpu.core import distributed
+from tensorflow_examples_tpu.data.memory import eval_batches, train_iterator
+from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
+from tensorflow_examples_tpu.train.config import (
+    apply_device_flag,
+    config_from_flags,
+    define_flags_from_config,
+)
+from tensorflow_examples_tpu.train.loop import Trainer
+
+
+def _setup(workload, default_cfg):
+    logging.set_verbosity(logging.INFO)
+    cfg = config_from_flags(default_cfg)
+    apply_device_flag(cfg.device)
+    distributed.initialize()
+    return cfg
+
+
+def _iterators(workload, cfg):
+    """Resolve (train_iter_fn(start), eval_iter_fn()) from the protocol."""
+    eval_bs = cfg.eval_batch_size or cfg.global_batch_size
+    if hasattr(workload, "make_train_iter"):
+        train_fn = lambda start: workload.make_train_iter(cfg, start)
+        eval_fn = (
+            (lambda: workload.make_eval_iter(cfg))
+            if hasattr(workload, "make_eval_iter")
+            else None
+        )
+        return train_fn, eval_fn
+    train_ds, test_ds = workload.datasets(cfg)
+    augment = (
+        workload.train_augment(cfg) if hasattr(workload, "train_augment") else None
+    )
+    train_fn = lambda start: train_iterator(
+        train_ds,
+        cfg.global_batch_size,
+        seed=cfg.seed,
+        start_step=start,
+        augment=augment,
+    )
+    eval_fn = lambda: eval_batches(test_ds, eval_bs)
+    return train_fn, eval_fn
+
+
+def train_main(workload, default_cfg):
+    """Build the absl main() for a workload's train.py."""
+    define_flags_from_config(default_cfg)
+
+    def main(argv):
+        del argv
+        cfg = _setup(workload, default_cfg)
+        train_fn, eval_fn = _iterators(workload, cfg)
+        trainer = Trainer(workload.make_task(cfg), cfg)
+        metrics = trainer.fit(train_fn, eval_iter_fn=eval_fn)
+        print({k: round(v, 4) for k, v in metrics.items()})
+
+    return main
+
+
+def eval_main(workload, default_cfg):
+    """Build the absl main() for a workload's eval.py."""
+    define_flags_from_config(default_cfg)
+
+    def main(argv):
+        del argv
+        cfg = _setup(workload, default_cfg)
+        if not cfg.workdir:
+            raise app.UsageError("--workdir is required for eval")
+        _, eval_fn = _iterators(workload, cfg)
+        if eval_fn is None:
+            raise app.UsageError(
+                f"workload {workload.__name__} defines no eval pipeline"
+            )
+        trainer = Trainer(workload.make_task(cfg), cfg)
+        restored = CheckpointManager(cfg.workdir).restore_latest(trainer.state)
+        if restored is None:
+            raise SystemExit(f"no checkpoint under {cfg.workdir}")
+        trainer.state = restored[0]
+        metrics = trainer.evaluate(eval_fn())
+        print({k: round(v, 4) for k, v in metrics.items()})
+
+    return main

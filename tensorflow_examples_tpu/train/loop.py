@@ -70,8 +70,11 @@ class Trainer:
         rng = jax.random.PRNGKey(cfg.seed)
 
         def make_state(rng):
-            params = self.task.init_fn(rng)
-            return TrainState.create(apply_fn=None, params=params, tx=tx)
+            variables = dict(self.task.init_fn(rng))
+            params = variables.pop("params")
+            return TrainState.create(
+                apply_fn=None, params=params, tx=tx, model_state=variables
+            )
 
         # Evaluate shapes → shardings from the rules → jit-init directly
         # into the sharded layout (params never materialize unsharded).
@@ -117,8 +120,16 @@ class Trainer:
         opt_sh = jax.tree_util.tree_map_with_path(
             opt_sharding, abstract_state.opt_state
         )
+        # Non-trainable collections (BN stats, …) follow the same path rules
+        # (unmatched → replicated, the common case for norm statistics).
+        model_state_sh = shardings_for_params(
+            abstract_state.model_state, self.mesh, rules
+        )
         return abstract_state.replace(
-            step=replicated, params=param_sh, opt_state=opt_sh
+            step=replicated,
+            params=param_sh,
+            opt_state=opt_sh,
+            model_state=model_state_sh,
         )
 
     # ------------------------------------------------------------- steps
@@ -131,16 +142,26 @@ class Trainer:
             rng = step_rng(seed_key, state.step)
 
             def loss_fn(params):
+                # Cast params AND batch: flax's dtype promotion computes in
+                # result_type(input, kernel), so a f32 batch would silently
+                # promote every matmul back to f32.
                 compute_params = policy.cast_compute(params)
-                loss, metrics = task.loss_fn(
-                    compute_params, batch, rng=rng, train=True
+                compute_batch = policy.cast_compute(batch)
+                loss, metrics, new_model_state = task.loss_fn(
+                    compute_params,
+                    state.model_state,
+                    compute_batch,
+                    rng=rng,
+                    train=True,
                 )
-                return loss, metrics
+                return loss, (metrics, new_model_state)
 
-            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
+            (loss, (metrics, new_model_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            new_state = state.apply_gradients(grads).replace(
+                model_state=new_model_state
             )
-            new_state = state.apply_gradients(grads)
             metrics = dict(metrics)
             metrics["loss"] = loss
             metrics["grad_norm"] = optax.global_norm(
@@ -161,12 +182,14 @@ class Trainer:
             return None
         task, policy = self.task, self.policy
 
-        def eval_step(params, batch):
-            return task.eval_fn(policy.cast_compute(params), batch)
+        def eval_step(params, model_state, batch):
+            return task.eval_fn(
+                policy.cast_compute(params), model_state, policy.cast_compute(batch)
+            )
 
         return jax.jit(
             eval_step,
-            in_shardings=(None, self._batch_sharding),
+            in_shardings=(None, None, self._batch_sharding),
             out_shardings=NamedSharding(self.mesh, P()),
         )
 
@@ -282,7 +305,9 @@ class Trainer:
         totals: dict[str, jax.Array] = {}
         count = None
         for batch in device_prefetch(iter(eval_iter), self._batch_sharding):
-            m = dict(self._eval_step(self.state.params, batch))
+            m = dict(
+                self._eval_step(self.state.params, self.state.model_state, batch)
+            )
             weight = m.pop("weight", None)
             w = weight if weight is not None else jnp.float32(1.0)
             for k, v in m.items():

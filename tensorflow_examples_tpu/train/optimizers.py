@@ -15,11 +15,14 @@ from tensorflow_examples_tpu.train.config import TrainConfig
 
 
 def warmup_cosine(cfg: TrainConfig, *, end_value: float = 0.0) -> optax.Schedule:
+    warmup = max(cfg.warmup_steps, 1)
     return optax.warmup_cosine_decay_schedule(
         init_value=0.0,
         peak_value=cfg.learning_rate,
-        warmup_steps=max(cfg.warmup_steps, 1),
-        decay_steps=max(cfg.train_steps, 2),
+        warmup_steps=warmup,
+        # decay_steps includes warmup; keep the cosine span positive even
+        # for short smoke runs where train_steps < warmup_steps.
+        decay_steps=max(cfg.train_steps, warmup + 1, 2),
         end_value=end_value,
     )
 

@@ -20,18 +20,22 @@ class TrainState:
     step: jax.Array
     params: Any
     opt_state: Any
+    # Non-trainable model collections (BatchNorm running stats, …) — the
+    # ``tf.keras`` non-trainable-variables analogue. ``{}`` when stateless.
+    model_state: Any
     # Non-pytree leaves:
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
 
     @classmethod
-    def create(cls, *, apply_fn, params, tx) -> "TrainState":
+    def create(cls, *, apply_fn, params, tx, model_state=None) -> "TrainState":
         import jax.numpy as jnp
 
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
+            model_state={} if model_state is None else model_state,
             apply_fn=apply_fn,
             tx=tx,
         )

@@ -18,18 +18,21 @@ from tensorflow_examples_tpu.core.sharding import REPLICATED, ShardingRules
 from tensorflow_examples_tpu.train.config import TrainConfig
 
 Batch = Mapping[str, jax.Array]
-# loss_fn(params, batch, model_apply, rng, train) -> (loss, metrics-dict)
-LossFn = Callable[..., tuple[jax.Array, Mapping[str, jax.Array]]]
+# loss_fn(params, model_state, batch, rng=, train=)
+#   -> (loss, metrics-dict, new_model_state)
+LossFn = Callable[..., tuple[jax.Array, Mapping[str, jax.Array], Any]]
 
 
 @dataclasses.dataclass
 class Task:
     name: str
-    # init_fn(rng) -> params pytree
+    # init_fn(rng) -> flax-style variables pytree: {"params": …, then any
+    # non-trainable collections ("batch_stats", …) which become
+    # TrainState.model_state}
     init_fn: Callable[[jax.Array], Any]
-    # apply_fn(params, batch, rng, train) -> (loss, metrics)
     loss_fn: LossFn
     make_optimizer: Callable[[TrainConfig], optax.GradientTransformation]
     sharding_rules: ShardingRules = dataclasses.field(default_factory=lambda: REPLICATED)
-    # eval_step(params, batch) -> metrics dict of (sum, count) style values
+    # eval_fn(params, model_state, batch) -> metrics dict; a "weight" entry
+    # weights the mean (padded-batch masking)
     eval_fn: Callable[..., Mapping[str, jax.Array]] | None = None

@@ -1,0 +1,82 @@
+"""CIFAR-10 ResNet-20 workload (BASELINE.json:configs[1]).
+
+Reference behavior: ResNet-20 (3 stages × n blocks) under a single-device
+``tf.distribute`` strategy, crop/flip augmentation, cosine (or step) LR
+with SGD+momentum. Here: the same capability on the shared TPU loop —
+jitted fused step, bf16 compute with f32 BN/head, sync-BN for free via
+global-batch jit semantics, deterministic host-side augmentation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tensorflow_examples_tpu.core.sharding import REPLICATED
+from tensorflow_examples_tpu.data.augment import cifar_augment
+from tensorflow_examples_tpu.data.sources import load_cifar10
+from tensorflow_examples_tpu.models.resnet import resnet20
+from tensorflow_examples_tpu.ops.losses import accuracy_metrics, softmax_cross_entropy
+from tensorflow_examples_tpu.train import Task, TrainConfig
+from tensorflow_examples_tpu.train import optimizers
+
+
+@dataclasses.dataclass
+class Cifar10Config(TrainConfig):
+    # Classic ResNet-20 recipe: batch 128, ~64k steps, SGD+momentum with
+    # cosine decay from 0.1, weight decay 1e-4.
+    global_batch_size: int = 128
+    train_steps: int = 64000
+    warmup_steps: int = 400
+    learning_rate: float = 0.1
+    weight_decay: float = 1e-4
+    eval_every: int = 4000
+    checkpoint_every: int = 4000
+    augment: bool = True
+
+
+def make_task(cfg: Cifar10Config) -> Task:
+    model = resnet20(num_classes=10)
+
+    def init_fn(rng):
+        import jax.numpy as jnp
+
+        dummy = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        return model.init({"params": rng}, dummy)
+
+    def loss_fn(params, model_state, batch, *, rng, train):
+        logits, new_vars = model.apply(
+            {"params": params, **model_state},
+            batch["image"],
+            train=train,
+            mutable=["batch_stats"] if train else [],
+        )
+        loss = softmax_cross_entropy(logits, batch["label"])
+        new_model_state = dict(new_vars) if train else model_state
+        return loss, accuracy_metrics(logits, batch["label"]), new_model_state
+
+    def eval_fn(params, model_state, batch):
+        logits = model.apply(
+            {"params": params, **model_state}, batch["image"], train=False
+        )
+        m = accuracy_metrics(logits, batch["label"], weights=batch["mask"])
+        m["loss"] = softmax_cross_entropy(
+            logits, batch["label"], weights=batch["mask"]
+        )
+        return m
+
+    return Task(
+        name="cifar10_resnet20",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_optimizer=optimizers.sgd_momentum_cosine,
+        sharding_rules=REPLICATED,
+        eval_fn=eval_fn,
+    )
+
+
+def datasets(cfg: Cifar10Config):
+    return load_cifar10(cfg.data_dir, "train"), load_cifar10(cfg.data_dir, "test")
+
+
+def train_augment(cfg: Cifar10Config):
+    return cifar_augment if cfg.augment else None

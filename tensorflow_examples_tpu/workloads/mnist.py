@@ -41,9 +41,9 @@ def make_task(cfg: MnistConfig) -> Task:
         import jax.numpy as jnp
 
         dummy = jnp.zeros((1, 28, 28, 1), jnp.float32)
-        return model.init({"params": rng}, dummy)["params"]
+        return model.init({"params": rng}, dummy)
 
-    def loss_fn(params, batch, *, rng, train):
+    def loss_fn(params, model_state, batch, *, rng, train):
         logits = model.apply(
             {"params": params},
             batch["image"],
@@ -51,9 +51,9 @@ def make_task(cfg: MnistConfig) -> Task:
             rngs={"dropout": rng} if train else None,
         )
         loss = softmax_cross_entropy(logits, batch["label"])
-        return loss, accuracy_metrics(logits, batch["label"])
+        return loss, accuracy_metrics(logits, batch["label"]), model_state
 
-    def eval_fn(params, batch):
+    def eval_fn(params, model_state, batch):
         logits = model.apply({"params": params}, batch["image"], train=False)
         m = accuracy_metrics(logits, batch["label"], weights=batch["mask"])
         m["loss"] = softmax_cross_entropy(

@@ -1,0 +1,103 @@
+"""CIFAR-10 ResNet-20 integration tests: tiny end-to-end train on the
+shared loop with BatchNorm state threading (SURVEY.md §4 integration tier).
+"""
+
+import numpy as np
+import pytest
+
+from tensorflow_examples_tpu.data.memory import eval_batches, train_iterator
+from tensorflow_examples_tpu.data.sources import synthetic_images
+from tensorflow_examples_tpu.train.loop import Trainer
+from tensorflow_examples_tpu.workloads import cifar10
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg(tmp_path_factory):
+    return cifar10.Cifar10Config(
+        global_batch_size=32,
+        train_steps=12,
+        warmup_steps=2,
+        learning_rate=0.05,
+        precision="f32",
+        log_every=6,
+        eval_every=0,
+        checkpoint_every=0,
+        workdir="",
+        augment=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_ds():
+    return synthetic_images(n=256, shape=(32, 32, 3), num_classes=10, seed=0)
+
+
+def test_train_loss_decreases(tiny_cfg, tiny_ds):
+    trainer = Trainer(cifar10.make_task(tiny_cfg), tiny_cfg)
+    it = train_iterator(
+        tiny_ds,
+        tiny_cfg.global_batch_size,
+        seed=0,
+        augment=cifar10.train_augment(tiny_cfg),
+    )
+    first_loss = None
+    state = trainer.state
+    for i in range(tiny_cfg.train_steps):
+        state, m = trainer._train_step(state, trainer._put_batch(next(it)))
+        if first_loss is None:
+            first_loss = float(m["loss"])
+    assert float(m["loss"]) < first_loss
+
+
+def test_batch_stats_are_threaded(tiny_cfg, tiny_ds):
+    trainer = Trainer(cifar10.make_task(tiny_cfg), tiny_cfg)
+    it = train_iterator(tiny_ds, tiny_cfg.global_batch_size, seed=0)
+    before = np.asarray(
+        trainer.state.model_state["batch_stats"]["stem_bn"]["mean"]
+    )
+    state, _ = trainer._train_step(trainer.state, trainer._put_batch(next(it)))
+    after = np.asarray(state.model_state["batch_stats"]["stem_bn"]["mean"])
+    assert not np.allclose(before, after)
+
+
+def test_eval_runs_with_model_state(tiny_cfg, tiny_ds):
+    trainer = Trainer(cifar10.make_task(tiny_cfg), tiny_cfg)
+    metrics = trainer.evaluate(eval_batches(tiny_ds, 32))
+    assert "accuracy" in metrics and "loss" in metrics
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_checkpoint_roundtrip_includes_model_state(tiny_ds, tmp_path):
+    cfg = cifar10.Cifar10Config(
+        global_batch_size=32,
+        train_steps=3,
+        warmup_steps=1,
+        precision="f32",
+        log_every=10**9,
+        eval_every=0,
+        checkpoint_every=3,
+        workdir=str(tmp_path),
+        augment=False,
+    )
+    trainer = Trainer(cifar10.make_task(cfg), cfg)
+    trainer.fit(
+        lambda start: train_iterator(
+            tiny_ds, cfg.global_batch_size, seed=0, start_step=start
+        )
+    )
+    stats = np.asarray(
+        trainer.state.model_state["batch_stats"]["stem_bn"]["mean"]
+    )
+
+    trainer2 = Trainer(cifar10.make_task(cfg), cfg)
+    from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
+
+    restored = CheckpointManager(str(tmp_path)).restore_latest(trainer2.state)
+    assert restored is not None
+    state2, step = restored
+    assert step == 3
+    np.testing.assert_allclose(
+        np.asarray(state2.model_state["batch_stats"]["stem_bn"]["mean"]),
+        stats,
+        rtol=1e-6,
+    )

@@ -7,6 +7,16 @@ each paired with a pure-XLA reference implementation of identical
 signature used for numerics tests (SURVEY.md §4) and as the CPU fallback.
 """
 
+from tensorflow_examples_tpu.ops.attention import (
+    attention_reference,
+    dot_product_attention,
+    flash_attention,
+)
+from tensorflow_examples_tpu.ops.cross_entropy import (
+    cross_entropy_loss,
+    cross_entropy_per_example,
+    cross_entropy_reference,
+)
 from tensorflow_examples_tpu.ops.losses import (
     accuracy_metrics,
     softmax_cross_entropy,

@@ -1,0 +1,405 @@
+"""Flash (blockwise) attention as a Pallas TPU kernel.
+
+TPU-native replacement for the reference's CUDA ``tf.custom_op`` kernels
+(BASELINE.json:north_star — "rewrite any tf.custom_op / CUDA kernels ...
+as Pallas or XLA custom-calls"; SURVEY.md §2c, §5g). The kernel is the
+single-device base for ring attention (``parallel/ring.py``): it computes
+attention over KV *blocks* with an online softmax, so the same math
+extends to KV blocks arriving over ICI.
+
+Design (TPU-first, not a CUDA translation):
+- Q is blocked over the grid; K/V live in VMEM per (batch*head) and are
+  consumed block-by-block inside a ``fori_loop`` — the online-softmax
+  running (max, sum, acc) ride in loop carries, which Mosaic keeps in
+  vector registers/VMEM.
+- All matmuls run on the MXU in f32 accumulation
+  (``preferred_element_type``), inputs may be bf16.
+- Causal masking skips whole KV blocks above the diagonal by shortening
+  the loop bound (no wasted MXU work), and masks inside the diagonal
+  block with ``broadcasted_iota``.
+- Backward is the standard two-kernel split (dkv by KV block, dq by Q
+  block) using the saved logsumexp, so the [seq, seq] score matrix is
+  never materialized in HBM.
+
+On non-TPU backends the same kernels run in Pallas interpret mode (used
+by the CPU test suite) and an XLA reference implementation is provided
+for numerics comparison.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Plain-XLA attention; the numerics reference for the Pallas kernel.
+
+    q, k, v: [batch, heads, seq, head_dim]. Softmax in f32.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(row + (sk - sq) >= col, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+# --------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_kv):
+    block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
+    seq_kv = k_ref.shape[1]
+    num_kv = seq_kv // block_kv
+    qi = pl.program_id(1)
+    q_offset = qi * block_q
+    # Bottom-right-aligned causal diagonal: query i attends keys
+    # <= i + (seq_kv - seq_q), matching attention_reference.
+    offset = seq_kv - pl.num_programs(1) * block_q
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_kv]
+        if causal:
+            row = q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            col = j * block_kv + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(row + offset >= col, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    # Causal: KV blocks entirely above the diagonal contribute nothing —
+    # shorten the loop instead of masking them (saves MXU work).
+    hi = (
+        jnp.clip(
+            lax.div(q_offset + block_q + offset + block_kv - 1, block_kv),
+            0,
+            num_kv,
+        )
+        if causal
+        else num_kv
+    )
+    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret):
+    bh, seq_q, head_dim = q.shape
+    seq_kv = k.shape[1]
+    grid = (bh, seq_q // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_kv=block_kv
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# -------------------------------------------------------------- backward
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, block_q,
+):
+    block_kv, head_dim = k_ref.shape[1], k_ref.shape[2]
+    seq_q = q_ref.shape[1]
+    seq_kv = pl.num_programs(1) * block_kv
+    offset = seq_kv - seq_q
+    ki = pl.program_id(1)
+    kv_offset = ki * block_kv
+
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q), :]  # [block_q, 1]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q), :]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_kv]
+        if causal:
+            row = j * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            col = kv_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(row + offset >= col, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_kv]
+        # dv += p^T do
+        dv_new = dv + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dp = do v^T ; ds = p * (dp - delta)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        # dk += ds^T q * scale
+        dk_new = dk + sm_scale * lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    zeros = jnp.zeros((block_kv, head_dim), jnp.float32)
+    # Causal: Q blocks strictly above this KV block's diagonal see none of
+    # it — start the loop at the first contributing Q block.
+    lo = (
+        jnp.clip(lax.div(kv_offset - offset, block_q), 0, seq_q // block_q)
+        if causal
+        else 0
+    )
+    dk, dv = lax.fori_loop(lo, seq_q // block_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, sm_scale, causal, block_kv,
+):
+    block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
+    seq_kv = k_ref.shape[1]
+    offset = seq_kv - pl.num_programs(1) * block_q
+    qi = pl.program_id(1)
+    q_offset = qi * block_q
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            row = q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            col = j * block_kv + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(row + offset >= col, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + sm_scale * jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+    hi = (
+        jnp.clip(
+            lax.div(q_offset + block_q + offset + block_kv - 1, block_kv),
+            0,
+            seq_kv // block_kv,
+        )
+        if causal
+        else seq_kv // block_kv
+    )
+    dq = lax.fori_loop(
+        0, hi, body, jnp.zeros((block_q, head_dim), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_kv, interpret, residuals, g):
+    q, k, v, o, lse = residuals
+    bh, seq_q, head_dim = q.shape
+    seq_kv = k.shape[1]
+    do = g
+    # delta_i = rowsum(do_i * o_i) — cheap, let XLA fuse it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    full_q = pl.BlockSpec((1, seq_q, head_dim), lambda b, i: (b, 0, 0))
+    full_kv = pl.BlockSpec((1, seq_kv, head_dim), lambda b, i: (b, 0, 0))
+    full_vec = pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q
+        ),
+        grid=(bh, seq_kv // block_kv),
+        in_specs=[full_q,
+                  pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
+                  full_q, full_vec, full_vec],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_kv=block_kv
+        ),
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            full_kv, full_kv,
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------ public api
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(causal, block_q, block_kv, interpret):
+    # sm_scale stays out of the cache key (a swept/per-layer scale must
+    # not leak a closure per value) — it rides through as a nondiff arg.
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+    def flash(q, k, v, sm_scale):
+        o, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret)
+        return o
+
+    def fwd(q, k, v, sm_scale):
+        o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret)
+        return o, (q, k, v, o, lse)
+
+    def bwd(sm_scale, residuals, g):
+        return _flash_bwd(
+            sm_scale, causal, block_q, block_kv, interpret, residuals, g
+        )
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Blockwise attention, differentiable; q/k/v: [batch, heads, seq, dim].
+
+    Runs the Pallas TPU kernel on TPU; on other backends runs the same
+    kernel in interpret mode (tests) unless ``interpret=False``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, seq_q, head_dim = q.shape
+    seq_kv = k.shape[2]
+    block_q = min(block_q, seq_q)
+    block_kv = min(block_kv, seq_kv)
+    if seq_q % block_q or seq_kv % block_kv:
+        raise ValueError(
+            f"seq lengths ({seq_q}, {seq_kv}) must be divisible by block "
+            f"sizes ({block_q}, {block_kv})"
+        )
+    if causal and seq_q > seq_kv:
+        # Rows with zero visible keys are degenerate (the reference
+        # softmaxes an all-masked row into uniform weights; the kernel
+        # would return 0) — reject rather than silently diverge.
+        raise ValueError(
+            f"causal attention requires seq_q ({seq_q}) <= seq_kv ({seq_kv})"
+        )
+    if sm_scale is None:
+        sm_scale = head_dim**-0.5
+    flash = _make_flash(bool(causal), block_q, block_kv, interpret)
+    fold = lambda x: x.reshape(b * h, x.shape[2], head_dim)
+    out = flash(fold(q), fold(k), fold(v), float(sm_scale))
+    return out.reshape(b, h, seq_q, head_dim)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    use_flash: bool = True,
+) -> jax.Array:
+    """Dispatcher: Pallas flash kernel when enabled, XLA reference otherwise."""
+    if use_flash:
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)

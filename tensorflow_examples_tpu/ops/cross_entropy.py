@@ -1,0 +1,191 @@
+"""Fused softmax cross-entropy as a Pallas TPU kernel.
+
+The reference computed sparse categorical cross-entropy via stock ops,
+which materializes a full [tokens, vocab] log-softmax in HBM — at GPT-2
+scale (vocab 50257) that is the single largest activation in the model.
+This kernel is HBM-bandwidth shaped instead: the vocab axis is consumed
+in VMEM-sized chunks with an online logsumexp; only per-row (nll, lse)
+ever leave the chip's VMEM in forward, and backward recomputes the
+softmax chunk-by-chunk from the saved lse (SURVEY.md §2c obligation —
+"fused cross-entropy" in the kernels layer).
+
+Grid layout: (row blocks, vocab chunks). The TPU grid is sequential with
+the last dimension fastest, so VMEM scratch carries the running
+(max, sumexp, label-logit) across vocab chunks of one row block — the
+same accumulation pattern as a blocked matmul's K loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def cross_entropy_reference(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example NLL in f32 via plain XLA. logits [N, V], labels [N]."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return lse - label_logit
+
+
+# --------------------------------------------------------------- kernels
+
+
+def _ce_fwd_kernel(
+    logits_ref, labels_ref, nll_ref, lse_ref, m_acc, l_acc, t_acc, *, vocab
+):
+    j = pl.program_id(1)
+    block_n, block_v = logits_ref.shape
+
+    @pl.when(j == 0)
+    def _():
+        m_acc[...] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+        t_acc[...] = jnp.zeros_like(t_acc)
+
+    col = j * block_v + lax.broadcasted_iota(jnp.int32, (block_n, block_v), 1)
+    s = jnp.where(col < vocab, logits_ref[...].astype(jnp.float32), NEG_INF)
+    labels = labels_ref[...]  # [block_n, 1]
+
+    m_prev, l_prev = m_acc[...], l_acc[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(jnp.exp(s - m_new), axis=1, keepdims=True)
+    m_acc[...] = m_new
+    l_acc[...] = l_new
+    # The label's logit lands in exactly one vocab chunk; accumulate it.
+    t_acc[...] += jnp.sum(
+        jnp.where(col == labels, s, 0.0), axis=1, keepdims=True
+    )
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        lse = m_acc[...] + jnp.log(jnp.maximum(l_acc[...], 1e-30))
+        lse_ref[...] = lse
+        nll_ref[...] = lse - t_acc[...]
+
+
+def _ce_bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dlogits_ref, *, vocab):
+    j = pl.program_id(1)
+    block_n, block_v = logits_ref.shape
+    col = j * block_v + lax.broadcasted_iota(jnp.int32, (block_n, block_v), 1)
+    logits = logits_ref[...].astype(jnp.float32)
+    p = jnp.exp(logits - lse_ref[...])  # softmax chunk from saved lse
+    onehot = (col == labels_ref[...]).astype(jnp.float32)
+    d = g_ref[...] * (p - onehot)
+    dlogits_ref[...] = jnp.where(col < vocab, d, 0.0).astype(dlogits_ref.dtype)
+
+
+def _fwd_call(logits, labels2d, block_n, block_v, interpret):
+    n, vocab = logits.shape
+    grid = (pl.cdiv(n, block_n), pl.cdiv(vocab, block_v))
+    row_spec = pl.BlockSpec((block_n, 1), lambda i, j: (i, 0))
+    nll, lse = pl.pallas_call(
+        functools.partial(_ce_fwd_kernel, vocab=vocab),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+            row_spec,
+        ],
+        out_specs=[row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits, labels2d)
+    return nll, lse
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused(block_n, block_v, interpret):
+    @jax.custom_vjp
+    def fused(logits, labels2d):
+        nll, _ = _fwd_call(logits, labels2d, block_n, block_v, interpret)
+        return nll
+
+    def fwd(logits, labels2d):
+        nll, lse = _fwd_call(logits, labels2d, block_n, block_v, interpret)
+        return nll, (logits, labels2d, lse)
+
+    def bwd(residuals, g):
+        logits, labels2d, lse = residuals
+        n, vocab = logits.shape
+        row_spec = pl.BlockSpec((block_n, 1), lambda i, j: (i, 0))
+        dlogits = pl.pallas_call(
+            functools.partial(_ce_bwd_kernel, vocab=vocab),
+            grid=(pl.cdiv(n, block_n), pl.cdiv(vocab, block_v)),
+            in_specs=[
+                pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+                row_spec, row_spec, row_spec,
+            ],
+            out_specs=pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct(logits.shape, logits.dtype),
+            interpret=interpret,
+        )(logits, labels2d, lse, g.astype(jnp.float32))
+        return dlogits, None
+
+    fused.defvjp(fwd, bwd)
+    return fused
+
+
+# ------------------------------------------------------------ public api
+
+
+def cross_entropy_per_example(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    block_n: int = 128,
+    block_v: int = 2048,
+    fused: bool | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-example NLL [N] (f32) from logits [N, V] and int labels [N]."""
+    if fused is None:
+        fused = True
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not fused:
+        return cross_entropy_reference(logits, labels)
+    n, vocab = logits.shape
+    block_n = min(block_n, n)
+    block_v = min(block_v, vocab)
+    fn = _make_fused(block_n, block_v, interpret)
+    return fn(logits, labels.astype(jnp.int32)[:, None])[:, 0]
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    weights: jax.Array | None = None,
+    *,
+    fused: bool | None = None,
+) -> jax.Array:
+    """Weighted-mean token cross-entropy for LM heads.
+
+    logits [..., V], labels [...]; weights [...] masks padding. Leading
+    dims are flattened so the kernel sees one [tokens, vocab] problem.
+    """
+    from tensorflow_examples_tpu.ops.losses import weighted_mean
+
+    vocab = logits.shape[-1]
+    flat_logits = logits.reshape(-1, vocab)
+    flat_labels = labels.reshape(-1)
+    nll = cross_entropy_per_example(flat_logits, flat_labels, fused=fused)
+    return weighted_mean(
+        nll, None if weights is None else weights.reshape(-1)
+    )

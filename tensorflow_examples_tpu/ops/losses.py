@@ -11,6 +11,15 @@ import jax
 import jax.numpy as jnp
 
 
+def weighted_mean(values: jax.Array, weights: jax.Array | None) -> jax.Array:
+    """Weighted mean with a padded-batch-safe denominator (min 1.0)."""
+    values = values.astype(jnp.float32)
+    if weights is None:
+        return jnp.mean(values)
+    weights = weights.astype(jnp.float32)
+    return jnp.sum(values * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
 def softmax_cross_entropy(
     logits: jax.Array,
     labels: jax.Array,
@@ -30,10 +39,7 @@ def softmax_cross_entropy(
     if label_smoothing > 0.0:
         smooth = -jnp.mean(log_probs, axis=-1)
         nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
-    if weights is not None:
-        weights = weights.astype(jnp.float32)
-        return jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
-    return jnp.mean(nll)
+    return weighted_mean(nll, weights)
 
 
 def accuracy_metrics(
@@ -42,10 +48,8 @@ def accuracy_metrics(
     pred = jnp.argmax(logits, axis=-1)
     correct = (pred == labels).astype(jnp.float32)
     if weights is not None:
-        weights = weights.astype(jnp.float32)
-        denom = jnp.maximum(jnp.sum(weights), 1.0)
         return {
-            "accuracy": jnp.sum(correct * weights) / denom,
-            "weight": jnp.sum(weights),
+            "accuracy": weighted_mean(correct, weights),
+            "weight": jnp.sum(weights.astype(jnp.float32)),
         }
     return {"accuracy": jnp.mean(correct)}

@@ -9,6 +9,8 @@ A workload module plugs in via a small protocol:
   - ``make_task(cfg) -> Task``          (required)
   - ``datasets(cfg) -> (train, eval)``  (required; InMemoryDataset pair or
                                          iterator factories)
+  - ``eval_dataset(cfg) -> eval``       (optional; lets eval.py skip
+                                         loading the train split)
   - ``train_augment(cfg) -> fn | None`` (optional)
   - ``make_train_iter(cfg, start) / make_eval_iter(cfg)`` (optional full
      override for streaming pipelines like ImageNet)
@@ -63,6 +65,20 @@ def _iterators(workload, cfg):
     return train_fn, eval_fn
 
 
+def _eval_iterator(workload, cfg):
+    """Eval-only resolver: never loads the training split."""
+    eval_bs = cfg.eval_batch_size or cfg.global_batch_size
+    if hasattr(workload, "make_eval_iter"):
+        return lambda: workload.make_eval_iter(cfg)
+    if hasattr(workload, "eval_dataset"):
+        test_ds = workload.eval_dataset(cfg)
+    elif hasattr(workload, "make_train_iter"):
+        return None
+    else:
+        _, test_ds = workload.datasets(cfg)
+    return lambda: eval_batches(test_ds, eval_bs)
+
+
 def train_main(workload, default_cfg):
     """Build the absl main() for a workload's train.py."""
     define_flags_from_config(default_cfg)
@@ -87,7 +103,7 @@ def eval_main(workload, default_cfg):
         cfg = _setup(workload, default_cfg)
         if not cfg.workdir:
             raise app.UsageError("--workdir is required for eval")
-        _, eval_fn = _iterators(workload, cfg)
+        eval_fn = _eval_iterator(workload, cfg)
         if eval_fn is None:
             raise app.UsageError(
                 f"workload {workload.__name__} defines no eval pipeline"

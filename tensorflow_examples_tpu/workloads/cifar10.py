@@ -78,5 +78,9 @@ def datasets(cfg: Cifar10Config):
     return load_cifar10(cfg.data_dir, "train"), load_cifar10(cfg.data_dir, "test")
 
 
+def eval_dataset(cfg: Cifar10Config):
+    return load_cifar10(cfg.data_dir, "test")
+
+
 def train_augment(cfg: Cifar10Config):
     return cifar_augment if cfg.augment else None

@@ -73,3 +73,7 @@ def make_task(cfg: MnistConfig) -> Task:
 
 def datasets(cfg: MnistConfig):
     return load_mnist(cfg.data_dir, "train"), load_mnist(cfg.data_dir, "test")
+
+
+def eval_dataset(cfg: MnistConfig):
+    return load_mnist(cfg.data_dir, "test")

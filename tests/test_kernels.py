@@ -1,0 +1,146 @@
+"""Pallas kernel numerics vs pure-XLA references (SURVEY.md §4).
+
+Runs the real kernel code in Pallas interpret mode on CPU; on TPU the
+same code path compiles via Mosaic (exercised by bench.py / examples).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_examples_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+)
+from tensorflow_examples_tpu.ops.cross_entropy import (
+    cross_entropy_loss,
+    cross_entropy_per_example,
+    cross_entropy_reference,
+)
+
+
+def _qkv(rng, shape, dtype):
+    ks = jax.random.split(rng, 3)
+    return [jax.random.normal(k, shape, dtype) for k in ks]
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("seq", [128, 256])
+    def test_forward_matches_reference(self, causal, seq):
+        q, k, v = _qkv(jax.random.PRNGKey(0), (2, 3, seq, 64), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, block_q=128, block_kv=128)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_forward_bf16(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1), (1, 2, 256, 64), jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref.astype(np.float32), atol=2e-2, rtol=2e-2
+        )
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradients_match_reference(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(2), (1, 2, 256, 64), jnp.float32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                a, b, atol=5e-4, rtol=5e-4, err_msg=f"d{name}"
+            )
+
+    def test_uneven_blocks(self):
+        # seq divisible by blocks but blocks differ; causal offsets exercise
+        # the loop-bound math.
+        q, k, v = _qkv(jax.random.PRNGKey(3), (1, 1, 256, 64), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_kv=128)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_lengths(self):
+        # seq_q != seq_kv: causal diagonal is bottom-right aligned, like
+        # the reference; exercises the offset loop-bound math.
+        rng = jax.random.PRNGKey(5)
+        q = jax.random.normal(rng, (1, 2, 128, 64))
+        k = jax.random.normal(jax.random.PRNGKey(6), (1, 2, 384, 64))
+        v = jax.random.normal(jax.random.PRNGKey(7), (1, 2, 384, 64))
+        for causal in (True, False):
+            out = flash_attention(q, k, v, causal=causal, block_q=64, block_kv=128)
+            ref = attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # Gradients through the offset path too.
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(attention_reference(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(
+                a, b, atol=5e-4, rtol=5e-4, err_msg=f"d{name}"
+            )
+
+    def test_jit_compatible(self):
+        q, k, v = _qkv(jax.random.PRNGKey(4), (2, 2, 128, 64), jnp.float32)
+        jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+        np.testing.assert_allclose(
+            jitted(q, k, v), flash_attention(q, k, v), atol=1e-6, rtol=1e-6
+        )
+
+
+class TestFusedCrossEntropy:
+    @pytest.mark.parametrize("vocab", [1000, 50257])
+    def test_forward_matches_reference(self, vocab):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (64, vocab), jnp.float32) * 3
+        labels = jax.random.randint(jax.random.PRNGKey(1), (64,), 0, vocab)
+        nll = cross_entropy_per_example(logits, labels, fused=True)
+        ref = cross_entropy_reference(logits, labels)
+        np.testing.assert_allclose(nll, ref, atol=1e-5, rtol=1e-5)
+
+    def test_gradient_matches_reference(self):
+        vocab = 4099  # not divisible by block_v: exercises padding mask
+        logits = jax.random.normal(jax.random.PRNGKey(2), (32, vocab))
+        labels = jax.random.randint(jax.random.PRNGKey(3), (32,), 0, vocab)
+
+        g_fused = jax.grad(
+            lambda l: jnp.mean(cross_entropy_per_example(l, labels, fused=True))
+        )(logits)
+        g_ref = jax.grad(
+            lambda l: jnp.mean(cross_entropy_reference(l, labels))
+        )(logits)
+        np.testing.assert_allclose(g_fused, g_ref, atol=1e-6, rtol=1e-5)
+
+    def test_loss_weighted_mean_masks_padding(self):
+        logits = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 512))
+        labels = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, 512)
+        weights = jnp.ones((2, 8)).at[:, -3:].set(0.0)
+        loss = cross_entropy_loss(logits, labels, weights, fused=True)
+        ref_rows = cross_entropy_reference(
+            logits.reshape(-1, 512), labels.reshape(-1)
+        ).reshape(2, 8)
+        expected = np.sum(np.asarray(ref_rows) * np.asarray(weights)) / np.sum(
+            np.asarray(weights)
+        )
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-6)
+
+    def test_bf16_logits(self):
+        logits = jax.random.normal(
+            jax.random.PRNGKey(6), (16, 1024), jnp.bfloat16
+        )
+        labels = jax.random.randint(jax.random.PRNGKey(7), (16,), 0, 1024)
+        nll = cross_entropy_per_example(logits, labels, fused=True)
+        ref = cross_entropy_reference(logits.astype(jnp.float32), labels)
+        np.testing.assert_allclose(nll, ref, atol=2e-2, rtol=2e-2)

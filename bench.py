@@ -1,11 +1,14 @@
 #!/usr/bin/env python
 """Framework benchmark — prints ONE JSON line for the driver.
 
-Metric (BASELINE.md): MNIST MLP step-time on one TPU chip. The reference
+Headline metric: GPT-2 124M training throughput (tokens/sec/chip) on one
+TPU chip — bf16 compute, Pallas flash attention, fused Pallas
+cross-entropy, whole step in one jitted XLA program. The reference
 published no numbers (BASELINE.json:published == {}), so vs_baseline is
 measured against the first bring-up value recorded in BASELINE.md (the
-regression floor): vs_baseline = floor_ms / measured_ms, >1.0 == faster
-than the floor.
+regression floor): vs_baseline = measured / floor, >1.0 == faster.
+
+Secondary benches (run with --bench=mnist): MNIST MLP step-time.
 """
 
 import json
@@ -13,12 +16,59 @@ import sys
 import time
 
 # First-measured regression floors (BASELINE.md "Measured baselines" table).
-FLOORS_MS = {
-    "mnist_mlp_step_time": 0.0702,
+FLOORS = {
+    "gpt2_124m_tokens_per_sec": 3224304.0,  # first bring-up, 2026-07-29
+    "mnist_mlp_step_time_ms": 0.0702,
 }
 
+BATCH = 8
+SEQ = 1024
 
-def bench_mnist_step(steps: int = 200, warmup: int = 20) -> dict:
+
+def bench_gpt2(steps: int = 30, warmup: int = 5) -> dict:
+    import jax
+
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import gpt2
+
+    cfg = gpt2.Gpt2Config(
+        global_batch_size=BATCH,
+        seq_len=SEQ,
+        dropout=0.0,
+        precision="bf16",
+        attention="flash",
+        fused_ce=True,
+        log_every=10**9,
+        checkpoint_every=0,
+        train_steps=10**6,  # schedule horizon only
+    )
+    trainer = Trainer(gpt2.make_task(cfg), cfg)
+    ds, _ = gpt2.datasets(cfg)
+    it = train_iterator(ds, cfg.global_batch_size, seed=0)
+    batches = [trainer._put_batch(next(it)) for _ in range(4)]
+
+    state = trainer.state
+    for i in range(warmup):
+        state, m = trainer._train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = trainer._train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tok_per_sec = steps * BATCH * SEQ / dt
+    return {
+        "metric": "gpt2_124m_tokens_per_sec",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tok_per_sec / FLOORS["gpt2_124m_tokens_per_sec"], 4),
+    }
+
+
+def bench_mnist(steps: int = 200, warmup: int = 20) -> dict:
     import jax
 
     from tensorflow_examples_tpu.data.memory import train_iterator
@@ -50,12 +100,16 @@ def bench_mnist_step(steps: int = 200, warmup: int = 20) -> dict:
         "metric": "mnist_mlp_step_time",
         "value": round(step_ms, 4),
         "unit": "ms/step",
-        "vs_baseline": round(FLOORS_MS["mnist_mlp_step_time"] / step_ms, 4),
+        "vs_baseline": round(FLOORS["mnist_mlp_step_time_ms"] / step_ms, 4),
     }
 
 
 def main():
-    result = bench_mnist_step()
+    which = "gpt2"
+    for a in sys.argv[1:]:
+        if a.startswith("--bench="):
+            which = a.split("=", 1)[1]
+    result = bench_gpt2() if which == "gpt2" else bench_mnist()
     print(json.dumps(result))
 
 

@@ -4,6 +4,11 @@
     python examples/cifar10/train.py --device=tpu [--train_steps=N ...]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
 from absl import app
 
 from tensorflow_examples_tpu.train.cli import train_main

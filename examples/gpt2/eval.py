@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""GPT-2 eval CLI: restore latest checkpoint → validation NLL.
+
+    python examples/gpt2/eval.py --device=tpu --workdir=/path/to/run
+
+Perplexity = exp(nll). For sampling, see generate.py in this directory.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import eval_main
+from tensorflow_examples_tpu.workloads import gpt2
+
+if __name__ == "__main__":
+    app.run(eval_main(gpt2, gpt2.Gpt2Config()))

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""GPT-2 sampling CLI (the reference's eval.py sampling path).
+
+    python examples/gpt2/generate.py --workdir=/path/to/run \
+        --num_tokens=64 --temperature=0.8 --top_k=40
+
+Decodes through the static-shape KV cache (models/transformer.py). With
+byte-level corpora (vocab_size=256) --prompt is interpreted as text;
+otherwise supply comma-separated token ids via --prompt_ids.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+from absl import app, flags
+
+from tensorflow_examples_tpu.models import transformer
+from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
+from tensorflow_examples_tpu.train.cli import _build_trainer, _setup
+from tensorflow_examples_tpu.train.config import define_flags_from_config
+from tensorflow_examples_tpu.workloads import gpt2
+
+define_flags_from_config(gpt2.Gpt2Config())
+flags.DEFINE_integer("num_tokens", 64, "tokens to sample")
+flags.DEFINE_float("temperature", 0.8, "0 = greedy")
+flags.DEFINE_integer("top_k", 40, "0 disables top-k filtering")
+flags.DEFINE_string("prompt", "The ", "text prompt (byte-level vocab)")
+flags.DEFINE_string("prompt_ids", "", "comma-separated token ids")
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    import jax
+
+    cfg = _setup(gpt2, gpt2.Gpt2Config())
+    if not cfg.workdir:
+        raise app.UsageError("--workdir is required for generate")
+    trainer = _build_trainer(gpt2, cfg)
+    restored = CheckpointManager(cfg.workdir).restore_latest(trainer.state)
+    if restored is None:
+        raise SystemExit(f"no checkpoint under {cfg.workdir}")
+    params = restored[0].params
+
+    if FLAGS.prompt_ids:
+        ids = [int(t) for t in FLAGS.prompt_ids.split(",")]
+    else:
+        ids = list(FLAGS.prompt.encode())
+    prompt = np.asarray([ids], np.int32)
+
+    model = transformer.Transformer(gpt2.model_config(cfg))
+    out = transformer.generate(
+        model,
+        params,
+        prompt,
+        num_tokens=FLAGS.num_tokens,
+        rng=jax.random.PRNGKey(cfg.seed),
+        temperature=FLAGS.temperature,
+        top_k=FLAGS.top_k,
+    )
+    toks = np.asarray(out[0])
+    print("token ids:", toks.tolist())
+    if cfg.vocab_size <= 256:
+        print(bytes(np.clip(toks, 0, 255).astype(np.uint8)).decode(errors="replace"))
+
+
+if __name__ == "__main__":
+    app.run(main)

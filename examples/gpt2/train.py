@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""GPT-2 124M causal-LM training CLI (BASELINE.json:configs[4]).
+
+Usage (contract preserved from the reference — BASELINE.json:north_star):
+    python examples/gpt2/train.py --device=tpu [--train_steps=N ...]
+
+Scale knobs (framework-native — SURVEY.md §2d):
+    --mesh_model=4            tensor parallelism over the `model` axis
+    --mesh_context=4 --attention=ring   ring-attention sequence parallelism
+    --mesh_fsdp=8             ZeRO-style parameter sharding
+    --remat --grad_accum_steps=K        memory relief for long context
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import train_main
+from tensorflow_examples_tpu.workloads import gpt2
+
+if __name__ == "__main__":
+    app.run(train_main(gpt2, gpt2.Gpt2Config()))

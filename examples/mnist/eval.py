@@ -4,6 +4,11 @@
     python examples/mnist/eval.py --device=tpu --workdir=/path/to/run
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
 from absl import app
 
 from tensorflow_examples_tpu.train.cli import eval_main

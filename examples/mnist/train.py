@@ -5,6 +5,11 @@ Usage (contract preserved from the reference — BASELINE.json:north_star):
     python examples/mnist/train.py --device=tpu [--train_steps=N ...]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
 from absl import app
 
 from tensorflow_examples_tpu.train.cli import train_main

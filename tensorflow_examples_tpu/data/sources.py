@@ -114,6 +114,59 @@ def load_cifar10(data_dir: str = "", split: str = "train") -> InMemoryDataset:
     )
 
 
+# ------------------------------------------------------------- LM corpora
+
+
+def load_lm_tokens(
+    data_dir: str = "",
+    split: str = "train",
+    *,
+    seq_len: int = 1024,
+    vocab_size: int = 50257,
+) -> InMemoryDataset:
+    """Token windows [n, seq_len+1] for causal-LM training.
+
+    Accepts the standard flat-token formats under ``data_dir``:
+    ``<split>.bin`` (uint16 memmap, the common GPT-2 prep format),
+    ``<split>.npy`` (any int dtype), or ``<split>.txt`` (byte-level,
+    vocab 256). Windows are non-overlapping; the +1 column provides the
+    shifted next-token labels. Without ``data_dir``: seeded synthetic
+    bigram streams (learnable, so tests can assert loss decreases).
+    """
+    if data_dir:
+        base = os.path.join(data_dir, split)
+        if os.path.exists(base + ".bin"):
+            flat = np.memmap(base + ".bin", dtype=np.uint16, mode="r")
+        elif os.path.exists(base + ".npy"):
+            flat = np.load(base + ".npy", mmap_mode="r")
+        elif os.path.exists(base + ".txt"):
+            with open(base + ".txt", "rb") as f:
+                flat = np.frombuffer(f.read(), dtype=np.uint8)
+        else:
+            raise FileNotFoundError(
+                f"--data_dir={data_dir} set but {split}.bin/.npy/.txt not "
+                "found there; omit --data_dir for synthetic data"
+            )
+        window = seq_len + 1
+        n = len(flat) // window
+        if n == 0:
+            raise ValueError(
+                f"corpus has {len(flat)} tokens < one window ({window})"
+            )
+        toks = np.asarray(flat[: n * window]).astype(np.int32).reshape(n, window)
+        if toks.max() >= vocab_size:
+            raise ValueError(
+                f"corpus token id {toks.max()} >= vocab_size {vocab_size}"
+            )
+        return InMemoryDataset({"tokens": toks})
+    return synthetic_tokens(
+        n=512 if split == "train" else 64,
+        seq_len=seq_len + 1,
+        vocab_size=vocab_size,
+        seed=4 if split == "train" else 5,
+    )
+
+
 # --------------------------------------------------------------- synthetic
 
 

@@ -7,3 +7,7 @@ the core rules tables each model exports.
 """
 
 from tensorflow_examples_tpu.models.mlp import MLP
+from tensorflow_examples_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+)

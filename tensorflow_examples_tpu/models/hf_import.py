@@ -1,0 +1,81 @@
+"""Pretrained-weight importers (HF → framework params).
+
+The reference's BERT/GPT-2 examples restored TF pretrained checkpoints
+(SURVEY.md §5d); the TPU-native replacement imports from HuggingFace
+``transformers`` (installed in-image) instead. Importers consume a live
+torch model or a local ``from_pretrained`` path — pure numpy reshapes,
+no torch code in the hot path — and produce the exact param pytree the
+flax models expect, ready for ``core.sharding.shard_params``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from tensorflow_examples_tpu.models.transformer import TransformerConfig
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+
+def import_gpt2(
+    hf_model_or_path: Any, cfg: TransformerConfig | None = None
+) -> tuple[TransformerConfig, Mapping]:
+    """Convert an HF ``GPT2LMHeadModel`` (or local path) to our params.
+
+    HF GPT-2 uses ``Conv1D`` layers whose weights are stored [in, out] —
+    the same layout as flax Dense kernels, so only head/stack reshapes
+    are needed (no transposes).
+    """
+    if isinstance(hf_model_or_path, str):
+        from transformers import GPT2LMHeadModel
+
+        hf_model_or_path = GPT2LMHeadModel.from_pretrained(hf_model_or_path)
+    sd = {k: _np(v) for k, v in hf_model_or_path.state_dict().items()}
+    hfc = hf_model_or_path.config
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=hfc.vocab_size,
+            max_len=hfc.n_positions,
+            num_layers=hfc.n_layer,
+            num_heads=hfc.n_head,
+            d_model=hfc.n_embd,
+        )
+    d, h, hd = cfg.d_model, cfg.num_heads, cfg.head_dim
+
+    def ln(prefix):
+        return {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]}
+
+    params: dict = {
+        "wte": {"embedding": sd["transformer.wte.weight"]},
+        "wpe": {"embedding": sd["transformer.wpe.weight"]},
+        "ln_f": ln("transformer.ln_f"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}"
+        params[f"h_{i}"] = {
+            "ln_1": ln(f"{p}.ln_1"),
+            "ln_2": ln(f"{p}.ln_2"),
+            "attn": {
+                "qkv": {
+                    "kernel": sd[f"{p}.attn.c_attn.weight"].reshape(d, 3, h, hd),
+                    "bias": sd[f"{p}.attn.c_attn.bias"].reshape(3, h, hd),
+                },
+                "proj": {
+                    "kernel": sd[f"{p}.attn.c_proj.weight"].reshape(h, hd, d),
+                    "bias": sd[f"{p}.attn.c_proj.bias"],
+                },
+            },
+            "mlp_fc": {
+                "kernel": sd[f"{p}.mlp.c_fc.weight"],
+                "bias": sd[f"{p}.mlp.c_fc.bias"],
+            },
+            "mlp_proj": {
+                "kernel": sd[f"{p}.mlp.c_proj.weight"],
+                "bias": sd[f"{p}.mlp.c_proj.bias"],
+            },
+        }
+    return cfg, params

@@ -1,0 +1,339 @@
+"""GPT-2 decoder-only transformer (BASELINE.json:configs[4]).
+
+Capability parity with the reference's GPT-2 124M example (12L/768H/12
+heads, vocab 50257, 1024 positions, tied embeddings, gelu_new, pre-LN),
+designed TPU-first rather than translated:
+
+- Attention runs through ``parallel.mesh_attention``: the Pallas flash
+  kernel on a single chip, ring/Ulysses context parallelism when the
+  mesh's ``context`` axis is real, all under one jitted step.
+- QKV/output projections are ``DenseGeneral`` over an explicit
+  [heads, head_dim] layout so tensor parallelism is a *sharding rule*
+  (heads over the ``model`` mesh axis — see ``GPT2_RULES``), not a
+  code path; XLA inserts the Megatron-style collectives.
+- Activation shardings are pinned with ``with_sharding_constraint`` at
+  the residual stream so the partitioner never wanders.
+- ``remat=True`` checkpoints each block (recompute in backward) — the
+  HBM/FLOPs trade that makes long-context training fit.
+- Decode mode keeps a KV cache (flax ``cache`` collection) with static
+  shapes: prefill writes the whole prompt in one call, then single-token
+  steps — both compile once per distinct query length.
+
+Weight layout matches HF ``GPT2LMHeadModel`` modulo reshapes so
+``models.hf_import`` can load pretrained checkpoints (the reference's
+BERT/GPT-2 pretrained-weight restore, SURVEY.md §5d).
+
+``train``/``decode`` are module *fields*, not call arguments: they are
+compile-time modes, and as fields they stay static under ``nn.remat``
+with no static_argnums bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflow_examples_tpu.core.mesh import AxisNames
+from tensorflow_examples_tpu.core.sharding import ShardingRules
+from tensorflow_examples_tpu.ops.attention import NEG_INF
+from tensorflow_examples_tpu.parallel.attention import mesh_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    max_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 → 4 * d_model
+    dropout: float = 0.1
+    attention: str = "flash"  # flash | xla | ring | ulysses
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+
+def gpt2_124m(**overrides) -> TransformerConfig:
+    return TransformerConfig(**overrides)
+
+
+# TP/FSDP rules (core.sharding table; axes of size 1 are dropped by the
+# mesh filter, so the same table serves pure-DP through full 4D meshes).
+_M, _F = AxisNames.MODEL, AxisNames.FSDP
+GPT2_RULES = ShardingRules(
+    [
+        (r"attn/qkv/kernel", P(_F, None, _M, None)),
+        (r"attn/qkv/bias", P(None, _M, None)),
+        (r"attn/proj/kernel", P(_M, None, _F)),
+        (r"mlp_fc/kernel", P(_F, _M)),
+        (r"mlp_fc/bias", P(_M)),
+        (r"mlp_proj/kernel", P(_M, _F)),
+        # Embeddings replicated: the tied head needs full-vocab logits for
+        # the fused CE kernel (vocab-sharded CE is a later optimization).
+    ]
+)
+
+
+def _shard(x, mesh: Mesh | None, *spec):
+    """Pin an activation's sharding when a mesh is provided."""
+    if mesh is None:
+        return x
+    from tensorflow_examples_tpu.core.sharding import named_sharding
+
+    return jax.lax.with_sharding_constraint(x, named_sharding(mesh, *spec))
+
+
+_BATCH = AxisNames.BATCH_AXES
+
+
+class Attention(nn.Module):
+    """Multi-head causal self-attention with optional KV-cache decode."""
+
+    cfg: TransformerConfig
+    mesh: Mesh | None
+    train: bool
+    decode: bool
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h, hd = cfg.num_heads, cfg.head_dim
+        qkv = nn.DenseGeneral(
+            features=(3, h, hd),
+            kernel_init=nn.initializers.normal(0.02),
+            dtype=x.dtype,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+
+        if self.decode:
+            out = self._decode_attend(q, k, v)
+        else:
+            # [B, S, H, D] → [B, H, S, D] for the kernel.
+            swap = lambda t: t.transpose(0, 2, 1, 3)
+            out = mesh_attention(
+                swap(q), swap(k), swap(v),
+                mesh=self.mesh, causal=True, impl=cfg.attention,
+            )
+            out = out.transpose(0, 2, 1, 3)
+
+        out = nn.DenseGeneral(
+            features=cfg.d_model,
+            axis=(-2, -1),
+            kernel_init=nn.initializers.normal(
+                0.02 / (2 * cfg.num_layers) ** 0.5
+            ),
+            dtype=x.dtype,
+            name="proj",
+        )(out)
+        return nn.Dropout(cfg.dropout, deterministic=not self.train)(out)
+
+    def _decode_attend(self, q, k, v):
+        """Append q_len new tokens to the cache and attend over it.
+
+        Static shapes: the cache is [B, max_len, H, D]; prefill calls pass
+        the whole prompt (q_len = prompt length), generation steps pass
+        q_len = 1 — each distinct q_len compiles once.
+        """
+        cfg = self.cfg
+        b, q_len = q.shape[:2]
+        ck = self.variable(
+            "cache", "key",
+            lambda: jnp.zeros((b, cfg.max_len) + k.shape[2:], k.dtype),
+        )
+        cv = self.variable(
+            "cache", "value",
+            lambda: jnp.zeros((b, cfg.max_len) + v.shape[2:], v.dtype),
+        )
+        idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        i0 = idx.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, i0, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, i0, 0, 0))
+        idx.value = i0 + q_len
+
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, ck.value,
+            preferred_element_type=jnp.float32,
+        ) * (cfg.head_dim ** -0.5)
+        # Row r (global position i0 + r) sees cache slots ≤ its position.
+        pos = i0 + jax.lax.broadcasted_iota(jnp.int32, (q_len, cfg.max_len), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (q_len, cfg.max_len), 1)
+        s = jnp.where(col <= pos, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    mesh: Mesh | None
+    train: bool
+    decode: bool
+
+    @nn.compact
+    def __call__(self, x):
+        cfg, mesh, decode = self.cfg, self.mesh, self.decode
+        ctx = None if decode else AxisNames.CONTEXT
+        y = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_1")(x)
+        y = Attention(cfg, mesh, self.train, decode, name="attn")(y)
+        x = _shard(x + y, mesh, _BATCH, ctx, None)
+        y = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_2")(x)
+        y = nn.Dense(
+            cfg.ff_dim,
+            kernel_init=nn.initializers.normal(0.02),
+            dtype=x.dtype,
+            name="mlp_fc",
+        )(y)
+        y = nn.gelu(y, approximate=True)
+        y = _shard(y, mesh, _BATCH, ctx, AxisNames.MODEL)
+        y = nn.Dense(
+            cfg.d_model,
+            kernel_init=nn.initializers.normal(
+                0.02 / (2 * cfg.num_layers) ** 0.5
+            ),
+            dtype=x.dtype,
+            name="mlp_proj",
+        )(y)
+        y = nn.Dropout(cfg.dropout, deterministic=not self.train)(y)
+        return _shard(x + y, mesh, _BATCH, ctx, None)
+
+
+class Transformer(nn.Module):
+    """GPT-2 style causal LM. ``__call__`` returns logits [B, S, vocab]."""
+
+    cfg: TransformerConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
+        cfg = self.cfg
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.02),
+            name="wte",
+        )
+        if decode:
+            # Global position rides a top-level cache var so positional
+            # embeddings line up with the per-layer KV cache index.
+            pos = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = pos.value + jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            pos.value = pos.value + tokens.shape[1]
+        else:
+            positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+        wpe = nn.Embed(
+            cfg.max_len, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.01),
+            name="wpe",
+        )
+        x = wte(tokens) + wpe(positions)[None]
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        ctx = None if decode else AxisNames.CONTEXT
+        x = _shard(x, self.mesh, _BATCH, ctx, None)
+
+        block = Block
+        if cfg.remat and not decode:
+            block = nn.remat(
+                Block,
+                prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(cfg.num_layers):
+            x = block(cfg, self.mesh, train, decode, name=f"h_{i}")(x)
+
+        x = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_f")(x)
+        # Tied LM head: logits = x @ wteᵀ (GPT-2 ties input/output embeds).
+        return wte.attend(x)
+
+
+def sharding_rules(extra: ShardingRules | None = None) -> ShardingRules:
+    return GPT2_RULES + extra if extra else GPT2_RULES
+
+
+# ---------------------------------------------------------------- decoding
+
+
+def init_cache(model: Transformer, batch_size: int):
+    """Allocate an empty KV cache (flax 'cache' collection).
+
+    Built from eval_shape + zeros rather than ``model.init``: a real init
+    call *runs* the decode step, which would advance the cache index past
+    the dummy token.
+    """
+    tokens = jnp.zeros((batch_size, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)}, tokens, decode=True)
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+    )
+
+
+def generate(
+    model: Transformer,
+    params,
+    prompt: jax.Array,
+    *,
+    num_tokens: int,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Sample ``num_tokens`` continuations of ``prompt`` [B, L] (greedy if
+    temperature == 0). Prefill is one call; then a ``lax.scan`` of
+    single-token steps over the static-shape cache. Returns [B, L+N]."""
+    b, prompt_len = prompt.shape
+    if prompt_len + num_tokens > model.cfg.max_len:
+        # Past max_len the cache update index clamps and wpe runs out of
+        # rows — silently corrupt output, so reject up front.
+        raise ValueError(
+            f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
+            f"max_len ({model.cfg.max_len})"
+        )
+    cache = init_cache(model, b)
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache}, prompt, decode=True,
+        mutable=["cache"],
+    )
+    cache = vars_out["cache"]
+
+    def sample(logits, rng):
+        logits = logits.astype(jnp.float32)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, NEG_INF, logits)
+        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+    rng, sub = jax.random.split(rng)
+    first = sample(logits[:, -1], sub)
+    if num_tokens == 1:
+        return jnp.concatenate([prompt, first[:, None]], axis=1)
+
+    def step(carry, rng_t):
+        cache, tok = carry
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache}, tok[:, None], decode=True,
+            mutable=["cache"],
+        )
+        nxt = sample(logits[:, -1], rng_t)
+        return (vars_out["cache"], nxt), tok
+
+    (_, last), toks = jax.lax.scan(
+        step, (cache, first), jax.random.split(rng, num_tokens - 1)
+    )
+    gen = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
+    return jnp.concatenate([prompt, gen], axis=1)

@@ -1,0 +1,18 @@
+"""Parallelism layer: collective attention + mesh-aware dispatch.
+
+The reference's distribution story was per-example ``tf.distribute``
+strategies over NCCL (SURVEY.md §2d). Here parallelism is mesh-native:
+sharding rules (core.sharding) cover DP/FSDP/TP for the dense math, and
+this package supplies the pieces XLA cannot derive automatically —
+sequence/context parallelism for attention (ring via ``ppermute``,
+Ulysses via ``all_to_all``) and the ``shard_map`` wrapper that runs the
+Pallas flash kernel on mesh-sharded operands.
+"""
+
+from tensorflow_examples_tpu.parallel.ring import (
+    ring_attention,
+    ulysses_attention,
+)
+from tensorflow_examples_tpu.parallel.attention import mesh_attention
+
+__all__ = ["ring_attention", "ulysses_attention", "mesh_attention"]

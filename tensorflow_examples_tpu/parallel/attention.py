@@ -1,0 +1,77 @@
+"""Mesh-aware attention dispatch: the jit ↔ shard_map bridge.
+
+XLA auto-partitions dense math from sharding annotations, but a Pallas
+kernel is opaque to the SPMD partitioner — calling it under jit with
+sharded operands would force an all-gather. ``mesh_attention`` closes the
+gap: it wraps the flash kernel (or the ring/Ulysses collectives when the
+``context`` axis is real) in ``shard_map`` with the framework's canonical
+specs, so batch rides (data, fsdp), heads ride ``model``, and sequence
+rides ``context`` — each device runs the kernel on exactly its shard.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflow_examples_tpu.core.mesh import AxisNames
+from tensorflow_examples_tpu.ops.attention import dot_product_attention
+from tensorflow_examples_tpu.parallel.ring import ring_attention, ulysses_attention
+
+
+def attention_spec(mesh: Mesh) -> P:
+    """PartitionSpec for [batch, heads, seq, head_dim] on the mesh."""
+    batch = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
+    model = AxisNames.MODEL if mesh.shape[AxisNames.MODEL] > 1 else None
+    ctx = AxisNames.CONTEXT if mesh.shape[AxisNames.CONTEXT] > 1 else None
+    return P(batch if batch else None, model, ctx, None)
+
+
+def mesh_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh | None,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    impl: str = "flash",  # flash | xla | ring | ulysses
+) -> jax.Array:
+    """Attention on [B, H, S, D] operands laid out on ``mesh``.
+
+    With no mesh (or a trivial one) this is the plain single-device
+    dispatcher; otherwise a shard_map over the canonical spec. ``ring`` /
+    ``ulysses`` select the context-parallel algorithm when
+    mesh.context > 1 (``flash`` defaults to ring in that case).
+    """
+    if impl == "xla":
+        return dot_product_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, use_flash=False
+        )
+    if mesh is None or all(mesh.shape[a] == 1 for a in AxisNames.ALL):
+        return dot_product_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    has_context = mesh.shape[AxisNames.CONTEXT] > 1
+    if has_context and impl == "ulysses":
+        local = functools.partial(
+            ulysses_attention,
+            axis_name=AxisNames.CONTEXT, causal=causal, sm_scale=sm_scale,
+        )
+    elif has_context:
+        local = functools.partial(
+            ring_attention,
+            axis_name=AxisNames.CONTEXT, causal=causal, sm_scale=sm_scale,
+        )
+    else:
+        local = functools.partial(
+            dot_product_attention, causal=causal, sm_scale=sm_scale
+        )
+    spec = attention_spec(mesh)
+    # check_vma=False: the Pallas kernel's out_shape carries no
+    # varying-axes type, which the vma checker (jax 0.9) rejects.
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

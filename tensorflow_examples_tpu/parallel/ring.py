@@ -1,0 +1,163 @@
+"""Sequence/context-parallel attention collectives (SURVEY.md §5g).
+
+The reference has no long-context machinery (a TF-examples repo predates
+it); these are framework-native extensions required by the task template,
+designed TPU-first:
+
+- ``ring_attention``: blockwise attention over a ``context`` mesh axis.
+  Each device holds a sequence shard of Q/K/V; K/V shards rotate around
+  the ring with ``jax.lax.ppermute`` (nearest-neighbor ICI traffic, no
+  all-gather), while an online softmax merges each arriving block into
+  f32 running (max, sum, acc) — the same math as the Pallas flash kernel
+  (ops/attention.py), lifted one level up so the "blocks" arrive over ICI
+  instead of from VMEM. Memory per device is O(S/c · d), never O(S²).
+- ``ulysses_attention``: the all-to-all alternative — reshard from
+  sequence-sharded to head-sharded with ``all_to_all``, run the local
+  flash kernel on full sequences for H/c heads, reshard back. Two
+  all-to-alls per call, but the inner loop is the single-device Pallas
+  kernel at full efficiency; preferable when heads ≥ ring size.
+
+Both run inside ``shard_map`` (see parallel/attention.py for the jit-level
+wrapper) and differentiate through the collectives (ppermute/all_to_all
+transpose to themselves under AD).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tensorflow_examples_tpu.ops.attention import NEG_INF, flash_attention
+
+_STABLE_MIN = -0.7 * NEG_INF  # guard value well inside f32 range
+
+
+def _block_attend(q, k, v, mask, sm_scale):
+    """One KV block's (scores→masked→exp) contribution, f32.
+
+    q: [B,H,Sq,D], k/v: [B,H,Sk,D], mask: broadcastable [Sq,Sk] bool.
+    Returns (m, l, acc) partials for online-softmax merging.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    s = jnp.where(mask, s, NEG_INF)
+    # Block-local max; clamp so fully-masked rows stay finite.
+    m = jnp.maximum(jnp.max(s, axis=-1), -_STABLE_MIN)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m, l, acc
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "context",
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Context-parallel attention; call inside ``shard_map``.
+
+    q, k, v: [batch, heads, seq_shard, head_dim] — the local sequence
+    shard on this device. Sharding along ``axis_name`` is assumed to be
+    contiguous ascending (shard i holds tokens [i·s, (i+1)·s)), which is
+    what ``NamedSharding(P(..., 'context', ...))`` produces.
+    """
+    axis_size = lax.axis_size(axis_name)
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if axis_size == 1:
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    my_idx = lax.axis_index(axis_name)
+    s_loc = q.shape[2]
+    qf = q.astype(jnp.float32)
+    row = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    col = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def merge(carry, step, k_blk, v_blk):
+        m, l, acc = carry
+        # After `step` rotations this device holds KV shard (my_idx - step).
+        kv_idx = (my_idx - step) % axis_size
+        if causal:
+            # Global causality between shard indices: earlier KV shard →
+            # fully visible; same shard → triangular; later → fully masked.
+            mask = (kv_idx < my_idx) | ((kv_idx == my_idx) & (row >= col))
+        else:
+            mask = jnp.ones((s_loc, s_loc), bool)
+        bm, bl, bacc = _block_attend(qf, k_blk, v_blk, mask, sm_scale)
+        m_new = jnp.maximum(m, bm)
+        a_old = jnp.exp(m - m_new)
+        a_blk = jnp.exp(bm - m_new)
+        l_new = l * a_old + bl * a_blk
+        acc_new = acc * a_old[..., None] + bacc * a_blk[..., None]
+        return m_new, l_new, acc_new
+
+    def body(carry, step):
+        m, l, acc, k_blk, v_blk = carry
+        m, l, acc = merge((m, l, acc), step, k_blk, v_blk)
+        # Rotate KV one hop around the ring (nearest-neighbor ICI).
+        k_nxt, v_nxt = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    # Initial carries derived from q (not fresh zeros) so they inherit
+    # q's varying-axes type under shard_map; XLA folds the dead arithmetic.
+    acc0 = jnp.zeros_like(qf)
+    m0 = acc0[..., 0] - _STABLE_MIN
+    l0 = acc0[..., 0]
+    # Remat the body: recompute each block's scores in backward instead of
+    # saving c × [s_loc, s_loc] score matrices. The final block merges
+    # outside the scan so its KV shard is not pointlessly rotated onward
+    # (saves 1/c of all ring traffic).
+    (m, l, acc, k_last, v_last), _ = lax.scan(
+        jax.checkpoint(body), (m0, l0, acc0, k, v), jnp.arange(axis_size - 1)
+    )
+    m, l, acc = jax.checkpoint(merge)(
+        (m, l, acc), axis_size - 1, k_last, v_last
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "context",
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """All-to-all sequence parallelism; call inside ``shard_map``.
+
+    q, k, v: [batch, heads, seq_shard, head_dim]. Requires
+    heads % axis_size == 0. Reshards seq→heads, runs the local Pallas
+    flash kernel over the full sequence, reshards back.
+    """
+    axis_size = lax.axis_size(axis_name)
+    if axis_size == 1:
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    h = q.shape[1]
+    if h % axis_size:
+        raise ValueError(f"heads {h} not divisible by context axis {axis_size}")
+
+    # [B, H, s, D] → [B, H/c, S, D]: gather seq, scatter heads.
+    to_seq = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2,
+        tiled=True,
+    )
+    ql, kl, vl = to_seq(q), to_seq(k), to_seq(v)
+    out = flash_attention(ql, kl, vl, causal=causal, sm_scale=sm_scale)
+    return lax.all_to_all(
+        out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )

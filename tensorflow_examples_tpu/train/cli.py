@@ -18,9 +18,12 @@ A workload module plugs in via a small protocol:
 
 from __future__ import annotations
 
+import inspect
+
 from absl import app, logging
 
 from tensorflow_examples_tpu.core import distributed
+from tensorflow_examples_tpu.core.mesh import create_mesh
 from tensorflow_examples_tpu.data.memory import eval_batches, train_iterator
 from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
 from tensorflow_examples_tpu.train.config import (
@@ -37,6 +40,18 @@ def _setup(workload, default_cfg):
     apply_device_flag(cfg.device)
     distributed.initialize()
     return cfg
+
+
+def _build_trainer(workload, cfg):
+    """Create (mesh, task, Trainer); passes the mesh to ``make_task`` when
+    the workload accepts it (models that pin activation shardings or run
+    shard_map'd attention need the concrete mesh at trace time)."""
+    mesh = create_mesh(cfg.mesh_config())
+    if "mesh" in inspect.signature(workload.make_task).parameters:
+        task = workload.make_task(cfg, mesh=mesh)
+    else:
+        task = workload.make_task(cfg)
+    return Trainer(task, cfg, mesh=mesh)
 
 
 def _iterators(workload, cfg):
@@ -87,7 +102,7 @@ def train_main(workload, default_cfg):
         del argv
         cfg = _setup(workload, default_cfg)
         train_fn, eval_fn = _iterators(workload, cfg)
-        trainer = Trainer(workload.make_task(cfg), cfg)
+        trainer = _build_trainer(workload, cfg)
         metrics = trainer.fit(train_fn, eval_iter_fn=eval_fn)
         print({k: round(v, 4) for k, v in metrics.items()})
 
@@ -108,7 +123,7 @@ def eval_main(workload, default_cfg):
             raise app.UsageError(
                 f"workload {workload.__name__} defines no eval pipeline"
             )
-        trainer = Trainer(workload.make_task(cfg), cfg)
+        trainer = _build_trainer(workload, cfg)
         restored = CheckpointManager(cfg.workdir).restore_latest(trainer.state)
         if restored is None:
             raise SystemExit(f"no checkpoint under {cfg.workdir}")

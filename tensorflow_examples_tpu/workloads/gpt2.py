@@ -1,0 +1,153 @@
+"""GPT-2 124M causal-LM workload (BASELINE.json:configs[4]).
+
+Reference behavior: ``tf.function(jit_compile=True)`` train step, XLA,
+grad accumulation, 16-chip scale, sampling in eval. Here the whole step
+is one jitted XLA program by construction; scale comes from the 4-axis
+mesh (dp via batch sharding, tp via GPT2_RULES over ``model``, sp via
+ring/Ulysses attention over ``context``, fsdp via ZeRO-style param
+sharding) instead of per-example strategy code. The LM loss runs the
+fused Pallas cross-entropy (ops/cross_entropy.py) so the [tokens, 50257]
+log-softmax never materializes in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from tensorflow_examples_tpu.data.sources import load_lm_tokens
+from tensorflow_examples_tpu.models import transformer
+from tensorflow_examples_tpu.ops.cross_entropy import cross_entropy_per_example
+from tensorflow_examples_tpu.ops.losses import weighted_mean
+from tensorflow_examples_tpu.train import Task, TrainConfig
+from tensorflow_examples_tpu.train import optimizers
+
+
+@dataclasses.dataclass
+class Gpt2Config(TrainConfig):
+    # GPT-2 124M pretraining recipe (AdamW b2=0.95, warmup-cosine 6e-4,
+    # wd 0.1, clip 1.0, bf16 compute).
+    vocab_size: int = 50257
+    seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    dropout: float = 0.1
+    attention: str = "flash"  # flash | xla | ring | ulysses
+    fused_ce: bool = True
+    pretrained: str = ""  # local HF GPT2LMHeadModel path to start from
+
+    global_batch_size: int = 16
+    train_steps: int = 20000
+    warmup_steps: int = 2000
+    learning_rate: float = 6e-4
+    weight_decay: float = 0.1
+    grad_clip_norm: float = 1.0
+    eval_every: int = 2000
+    checkpoint_every: int = 2000
+    log_every: int = 50
+
+
+def model_config(cfg: Gpt2Config) -> transformer.TransformerConfig:
+    return transformer.TransformerConfig(
+        vocab_size=cfg.vocab_size,
+        max_len=cfg.seq_len,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        d_model=cfg.d_model,
+        dropout=cfg.dropout,
+        attention=cfg.attention,
+        remat=cfg.remat,
+    )
+
+
+def make_task(cfg: Gpt2Config, mesh=None) -> Task:
+    model = transformer.Transformer(model_config(cfg), mesh=mesh)
+
+    def init_fn(rng):
+        import math
+
+        import jax
+
+        from tensorflow_examples_tpu.core.mesh import AxisNames
+
+        # Dummy batch must be shardable over the mesh's batch axes (the
+        # shard_map'd attention path sees it at init time).
+        nb = (
+            math.prod(mesh.shape[a] for a in AxisNames.BATCH_AXES)
+            if mesh is not None
+            else 1
+        )
+        dummy = jnp.zeros((nb, cfg.seq_len), jnp.int32)
+        variables = dict(model.init({"params": rng}, dummy))
+        if cfg.pretrained:
+            from tensorflow_examples_tpu.models.hf_import import import_gpt2
+
+            _, params = import_gpt2(cfg.pretrained, model_config(cfg))
+            variables["params"] = jax.tree.map(jnp.asarray, params)
+        return variables
+
+    def token_nll(params, batch, *, rng, train):
+        inputs = batch["tokens"][:, :-1]
+        labels = batch["tokens"][:, 1:]
+        logits = model.apply(
+            {"params": params},
+            inputs,
+            train=train,
+            rngs={"dropout": rng} if train else None,
+        )
+        nll = cross_entropy_per_example(
+            logits.reshape(-1, cfg.vocab_size),
+            labels.reshape(-1),
+            fused=cfg.fused_ce,
+        )
+        return nll.reshape(labels.shape)
+
+    def loss_fn(params, model_state, batch, *, rng, train):
+        nll = token_nll(params, batch, rng=rng, train=train)
+        return jnp.mean(nll), {}, model_state
+
+    def eval_fn(params, model_state, batch):
+        nll = token_nll(params, batch, rng=None, train=False)
+        per_example = jnp.mean(nll, axis=-1)
+        mask = batch.get("mask")
+        return {
+            "nll": weighted_mean(per_example, mask),
+            "weight": jnp.sum(mask) if mask is not None else jnp.float32(
+                per_example.shape[0]
+            ),
+        }
+
+    return Task(
+        name="gpt2_124m",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_optimizer=optimizers.adamw_cosine,
+        sharding_rules=transformer.GPT2_RULES,
+        eval_fn=eval_fn,
+    )
+
+
+def datasets(cfg: Gpt2Config):
+    return (
+        load_lm_tokens(
+            cfg.data_dir, "train", seq_len=cfg.seq_len, vocab_size=cfg.vocab_size
+        ),
+        eval_dataset(cfg),
+    )
+
+
+def eval_dataset(cfg: Gpt2Config):
+    import os
+
+    has_val = bool(cfg.data_dir) and any(
+        os.path.exists(os.path.join(cfg.data_dir, "val" + ext))
+        for ext in (".bin", ".npy", ".txt")
+    )
+    return load_lm_tokens(
+        cfg.data_dir if has_val else "",
+        "val",
+        seq_len=cfg.seq_len,
+        vocab_size=cfg.vocab_size,
+    )

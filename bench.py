@@ -104,13 +104,17 @@ def bench_mnist(steps: int = 200, warmup: int = 20) -> dict:
     }
 
 
+BENCHES = {"gpt2": lambda: bench_gpt2(), "mnist": lambda: bench_mnist()}
+
+
 def main():
     which = "gpt2"
     for a in sys.argv[1:]:
         if a.startswith("--bench="):
             which = a.split("=", 1)[1]
-    result = bench_gpt2() if which == "gpt2" else bench_mnist()
-    print(json.dumps(result))
+    if which not in BENCHES:
+        raise SystemExit(f"unknown --bench={which}; one of {sorted(BENCHES)}")
+    print(json.dumps(BENCHES[which]()))
 
 
 if __name__ == "__main__":

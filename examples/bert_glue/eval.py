@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""BERT GLUE eval CLI: restore checkpoint → per-task metrics
+(accuracy; +F1 for MRPC/QQP, MCC for CoLA, Pearson for STS-B).
+
+    python examples/bert_glue/eval.py --device=tpu --task=sst2 --workdir=/path/to/run
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import eval_main
+from tensorflow_examples_tpu.workloads import bert_glue
+
+if __name__ == "__main__":
+    app.run(eval_main(bert_glue, bert_glue.BertGlueConfig()))

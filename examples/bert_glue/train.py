@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""BERT-base GLUE fine-tune CLI (BASELINE.json:configs[3]).
+
+Usage (contract preserved from the reference — BASELINE.json:north_star):
+    python examples/bert_glue/train.py --device=tpu --task=sst2 \
+        --pretrained=/models/bert-base-uncased [--data_dir=...]
+
+--data_dir expects pre-tokenized <task>_<split>.npz (see
+data/sources.load_glue); omit for synthetic data. Multi-host runs use the
+same command per host (core/distributed.py bootstraps from TPU metadata).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import train_main
+from tensorflow_examples_tpu.workloads import bert_glue
+
+if __name__ == "__main__":
+    app.run(train_main(bert_glue, bert_glue.BertGlueConfig()))

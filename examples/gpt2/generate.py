@@ -19,8 +19,9 @@ from absl import app, flags
 
 from tensorflow_examples_tpu.models import transformer
 from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
-from tensorflow_examples_tpu.train.cli import _build_trainer, _setup
+from tensorflow_examples_tpu.train.cli import _setup
 from tensorflow_examples_tpu.train.config import define_flags_from_config
+from tensorflow_examples_tpu.train.loop import state_factory
 from tensorflow_examples_tpu.workloads import gpt2
 
 define_flags_from_config(gpt2.Gpt2Config())
@@ -35,15 +36,19 @@ FLAGS = flags.FLAGS
 def main(argv):
     del argv
     import jax
+    import jax.numpy as jnp
 
     cfg = _setup(gpt2, gpt2.Gpt2Config())
     if not cfg.workdir:
         raise app.UsageError("--workdir is required for generate")
-    trainer = _build_trainer(gpt2, cfg)
-    restored = CheckpointManager(cfg.workdir).restore_latest(trainer.state)
+    # Restore through an eval_shape template: no throwaway random params
+    # or optimizer state ever materialize on the chip.
+    make_state, _ = state_factory(gpt2.make_task(cfg), cfg)
+    abstract = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+    restored = CheckpointManager(cfg.workdir).restore_latest(abstract)
     if restored is None:
         raise SystemExit(f"no checkpoint under {cfg.workdir}")
-    params = restored[0].params
+    params = jax.tree.map(jnp.asarray, restored[0].params)
 
     if FLAGS.prompt_ids:
         ids = [int(t) for t in FLAGS.prompt_ids.split(",")]

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""ResNet-50 ImageNet eval CLI: restore checkpoint → top-1/top-5.
+
+    python examples/resnet50/eval.py --device=tpu --workdir=/path/to/run \
+        --data_dir=/data/imagenet
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import eval_main
+from tensorflow_examples_tpu.workloads import imagenet
+
+if __name__ == "__main__":
+    app.run(eval_main(imagenet, imagenet.ImagenetConfig()))

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""ResNet-50 ImageNet training CLI (BASELINE.json:configs[2]).
+
+Usage (contract preserved from the reference — BASELINE.json:north_star):
+    python examples/resnet50/train.py --device=tpu \
+        --data_dir=/data/imagenet [--global_batch_size=1024 ...]
+
+--data_dir expects standard ImageNet TFRecord shards (train-*,
+validation-*); omit it for a synthetic smoke stream. Large-batch runs:
+--optimizer=lars.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from absl import app
+
+from tensorflow_examples_tpu.train.cli import train_main
+from tensorflow_examples_tpu.workloads import imagenet
+
+if __name__ == "__main__":
+    app.run(train_main(imagenet, imagenet.ImagenetConfig()))

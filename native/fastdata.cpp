@@ -1,0 +1,120 @@
+// Threaded host-side input-pipeline kernels (ctypes ABI).
+//
+// TPU-native replacement for the role the reference's C++ tf.data runtime
+// played (SURVEY.md §2c): the augmentation/normalization inner loops that
+// sit on the host CPU between storage and the device transfer. Python
+// (numpy) drives determinism — all randomness (crop offsets, flip flags)
+// is decided by the caller's seeded Generator and passed in — while the
+// byte-crunching runs here, multithreaded, without the GIL.
+//
+// Exposed C ABI (see tensorflow_examples_tpu/native/__init__.py):
+//   crop_flip_normalize_u8 : uint8 NHWC batch -> cropped/flipped/
+//                            normalized float32 batch
+//   normalize_u8           : uint8 NHWC batch -> normalized float32 batch
+//
+// Build: make -C native (g++ -O3 -shared; no external dependencies).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(i) for i in [0, n) over a small thread pool.
+void parallel_for(int64_t n, int threads, void (*fn)(int64_t, void*), void* ctx) {
+  if (threads <= 1 || n <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i, ctx);
+    return;
+  }
+  std::vector<std::thread> pool;
+  std::int64_t chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk, hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) fn(i, ctx);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+struct CropCtx {
+  const uint8_t* in;
+  float* out;
+  const int32_t* ys;      // [b] crop row offsets (into padded coords)
+  const int32_t* xs;      // [b] crop col offsets
+  const uint8_t* flips;   // [b] horizontal-flip flags
+  const float* mean;      // [c]
+  const float* inv_std;   // [c]
+  int64_t in_h, in_w, out_h, out_w, ch, pad;
+};
+
+// One example: reflect-pad by ctx.pad, crop out_h×out_w at (ys, xs),
+// optional h-flip, then (x/255 - mean) * inv_std.
+void crop_one(int64_t b, void* vctx) {
+  const CropCtx& c = *static_cast<CropCtx*>(vctx);
+  const uint8_t* src = c.in + b * c.in_h * c.in_w * c.ch;
+  float* dst = c.out + b * c.out_h * c.out_w * c.ch;
+  const bool flip = c.flips[b] != 0;
+  for (int64_t oy = 0; oy < c.out_h; ++oy) {
+    int64_t iy = oy + c.ys[b] - c.pad;  // padded coords -> source coords
+    if (iy < 0) iy = -iy;               // reflect
+    if (iy >= c.in_h) iy = 2 * c.in_h - 2 - iy;
+    for (int64_t ox = 0; ox < c.out_w; ++ox) {
+      int64_t ox_src = flip ? (c.out_w - 1 - ox) : ox;
+      int64_t ix = ox_src + c.xs[b] - c.pad;
+      if (ix < 0) ix = -ix;
+      if (ix >= c.in_w) ix = 2 * c.in_w - 2 - ix;
+      const uint8_t* px = src + (iy * c.in_w + ix) * c.ch;
+      float* q = dst + (oy * c.out_w + ox) * c.ch;
+      for (int64_t k = 0; k < c.ch; ++k) {
+        q[k] = (px[k] * (1.0f / 255.0f) - c.mean[k]) * c.inv_std[k];
+      }
+    }
+  }
+}
+
+struct NormCtx {
+  const uint8_t* in;
+  float* out;
+  const float* mean;
+  const float* inv_std;
+  int64_t hw, ch;
+};
+
+void norm_one(int64_t b, void* vctx) {
+  const NormCtx& c = *static_cast<NormCtx*>(vctx);
+  const uint8_t* src = c.in + b * c.hw * c.ch;
+  float* dst = c.out + b * c.hw * c.ch;
+  for (int64_t i = 0; i < c.hw; ++i) {
+    for (int64_t k = 0; k < c.ch; ++k) {
+      dst[i * c.ch + k] =
+          (src[i * c.ch + k] * (1.0f / 255.0f) - c.mean[k]) * c.inv_std[k];
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void crop_flip_normalize_u8(const uint8_t* in, float* out, const int32_t* ys,
+                            const int32_t* xs, const uint8_t* flips,
+                            const float* mean, const float* inv_std,
+                            int64_t batch, int64_t in_h, int64_t in_w,
+                            int64_t out_h, int64_t out_w, int64_t ch,
+                            int64_t pad, int64_t threads) {
+  CropCtx ctx{in, out, ys, xs, flips, mean, inv_std,
+              in_h, in_w, out_h, out_w, ch, pad};
+  parallel_for(batch, static_cast<int>(threads), crop_one, &ctx);
+}
+
+void normalize_u8(const uint8_t* in, float* out, const float* mean,
+                  const float* inv_std, int64_t batch, int64_t hw, int64_t ch,
+                  int64_t threads) {
+  NormCtx ctx{in, out, mean, inv_std, hw, ch};
+  parallel_for(batch, static_cast<int>(threads), norm_one, &ctx);
+}
+
+}  // extern "C"

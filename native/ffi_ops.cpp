@@ -1,0 +1,72 @@
+// XLA custom-call ops in C++ via the XLA FFI (SURVEY.md §2c obligation:
+// "the XLA custom-call C++ scaffold for any op Pallas can't express").
+//
+// On TPU the idiomatic kernel path is Pallas (ops/attention.py,
+// ops/cross_entropy.py); XLA:TPU does not accept user custom-calls the
+// way XLA:CPU/GPU do. This scaffold therefore targets the CPU backend —
+// it is the framework's mechanism for host-side compiled ops and the
+// template to extend if an op ever needs to escape both XLA fusion and
+// Pallas. Registered op:
+//
+//   fused_cross_entropy_fwd : f32[n, v] logits, s32[n] labels
+//                             -> (f32[n] nll, f32[n] lse)
+//   (single pass, online logsumexp — the CPU analogue of the Pallas
+//    kernel in ops/cross_entropy.py, shares its unit tests)
+//
+// Build: make -C native (uses jax.ffi.include_dir() headers, no jaxlib
+// link dependency — the FFI is header-only).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error FusedCrossEntropyFwd(
+    ffi::Buffer<ffi::F32> logits, ffi::Buffer<ffi::S32> labels,
+    ffi::ResultBuffer<ffi::F32> nll, ffi::ResultBuffer<ffi::F32> lse) {
+  auto dims = logits.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("logits must be rank 2");
+  }
+  const int64_t n = dims[0], v = dims[1];
+  const float* x = logits.typed_data();
+  const int32_t* y = labels.typed_data();
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = x + i * v;
+    // Online logsumexp: one pass, no [v] scratch.
+    float m = -INFINITY, s = 0.0f;
+    for (int64_t j = 0; j < v; ++j) {
+      float z = row[j];
+      if (z > m) {
+        s = s * std::exp(m - z) + 1.0f;
+        m = z;
+      } else {
+        s += std::exp(z - m);
+      }
+    }
+    float l = m + std::log(s);
+    lse->typed_data()[i] = l;
+    int64_t label = std::min<int64_t>(std::max<int64_t>(y[i], 0), v - 1);
+    nll->typed_data()[i] = l - row[label];
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    kFusedCrossEntropyFwd, FusedCrossEntropyFwd,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+extern "C" {
+// Looked up via ctypes and handed to jax.ffi.register_ffi_target through
+// a PyCapsule (tensorflow_examples_tpu/native/__init__.py).
+void* fused_cross_entropy_fwd_handler() {
+  return reinterpret_cast<void*>(kFusedCrossEntropyFwd);
+}
+}

@@ -1,0 +1,214 @@
+"""ImageNet input pipeline (SURVEY.md §3(4) — the perf-critical one).
+
+Reference shape: ``TFRecordDataset(shards) → shuffle → map(decode_jpeg +
+augment, parallel) → batch → prefetch(device)`` on host CPU threads
+overlapped with the device step. Here the same stages run through
+``tf.data`` **as a host-side reader only** (TF never touches the TPU;
+batches cross into JAX as numpy), feeding the shared loop's async
+device-prefetch queue (data/prefetch.py) which replaces
+``experimental_distribute_dataset`` + device prefetch:
+
+- standard ImageNet TFRecord schema (``image/encoded``,
+  ``image/class/label``) with the classic ResNet augmentation:
+  sample_distorted_bounding_box crop → resize 224 → random flip for
+  train; 87.5% central crop for eval.
+- per-host sharding by ``jax.process_index`` (the multi-worker
+  ``dataset.shard(num_workers, index)`` equivalent, SURVEY.md §3(5)).
+- without ``data_dir``: a seeded synthetic stream with label-correlated
+  low-rank image structure — learnable, so integration tests assert
+  actual training, with O(classes·size) memory instead of materializing
+  full images.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+MEAN_RGB = np.array([0.485, 0.456, 0.406], np.float32)
+STDDEV_RGB = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+# --------------------------------------------------------------- synthetic
+
+
+class SyntheticImageNet:
+    """Streaming label-correlated synthetic images.
+
+    Image for class c = outer(u_c, v_c) pattern + noise; u, v are seeded
+    per class, so storage is O(classes · size), not O(n · size²)."""
+
+    def __init__(self, *, image_size=224, num_classes=1000, seed=0):
+        rng = np.random.default_rng(seed)
+        self.u = rng.normal(0, 1, (num_classes, image_size)).astype(np.float32)
+        self.v = rng.normal(0, 1, (num_classes, image_size)).astype(np.float32)
+        self.phase = rng.normal(0, 1, (num_classes, 3)).astype(np.float32)
+        self.num_classes = num_classes
+        self.image_size = image_size
+
+    def batch(self, batch_size: int, rng: np.random.Generator):
+        y = rng.integers(0, self.num_classes, batch_size).astype(np.int32)
+        base = np.einsum("bh,bw->bhw", self.u[y], self.v[y])
+        img = base[..., None] * self.phase[y][:, None, None, :]
+        img += rng.normal(0, 2.0, img.shape).astype(np.float32)
+        return {"image": img.astype(np.float32), "label": y}
+
+
+def synthetic_train_iter(
+    batch_size: int,
+    *,
+    image_size=224,
+    num_classes=1000,
+    seed=0,
+    start_step=0,
+) -> Iterator[dict]:
+    src = SyntheticImageNet(
+        image_size=image_size, num_classes=num_classes, seed=seed
+    )
+    step = start_step
+    while True:
+        yield src.batch(batch_size, np.random.default_rng((seed, step)))
+        step += 1
+
+
+def synthetic_eval_iter(
+    batch_size: int, *, image_size=224, num_classes=1000, seed=1, batches=8
+) -> Iterator[dict]:
+    src = SyntheticImageNet(
+        image_size=image_size, num_classes=num_classes, seed=seed
+    )
+    for step in range(batches):
+        b = src.batch(batch_size, np.random.default_rng((seed, step)))
+        b["mask"] = np.ones(batch_size, np.float32)
+        yield b
+
+
+# ---------------------------------------------------------------- tfrecord
+
+
+def _tf():
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")  # host-side reader only
+    try:
+        tf.config.set_visible_devices([], "TPU")
+    except Exception:
+        pass
+    return tf
+
+
+def _parse_and_decode(tf, record, *, train: bool, image_size: int):
+    feats = tf.io.parse_single_example(
+        record,
+        {
+            "image/encoded": tf.io.FixedLenFeature([], tf.string),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+        },
+    )
+    img_bytes = feats["image/encoded"]
+    if train:
+        # Classic ResNet crop: random area 8–100%, aspect 3/4–4/3.
+        bbox = tf.zeros([1, 0, 4], tf.float32)
+        begin, size, _ = tf.image.sample_distorted_bounding_box(
+            tf.io.extract_jpeg_shape(img_bytes),
+            bounding_boxes=bbox,
+            area_range=(0.08, 1.0),
+            aspect_ratio_range=(3 / 4, 4 / 3),
+            max_attempts=10,
+            use_image_if_no_bounding_boxes=True,
+        )
+        y, x, _ = tf.unstack(begin)
+        h, w, _ = tf.unstack(size)
+        img = tf.image.decode_and_crop_jpeg(
+            img_bytes, tf.stack([y, x, h, w]), channels=3
+        )
+        img = tf.image.resize(img, [image_size, image_size])
+        img = tf.image.random_flip_left_right(img)
+    else:
+        img = tf.io.decode_jpeg(img_bytes, channels=3)
+        shape = tf.shape(img)
+        crop = tf.cast(
+            tf.cast(tf.minimum(shape[0], shape[1]), tf.float32) * 0.875, tf.int32
+        )
+        img = tf.image.resize_with_crop_or_pad(img, crop, crop)
+        img = tf.image.resize(img, [image_size, image_size])
+    # Emit uint8: normalization runs in the threaded C++ host library
+    # (native/fastdata.cpp) after the tf graph — and uint8 batches are
+    # 4x cheaper to move between tf.data and numpy.
+    img = tf.cast(tf.clip_by_value(img, 0.0, 255.0), tf.uint8)
+    # ImageNet TFRecord labels are 1-based.
+    label = tf.cast(feats["image/class/label"], tf.int32) - 1
+    return {"image": img, "label": label}
+
+
+def tfrecord_iter(
+    data_dir: str,
+    split: str,
+    batch_size: int,
+    *,
+    train: bool,
+    image_size: int = 224,
+    seed: int = 0,
+    num_parallel: int = 16,
+) -> Iterator[dict]:
+    """Host tf.data pipeline → numpy batches (masked final eval batch)."""
+    import jax
+
+    tf = _tf()
+    pattern = os.path.join(data_dir, f"{split}-*")
+    files = sorted(tf.io.gfile.glob(pattern))
+    if not files:
+        raise FileNotFoundError(f"no TFRecord shards matching {pattern}")
+    ds = tf.data.Dataset.from_tensor_slices(files)
+    # Per-host input sharding (multi-host DP, SURVEY.md §3(5)).
+    ds = ds.shard(jax.process_count(), jax.process_index())
+    if train:
+        ds = ds.shuffle(len(files), seed=seed)
+    ds = ds.interleave(
+        tf.data.TFRecordDataset,
+        cycle_length=num_parallel,
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=not train,
+    )
+    if train:
+        ds = ds.shuffle(16 * batch_size, seed=seed)
+        ds = ds.repeat()
+    ds = ds.map(
+        lambda r: _parse_and_decode(tf, r, train=train, image_size=image_size),
+        num_parallel_calls=tf.data.AUTOTUNE,
+    )
+    ds = ds.batch(batch_size, drop_remainder=train)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+
+    from tensorflow_examples_tpu import native
+
+    for batch in ds.as_numpy_iterator():
+        img = native.normalize(batch["image"], MEAN_RGB, STDDEV_RGB)
+        if img is None:  # no toolchain → vectorized numpy fallback
+            img = (
+                batch["image"].astype(np.float32) / 255.0 - MEAN_RGB
+            ) / STDDEV_RGB
+        out = {"image": img, "label": batch["label"]}
+        n = len(out["label"])
+        if not train and n < batch_size:
+            pad = batch_size - n
+            out = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                for k, v in out.items()
+            }
+            out["mask"] = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+            )
+        elif not train:
+            out["mask"] = np.ones(n, np.float32)
+        yield out
+
+
+def has_tfrecords(data_dir: str, split: str) -> bool:
+    if not data_dir:
+        return False
+    import glob
+
+    return bool(glob.glob(os.path.join(data_dir, f"{split}-*")))

@@ -197,3 +197,99 @@ def synthetic_tokens(
     for t in range(1, seq_len):
         toks[:, t] = np.where(noise[:, t], rand[:, t], succ[toks[:, t - 1]])
     return InMemoryDataset({"tokens": toks})
+
+
+# ------------------------------------------------------------------- GLUE
+
+
+GLUE_NUM_LABELS = {
+    "cola": 2, "sst2": 2, "mrpc": 2, "stsb": 1, "qqp": 2,
+    "mnli": 3, "qnli": 2, "rte": 2, "wnli": 2,
+}
+
+
+def load_glue(
+    data_dir: str = "",
+    task: str = "sst2",
+    split: str = "train",
+    *,
+    seq_len: int = 128,
+    vocab_size: int = 30522,
+) -> InMemoryDataset:
+    """Tokenized GLUE features for BERT fine-tuning.
+
+    With ``data_dir``: expects ``<task>_<split>.npz`` holding pre-tokenized
+    arrays (``tokens`` [n, S], ``attention_mask`` [n, S],
+    ``token_type_ids`` [n, S], ``label`` [n]) — the output of any BERT
+    tokenizer run offline (this hermetic image has no network for
+    vocab downloads). Without: a seeded synthetic task with the same
+    schema whose label is a linear function of marker-token counts, so
+    fine-tuning measurably learns.
+    """
+    if task not in GLUE_NUM_LABELS:
+        raise ValueError(f"unknown GLUE task {task!r}; one of {sorted(GLUE_NUM_LABELS)}")
+    if data_dir:
+        path = os.path.join(data_dir, f"{task}_{split}.npz")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"--data_dir={data_dir} set but {task}_{split}.npz not found; "
+                "omit --data_dir for synthetic data"
+            )
+        d = np.load(path)
+        arrays = {
+            "tokens": d["tokens"].astype(np.int32),
+            "attention_mask": d["attention_mask"].astype(np.int32),
+            "token_type_ids": d["token_type_ids"].astype(np.int32),
+            "label": d["label"].astype(
+                np.float32 if task == "stsb" else np.int32
+            ),
+        }
+        return InMemoryDataset(arrays)
+    return synthetic_glue(
+        task,
+        n=2048 if split == "train" else 256,
+        seq_len=seq_len,
+        vocab_size=vocab_size,
+        seed=6 if split == "train" else 7,
+    )
+
+
+def synthetic_glue(
+    task: str, *, n: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> InMemoryDataset:
+    """Seeded synthetic sentence(-pair) classification/regression data.
+
+    Marker token ids 10..10+C are planted with class-dependent frequency;
+    the label is recoverable from their counts (regression for stsb)."""
+    rng = np.random.default_rng(seed)
+    num_labels = GLUE_NUM_LABELS[task]
+    classes = max(num_labels, 2)
+    toks = rng.integers(100, vocab_size, size=(n, seq_len)).astype(np.int32)
+    lengths = rng.integers(seq_len // 2, seq_len + 1, size=n)
+    mask = (np.arange(seq_len)[None, :] < lengths[:, None]).astype(np.int32)
+    y = rng.integers(0, classes, size=n)
+    for c in range(classes):
+        rows = np.where(y == c)[0]
+        # Plant ~8 class-c markers at random valid positions per row.
+        for r in rows:
+            pos = rng.integers(1, lengths[r], size=8)
+            toks[r, pos] = 10 + c
+    toks[:, 0] = 101  # [CLS]
+    # Pair tasks get a type-id boundary mid-sentence ([SEP] at split).
+    boundary = np.maximum(lengths // 2, 1)
+    type_ids = (np.arange(seq_len)[None, :] >= boundary[:, None]).astype(np.int32)
+    type_ids *= mask
+    toks = np.where(mask > 0, toks, 0)
+    label = (
+        (y.astype(np.float32) / (classes - 1) * 5.0)
+        if task == "stsb"
+        else y.astype(np.int32)
+    )
+    return InMemoryDataset(
+        {
+            "tokens": toks,
+            "attention_mask": mask,
+            "token_type_ids": type_ids,
+            "label": label,
+        }
+    )

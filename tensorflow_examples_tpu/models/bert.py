@@ -1,0 +1,164 @@
+"""BERT-base encoder + GLUE heads (BASELINE.json:configs[3]).
+
+Capability parity with the reference's BERT-base GLUE fine-tune example
+(12L/768H/12 heads, vocab 30522, learned positions, post-LN, gelu,
+pooler + per-task head), built TPU-first on the shared framework:
+
+- Bidirectional attention with the padding mask folded in as an additive
+  bias. At GLUE sequence lengths (≤128) attention is a small fraction of
+  the FLOPs, so the XLA softmax path is the right kernel choice here;
+  the Pallas flash path stays the long-sequence/causal specialty
+  (models/transformer.py).
+- Same head-major DenseGeneral layout as the GPT-2 model, so the
+  GPT2-style TP sharding rules apply (BERT_RULES below).
+- Weight layout maps 1:1 from HF ``BertModel`` (models/hf_import.py →
+  ``import_bert``), replacing the reference's TF pretrained-checkpoint
+  restore (SURVEY.md §5d).
+
+Classification (single-label) and regression (STS-B) share the module;
+``num_labels=1`` means regression.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflow_examples_tpu.core.mesh import AxisNames
+from tensorflow_examples_tpu.core.sharding import ShardingRules
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_len: int = 512
+    type_vocab_size: int = 2
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+
+def bert_base(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+_M, _F = AxisNames.MODEL, AxisNames.FSDP
+BERT_RULES = ShardingRules(
+    [
+        (r"attn_qkv/kernel", P(_F, None, _M, None)),
+        (r"attn_qkv/bias", P(None, _M, None)),
+        (r"attn_proj/kernel", P(_M, None, _F)),
+        (r"ffn_in/kernel", P(_F, _M)),
+        (r"ffn_in/bias", P(_M)),
+        (r"ffn_out/kernel", P(_M, _F)),
+    ]
+)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+    train: bool
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.cfg
+        h, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
+        drop = lambda t: nn.Dropout(cfg.dropout, deterministic=not self.train)(t)
+
+        qkv = nn.DenseGeneral(features=(3, h, hd), dtype=x.dtype, name="attn_qkv")(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * (hd ** -0.5)
+        p = jax.nn.softmax(s + bias, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        attn_out = nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), dtype=x.dtype, name="attn_proj"
+        )(ctx)
+        # Post-LN (original BERT): LN(residual + sublayer).
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=x.dtype, name="attn_ln"
+        )(x + drop(attn_out))
+
+        y = nn.Dense(cfg.d_ff, dtype=x.dtype, name="ffn_in")(x)
+        y = nn.gelu(y, approximate=False)  # BERT uses exact erf gelu
+        y = nn.Dense(cfg.d_model, dtype=x.dtype, name="ffn_out")(y)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=x.dtype, name="ffn_ln"
+        )(x + drop(y))
+
+
+class BertEncoder(nn.Module):
+    """Returns (sequence_output [B,S,d], pooled [B,d])."""
+
+    cfg: BertConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, token_type_ids=None, *,
+                 train: bool = False):
+        cfg = self.cfg
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(tokens)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(tokens)
+
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.02), name="word_embeddings",
+        )(tokens)
+        emb += nn.Embed(
+            cfg.max_len, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.02),
+            name="position_embeddings",
+        )(jnp.arange(tokens.shape[1], dtype=jnp.int32))[None]
+        emb += nn.Embed(
+            cfg.type_vocab_size, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.02),
+            name="token_type_embeddings",
+        )(token_type_ids)
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=emb.dtype, name="embeddings_ln"
+        )(emb)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+
+        # Padding mask → additive attention bias [B, 1, 1, S].
+        bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+        bias = bias.astype(jnp.float32)
+
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, train, name=f"layer_{i}")(x, bias)
+
+        pooled = nn.tanh(
+            nn.Dense(cfg.d_model, dtype=x.dtype, name="pooler")(x[:, 0])
+        )
+        return x, pooled
+
+
+class BertClassifier(nn.Module):
+    """BERT encoder + dropout + task head (classification or regression)."""
+
+    cfg: BertConfig
+    num_labels: int = 2
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, token_type_ids=None, *,
+                 train: bool = False):
+        _, pooled = BertEncoder(self.cfg, self.mesh, name="bert")(
+            tokens, attention_mask, token_type_ids, train=train
+        )
+        pooled = nn.Dropout(self.cfg.dropout, deterministic=not train)(pooled)
+        # Head in f32 for stable logits/regression under bf16 compute.
+        return nn.Dense(
+            self.num_labels, dtype=jnp.float32, name="classifier"
+        )(pooled.astype(jnp.float32))

@@ -14,6 +14,7 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from tensorflow_examples_tpu.models.bert import BertConfig
 from tensorflow_examples_tpu.models.transformer import TransformerConfig
 
 
@@ -77,5 +78,97 @@ def import_gpt2(
                 "kernel": sd[f"{p}.mlp.c_proj.weight"],
                 "bias": sd[f"{p}.mlp.c_proj.bias"],
             },
+        }
+    return cfg, params
+
+
+def import_bert(
+    hf_model_or_path: Any, num_labels: int | None = None
+) -> tuple[BertConfig, Mapping]:
+    """Convert an HF ``BertModel``/``BertForSequenceClassification`` (or
+    local path) to our ``BertClassifier`` params.
+
+    torch ``Linear`` stores weights [out, in] → transposed here; QKV are
+    three separate Linears in HF, stacked into our combined [d, 3, H, hd]
+    DenseGeneral kernel.
+    """
+    if isinstance(hf_model_or_path, str):
+        from transformers import BertForSequenceClassification
+
+        hf_model_or_path = BertForSequenceClassification.from_pretrained(
+            hf_model_or_path
+        )
+    sd = {k: _np(v) for k, v in hf_model_or_path.state_dict().items()}
+    hfc = hf_model_or_path.config
+    cfg = BertConfig(
+        vocab_size=hfc.vocab_size,
+        max_len=hfc.max_position_embeddings,
+        type_vocab_size=hfc.type_vocab_size,
+        num_layers=hfc.num_hidden_layers,
+        num_heads=hfc.num_attention_heads,
+        d_model=hfc.hidden_size,
+        d_ff=hfc.intermediate_size,
+        layer_norm_eps=hfc.layer_norm_eps,
+    )
+    d, h = cfg.d_model, cfg.num_heads
+    hd = d // h
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+
+    def lin(prefix):  # torch Linear → flax Dense
+        return {"kernel": sd[f"{prefix}.weight"].T, "bias": sd[f"{prefix}.bias"]}
+
+    def ln(prefix):
+        return {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]}
+
+    bert: dict = {
+        "word_embeddings": {
+            "embedding": sd[f"{pre}embeddings.word_embeddings.weight"]
+        },
+        "position_embeddings": {
+            "embedding": sd[f"{pre}embeddings.position_embeddings.weight"]
+        },
+        "token_type_embeddings": {
+            "embedding": sd[f"{pre}embeddings.token_type_embeddings.weight"]
+        },
+        "embeddings_ln": ln(f"{pre}embeddings.LayerNorm"),
+        "pooler": lin(f"{pre}pooler.dense"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"{pre}encoder.layer.{i}"
+        qkv_w = np.stack(
+            [
+                sd[f"{p}.attention.self.{n}.weight"].T.reshape(d, h, hd)
+                for n in ("query", "key", "value")
+            ],
+            axis=1,
+        )
+        qkv_b = np.stack(
+            [
+                sd[f"{p}.attention.self.{n}.bias"].reshape(h, hd)
+                for n in ("query", "key", "value")
+            ],
+            axis=0,
+        )
+        bert[f"layer_{i}"] = {
+            "attn_qkv": {"kernel": qkv_w, "bias": qkv_b},
+            "attn_proj": {
+                "kernel": sd[f"{p}.attention.output.dense.weight"].T.reshape(
+                    h, hd, d
+                ),
+                "bias": sd[f"{p}.attention.output.dense.bias"],
+            },
+            "attn_ln": ln(f"{p}.attention.output.LayerNorm"),
+            "ffn_in": lin(f"{p}.intermediate.dense"),
+            "ffn_out": lin(f"{p}.output.dense"),
+            "ffn_ln": ln(f"{p}.output.LayerNorm"),
+        }
+    params: dict = {"bert": bert}
+    if "classifier.weight" in sd:
+        params["classifier"] = lin("classifier")
+    elif num_labels:
+        rng = np.random.default_rng(0)
+        params["classifier"] = {
+            "kernel": rng.normal(0, 0.02, (d, num_labels)).astype(np.float32),
+            "bias": np.zeros(num_labels, np.float32),
         }
     return cfg, params

@@ -1,0 +1,189 @@
+"""ctypes bindings for the C++ host-runtime libraries (native/).
+
+The reference leaned on TensorFlow's C++ runtime for its input pipeline
+and on CUDA ``tf.custom_op`` kernels (SURVEY.md §2c). The TPU-native
+split implemented here:
+
+- device kernels → Pallas (ops/), because that is the supported kernel
+  path on TPU;
+- host runtime → C++ in ``native/``: threaded augmentation/normalization
+  (libfastdata) feeding the device-prefetch queue, and an XLA FFI
+  custom-call library (libffi_ops) as the C++ compiled-op scaffold on
+  the CPU backend.
+
+Everything degrades gracefully: if the toolchain or headers are missing
+the numpy/Pallas fallbacks are used and ``available()`` returns False.
+Build happens lazily (``make -C native``) on first use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _load(name: str):
+    path = os.path.join(_NATIVE_DIR, "build", f"lib{name}.so")
+    if not os.path.exists(path):
+        if not os.path.isdir(_NATIVE_DIR):
+            return None
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, f"build/lib{name}.so"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception as e:  # toolchain missing → fallbacks
+            log.warning("native build of %s failed: %s", name, e)
+            return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError as e:
+        log.warning("failed to load %s: %s", path, e)
+        return None
+
+
+def available(name: str = "fastdata") -> bool:
+    return _load(name) is not None
+
+
+# ------------------------------------------------------------- fastdata
+
+
+def crop_flip_normalize(
+    images_u8: np.ndarray,
+    ys: np.ndarray,
+    xs: np.ndarray,
+    flips: np.ndarray,
+    mean: np.ndarray,
+    std: np.ndarray,
+    *,
+    pad: int = 4,
+    out_size: tuple[int, int] | None = None,
+    threads: int | None = None,
+) -> np.ndarray | None:
+    """Threaded reflect-pad crop + flip + normalize; None if unavailable.
+
+    images_u8: [B, H, W, C] uint8. ys/xs: [B] int32 offsets in padded
+    coords (0..2*pad). flips: [B] bool/uint8. Returns [B, h, w, C] f32.
+    """
+    lib = _load("fastdata")
+    if lib is None:
+        return None
+    b, h, w, c = images_u8.shape
+    oh, ow = out_size or (h, w)
+    out = np.empty((b, oh, ow, c), np.float32)
+    images_u8 = np.ascontiguousarray(images_u8)
+    inv_std = np.ascontiguousarray(1.0 / std.astype(np.float32))
+    mean = np.ascontiguousarray(mean.astype(np.float32))
+    ys = np.ascontiguousarray(ys.astype(np.int32))
+    xs = np.ascontiguousarray(xs.astype(np.int32))
+    flips = np.ascontiguousarray(flips.astype(np.uint8))
+    nthreads = threads or min(16, os.cpu_count() or 1)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    f32 = ctypes.POINTER(ctypes.c_float)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    lib.crop_flip_normalize_u8(
+        images_u8.ctypes.data_as(u8),
+        out.ctypes.data_as(f32),
+        ys.ctypes.data_as(i32),
+        xs.ctypes.data_as(i32),
+        flips.ctypes.data_as(u8),
+        mean.ctypes.data_as(f32),
+        inv_std.ctypes.data_as(f32),
+        *map(ctypes.c_int64, (b, h, w, oh, ow, c, pad, nthreads)),
+    )
+    return out
+
+
+def normalize(
+    images_u8: np.ndarray,
+    mean: np.ndarray,
+    std: np.ndarray,
+    *,
+    threads: int | None = None,
+) -> np.ndarray | None:
+    """Threaded (x/255 - mean)/std on a uint8 NHWC batch; None if unavailable."""
+    lib = _load("fastdata")
+    if lib is None:
+        return None
+    b, h, w, c = images_u8.shape
+    out = np.empty((b, h, w, c), np.float32)
+    images_u8 = np.ascontiguousarray(images_u8)
+    inv_std = np.ascontiguousarray(1.0 / std.astype(np.float32))
+    mean = np.ascontiguousarray(mean.astype(np.float32))
+    nthreads = threads or min(16, os.cpu_count() or 1)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    f32 = ctypes.POINTER(ctypes.c_float)
+    lib.normalize_u8(
+        images_u8.ctypes.data_as(u8),
+        out.ctypes.data_as(f32),
+        mean.ctypes.data_as(f32),
+        inv_std.ctypes.data_as(f32),
+        *map(ctypes.c_int64, (b, h * w, c, nthreads)),
+    )
+    return out
+
+
+# ------------------------------------------------------------- ffi_ops
+
+
+@functools.lru_cache(maxsize=None)
+def register_ffi_targets() -> bool:
+    """Register the C++ XLA custom-calls with jax (CPU backend).
+
+    Returns True when ``fused_cross_entropy_fwd`` is callable via
+    ``jax.ffi.ffi_call`` (see ``ffi_cross_entropy``)."""
+    lib = _load("ffi_ops")
+    if lib is None:
+        return False
+    try:
+        import jax.ffi
+
+        lib.fused_cross_entropy_fwd_handler.restype = ctypes.c_void_p
+        handler = lib.fused_cross_entropy_fwd_handler()
+        ctypes.pythonapi.PyCapsule_New.restype = ctypes.py_object
+        ctypes.pythonapi.PyCapsule_New.argtypes = (
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+        )
+        capsule = ctypes.pythonapi.PyCapsule_New(
+            ctypes.c_void_p(handler), None, None
+        )
+        jax.ffi.register_ffi_target(
+            "tfe_fused_cross_entropy_fwd", capsule, platform="cpu"
+        )
+        return True
+    except Exception as e:
+        log.warning("FFI registration failed: %s", e)
+        return False
+
+
+def ffi_cross_entropy(logits, labels):
+    """Per-example (nll, lse) via the C++ XLA custom call (CPU backend)."""
+    import jax
+    import jax.numpy as jnp
+
+    if not register_ffi_targets():
+        raise RuntimeError("native ffi_ops library unavailable")
+    n = logits.shape[0]
+    out_types = (
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+    )
+    return jax.ffi.ffi_call("tfe_fused_cross_entropy_fwd", out_types)(
+        logits.astype(jnp.float32), labels.astype(jnp.int32)
+    )

@@ -1,0 +1,70 @@
+"""GLUE metrics (MCC, F1, Pearson/Spearman-free recipe) as mean-composable
+pieces.
+
+The shared eval loop (train/loop.py) aggregates *weighted means* across
+batches. F1/MCC/Pearson are not batch-mean composable, but they ARE
+functions of globally-aggregated means: confusion-cell indicator rates
+(tp/fp/fn/tn) and raw moments (x, y, x², y², xy). Each task's ``eval_fn``
+emits those per-batch rates; ``Task.eval_finalize`` turns the aggregated
+means into the final score. This keeps eval single-pass, jitted, and
+static-shape (SURVEY.md §3(3)) with no host-side prediction buffering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tensorflow_examples_tpu.ops.losses import weighted_mean
+
+
+def confusion_rates(
+    preds: jax.Array, labels: jax.Array, weights: jax.Array | None
+) -> dict[str, jax.Array]:
+    """Per-batch weighted means of binary confusion indicators."""
+    preds = preds.astype(jnp.int32)
+    labels = labels.astype(jnp.int32)
+    out = {}
+    for name, cond in {
+        "tp": (preds == 1) & (labels == 1),
+        "fp": (preds == 1) & (labels == 0),
+        "fn": (preds == 0) & (labels == 1),
+        "tn": (preds == 0) & (labels == 0),
+    }.items():
+        out[name] = weighted_mean(cond.astype(jnp.float32), weights)
+    return out
+
+
+def f1_from_rates(m: dict) -> float:
+    tp, fp, fn = m["tp"], m["fp"], m["fn"]
+    denom = 2 * tp + fp + fn
+    return 2 * tp / denom if denom > 0 else 0.0
+
+
+def mcc_from_rates(m: dict) -> float:
+    tp, fp, fn, tn = m["tp"], m["fp"], m["fn"], m["tn"]
+    denom = ((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)) ** 0.5
+    return (tp * tn - fp * fn) / denom if denom > 0 else 0.0
+
+
+def moment_means(
+    x: jax.Array, y: jax.Array, weights: jax.Array | None
+) -> dict[str, jax.Array]:
+    """Raw-moment means for Pearson correlation."""
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    return {
+        "x": weighted_mean(x, weights),
+        "y": weighted_mean(y, weights),
+        "xx": weighted_mean(x * x, weights),
+        "yy": weighted_mean(y * y, weights),
+        "xy": weighted_mean(x * y, weights),
+    }
+
+
+def pearson_from_moments(m: dict) -> float:
+    cov = m["xy"] - m["x"] * m["y"]
+    vx = max(m["xx"] - m["x"] ** 2, 0.0)
+    vy = max(m["yy"] - m["y"] ** 2, 0.0)
+    denom = (vx * vy) ** 0.5
+    return cov / denom if denom > 0 else 0.0

@@ -43,13 +43,20 @@ def softmax_cross_entropy(
 
 
 def accuracy_metrics(
-    logits: jax.Array, labels: jax.Array, weights: jax.Array | None = None
+    logits: jax.Array,
+    labels: jax.Array,
+    weights: jax.Array | None = None,
+    *,
+    top5: bool = False,
 ) -> dict[str, jax.Array]:
     pred = jnp.argmax(logits, axis=-1)
     correct = (pred == labels).astype(jnp.float32)
+    out = {"accuracy": weighted_mean(correct, weights)}
+    if top5:
+        # In-top-5 without a sort: count logits strictly above the label's.
+        label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)
+        rank = jnp.sum((logits > label_logit).astype(jnp.int32), axis=-1)
+        out["top5_accuracy"] = weighted_mean((rank < 5).astype(jnp.float32), weights)
     if weights is not None:
-        return {
-            "accuracy": weighted_mean(correct, weights),
-            "weight": jnp.sum(weights.astype(jnp.float32)),
-        }
-    return {"accuracy": jnp.mean(correct)}
+        out["weight"] = jnp.sum(weights.astype(jnp.float32))
+    return out

@@ -18,8 +18,6 @@ A workload module plugs in via a small protocol:
 
 from __future__ import annotations
 
-import inspect
-
 from absl import app, logging
 
 from tensorflow_examples_tpu.core import distributed
@@ -43,15 +41,11 @@ def _setup(workload, default_cfg):
 
 
 def _build_trainer(workload, cfg):
-    """Create (mesh, task, Trainer); passes the mesh to ``make_task`` when
-    the workload accepts it (models that pin activation shardings or run
-    shard_map'd attention need the concrete mesh at trace time)."""
+    """Create the mesh once and hand it to both the task and the Trainer
+    (models that pin activation shardings or run shard_map'd attention
+    need the concrete mesh at trace time)."""
     mesh = create_mesh(cfg.mesh_config())
-    if "mesh" in inspect.signature(workload.make_task).parameters:
-        task = workload.make_task(cfg, mesh=mesh)
-    else:
-        task = workload.make_task(cfg)
-    return Trainer(task, cfg, mesh=mesh)
+    return Trainer(workload.make_task(cfg, mesh=mesh), cfg, mesh=mesh)
 
 
 def _iterators(workload, cfg):

@@ -47,6 +47,23 @@ from tensorflow_examples_tpu.train.task import Task
 log = logging.getLogger(__name__)
 
 
+def state_factory(task: Task, config: TrainConfig):
+    """(make_state(rng) -> TrainState, tx). Shared by Trainer init and by
+    restore-only consumers (e.g. sampling CLIs), which ``jax.eval_shape``
+    the factory to get a checkpoint template without materializing params
+    or optimizer state."""
+    tx = task.make_optimizer(config)
+
+    def make_state(rng):
+        variables = dict(task.init_fn(rng))
+        params = variables.pop("params")
+        return TrainState.create(
+            apply_fn=None, params=params, tx=tx, model_state=variables
+        )
+
+    return make_state, tx
+
+
 class Trainer:
     """Runs a Task under a TrainConfig on a device mesh."""
 
@@ -66,15 +83,8 @@ class Trainer:
 
     def _init_state(self) -> TrainState:
         cfg = self.config
-        tx = self.task.make_optimizer(cfg)
         rng = jax.random.PRNGKey(cfg.seed)
-
-        def make_state(rng):
-            variables = dict(self.task.init_fn(rng))
-            params = variables.pop("params")
-            return TrainState.create(
-                apply_fn=None, params=params, tx=tx, model_state=variables
-            )
+        make_state, tx = state_factory(self.task, cfg)
 
         # Evaluate shapes → shardings from the rules → jit-init directly
         # into the sharded layout (params never materialize unsharded).
@@ -316,7 +326,10 @@ class Trainer:
             count = w if count is None else count + w
         if count is None:
             return {}
-        return {k: float(v) / max(float(count), 1.0) for k, v in totals.items()}
+        means = {k: float(v) / max(float(count), 1.0) for k, v in totals.items()}
+        if self.task.eval_finalize is not None:
+            means = dict(self.task.eval_finalize(means))
+        return means
 
 
 def _make_writer(workdir: str):

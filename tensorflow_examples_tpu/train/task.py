@@ -36,3 +36,7 @@ class Task:
     # eval_fn(params, model_state, batch) -> metrics dict; a "weight" entry
     # weights the mean (padded-batch masking)
     eval_fn: Callable[..., Mapping[str, jax.Array]] | None = None
+    # eval_finalize(mean-metrics dict) -> final dict; for metrics that are
+    # functions of globally-aggregated means rather than batch means
+    # (F1/MCC from confusion rates, Pearson from moment means).
+    eval_finalize: Callable[[dict], dict] | None = None

@@ -1,0 +1,192 @@
+"""BERT-base GLUE fine-tune workload (BASELINE.json:configs[3]).
+
+Reference behavior: fine-tune a pretrained BERT-base encoder on GLUE
+tasks under ``MultiWorkerMirroredStrategy`` (multi-host DP) with AdamW +
+warmup-linear-decay and per-task metrics (MCC/F1/accuracy). Here the
+multi-host machinery is the mesh (a multi-host run is the same code with
+more devices on the ``data`` axis — core/distributed.py bootstraps
+processes), pretrained weights import from HF (models/hf_import.py), and
+the non-composable GLUE metrics aggregate exactly through the shared
+eval loop via confusion/moment rates (ops/glue_metrics.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from tensorflow_examples_tpu.data.sources import GLUE_NUM_LABELS, load_glue
+from tensorflow_examples_tpu.models import bert
+from tensorflow_examples_tpu.ops import glue_metrics
+from tensorflow_examples_tpu.ops.losses import softmax_cross_entropy, weighted_mean
+from tensorflow_examples_tpu.train import Task, TrainConfig
+from tensorflow_examples_tpu.train import optimizers
+
+
+@dataclasses.dataclass
+class BertGlueConfig(TrainConfig):
+    # Standard BERT fine-tune recipe: 3 epochs, batch 32, lr 2e-5,
+    # 10% warmup, AdamW(b2=0.999, eps=1e-6), linear decay.
+    task: str = "sst2"
+    seq_len: int = 128
+    vocab_size: int = 30522
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dropout: float = 0.1
+    pretrained: str = ""  # local HF BERT path; "" = random init
+
+    global_batch_size: int = 32
+    train_steps: int = 6000
+    warmup_steps: int = 600
+    learning_rate: float = 2e-5
+    weight_decay: float = 0.01
+    grad_clip_norm: float = 1.0
+    eval_every: int = 1000
+    checkpoint_every: int = 1000
+    log_every: int = 50
+
+
+def model_config(cfg: BertGlueConfig) -> bert.BertConfig:
+    return bert.BertConfig(
+        vocab_size=cfg.vocab_size,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        d_model=cfg.d_model,
+        d_ff=cfg.d_ff,
+        dropout=cfg.dropout,
+    )
+
+
+def make_task(cfg: BertGlueConfig, mesh=None) -> Task:
+    num_labels = GLUE_NUM_LABELS[cfg.task]
+    regression = num_labels == 1
+    model = bert.BertClassifier(
+        model_config(cfg), num_labels=num_labels, mesh=mesh
+    )
+
+    def init_fn(rng):
+        import jax
+
+        dummy = jnp.zeros((1, cfg.seq_len), jnp.int32)
+        variables = dict(model.init({"params": rng}, dummy))
+        if cfg.pretrained:
+            from tensorflow_examples_tpu.models.hf_import import import_bert
+
+            _, params = import_bert(cfg.pretrained, num_labels=num_labels)
+            # Keep the fresh head if the checkpoint lacks a matching one.
+            imported = jax.tree.map(jnp.asarray, params)
+            if (
+                "classifier" not in imported
+                or imported["classifier"]["kernel"].shape
+                != variables["params"]["classifier"]["kernel"].shape
+            ):
+                imported["classifier"] = variables["params"]["classifier"]
+            variables["params"] = imported
+        return variables
+
+    def forward(params, batch, *, rng, train):
+        return model.apply(
+            {"params": params},
+            batch["tokens"],
+            batch["attention_mask"],
+            batch["token_type_ids"],
+            train=train,
+            rngs={"dropout": rng} if train else None,
+        )
+
+    def loss_fn(params, model_state, batch, *, rng, train):
+        logits = forward(params, batch, rng=rng, train=train)
+        if regression:
+            pred = logits[:, 0]
+            loss = jnp.mean((pred - batch["label"]) ** 2)
+            metrics = {}
+        else:
+            loss = softmax_cross_entropy(logits, batch["label"])
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+            )
+            metrics = {"accuracy": acc}
+        return loss, metrics, model_state
+
+    def eval_fn(params, model_state, batch):
+        logits = forward(params, batch, rng=None, train=False)
+        w = batch.get("mask")
+        if regression:
+            pred = logits[:, 0]
+            m = glue_metrics.moment_means(pred, batch["label"], w)
+            m["loss"] = weighted_mean((pred - batch["label"]) ** 2, w)
+        else:
+            pred = jnp.argmax(logits, -1)
+            m = {
+                "accuracy": weighted_mean(
+                    (pred == batch["label"]).astype(jnp.float32), w
+                ),
+                "loss": softmax_cross_entropy(logits, batch["label"], weights=w),
+            }
+            if num_labels == 2:
+                m.update(glue_metrics.confusion_rates(pred, batch["label"], w))
+        m["weight"] = (
+            jnp.sum(w) if w is not None else jnp.float32(batch["tokens"].shape[0])
+        )
+        return m
+
+    def eval_finalize(means: dict) -> dict:
+        out = dict(means)
+        if regression:
+            out["pearson"] = glue_metrics.pearson_from_moments(means)
+            for k in ("x", "y", "xx", "yy", "xy"):
+                out.pop(k, None)
+        elif num_labels == 2:
+            if cfg.task == "cola":
+                out["mcc"] = glue_metrics.mcc_from_rates(means)
+            if cfg.task in ("mrpc", "qqp"):
+                out["f1"] = glue_metrics.f1_from_rates(means)
+            for k in ("tp", "fp", "fn", "tn"):
+                out.pop(k, None)
+        return out
+
+    return Task(
+        name=f"bert_glue_{cfg.task}",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_optimizer=optimizers.adamw_linear,
+        sharding_rules=bert.BERT_RULES,
+        eval_fn=eval_fn,
+        eval_finalize=eval_finalize,
+    )
+
+
+def datasets(cfg: BertGlueConfig):
+    kw = dict(seq_len=cfg.seq_len, vocab_size=cfg.vocab_size)
+    return (
+        load_glue(cfg.data_dir, cfg.task, "train", **kw),
+        load_glue(cfg.data_dir, cfg.task, "validation", **kw)
+        if _has_split(cfg, "validation")
+        else load_glue("", cfg.task, "validation", **kw),
+    )
+
+
+def eval_dataset(cfg: BertGlueConfig):
+    import logging
+
+    kw = dict(seq_len=cfg.seq_len, vocab_size=cfg.vocab_size)
+    has_val = _has_split(cfg, "validation")
+    if cfg.data_dir and not has_val:
+        logging.getLogger(__name__).warning(
+            "--data_dir=%s has no %s_validation.npz; eval runs on SYNTHETIC "
+            "data — reported metrics are not real GLUE scores",
+            cfg.data_dir,
+            cfg.task,
+        )
+    return load_glue(cfg.data_dir if has_val else "", cfg.task, "validation", **kw)
+
+
+def _has_split(cfg: BertGlueConfig, split: str) -> bool:
+    import os
+
+    return bool(cfg.data_dir) and os.path.exists(
+        os.path.join(cfg.data_dir, f"{cfg.task}_{split}.npz")
+    )

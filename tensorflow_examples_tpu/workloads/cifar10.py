@@ -34,7 +34,7 @@ class Cifar10Config(TrainConfig):
     augment: bool = True
 
 
-def make_task(cfg: Cifar10Config) -> Task:
+def make_task(cfg: Cifar10Config, mesh=None) -> Task:
     model = resnet20(num_classes=10)
 
     def init_fn(rng):

@@ -139,12 +139,19 @@ def datasets(cfg: Gpt2Config):
 
 
 def eval_dataset(cfg: Gpt2Config):
+    import logging
     import os
 
     has_val = bool(cfg.data_dir) and any(
         os.path.exists(os.path.join(cfg.data_dir, "val" + ext))
         for ext in (".bin", ".npy", ".txt")
     )
+    if cfg.data_dir and not has_val:
+        logging.getLogger(__name__).warning(
+            "--data_dir=%s has no val.{bin,npy,txt}; eval runs on SYNTHETIC "
+            "data — reported nll is not a real validation score",
+            cfg.data_dir,
+        )
     return load_lm_tokens(
         cfg.data_dir if has_val else "",
         "val",

@@ -1,0 +1,124 @@
+"""ResNet-50 ImageNet workload (BASELINE.json:configs[2]).
+
+The reference's throughput workload: tf.data input pipeline with device
+prefetch, data-parallel across 8 chips, ResNet-50, label smoothing,
+SGD+momentum (LARS for large batch). Here: the same capability on the
+shared loop — streaming tf.data/TFRecord host pipeline (or synthetic
+fallback) feeding the async device-prefetch queue, one jitted step with
+sync-BN semantics for free (global-batch jit), examples/sec as the
+north-star metric (BASELINE.json:metric).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tensorflow_examples_tpu.core.sharding import REPLICATED
+from tensorflow_examples_tpu.data import imagenet as imagenet_data
+from tensorflow_examples_tpu.models.resnet import resnet50
+from tensorflow_examples_tpu.ops.losses import accuracy_metrics, softmax_cross_entropy
+from tensorflow_examples_tpu.train import Task, TrainConfig
+from tensorflow_examples_tpu.train import optimizers
+
+
+@dataclasses.dataclass
+class ImagenetConfig(TrainConfig):
+    # 90-epoch recipe at batch 1024: lr 0.4 (= 0.1 · bs/256) cosine with
+    # 5-epoch warmup, wd 1e-4, label smoothing 0.1.
+    image_size: int = 224
+    num_classes: int = 1000
+    label_smoothing: float = 0.1
+    optimizer: str = "sgd"  # sgd | lars (large-batch)
+    global_batch_size: int = 1024
+    train_steps: int = 112590  # 90 epochs · 1.28M / 1024
+    warmup_steps: int = 6255
+    learning_rate: float = 0.4
+    weight_decay: float = 1e-4
+    eval_every: int = 5000
+    checkpoint_every: int = 5000
+    eval_batches: int = 8  # synthetic-eval length (real eval: full split)
+
+
+def make_task(cfg: ImagenetConfig, mesh=None) -> Task:
+    model = resnet50(num_classes=cfg.num_classes)
+
+    def init_fn(rng):
+        import jax.numpy as jnp
+
+        dummy = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+        return model.init({"params": rng}, dummy)
+
+    def loss_fn(params, model_state, batch, *, rng, train):
+        logits, new_vars = model.apply(
+            {"params": params, **model_state},
+            batch["image"],
+            train=train,
+            mutable=["batch_stats"] if train else [],
+        )
+        loss = softmax_cross_entropy(
+            logits, batch["label"], label_smoothing=cfg.label_smoothing
+        )
+        new_model_state = dict(new_vars) if train else model_state
+        return loss, accuracy_metrics(logits, batch["label"]), new_model_state
+
+    def eval_fn(params, model_state, batch):
+        logits = model.apply(
+            {"params": params, **model_state}, batch["image"], train=False
+        )
+        m = accuracy_metrics(logits, batch["label"], weights=batch["mask"], top5=True)
+        m["loss"] = softmax_cross_entropy(
+            logits, batch["label"], weights=batch["mask"]
+        )
+        return m
+
+    return Task(
+        name="imagenet_resnet50",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_optimizer=(
+            optimizers.lars if cfg.optimizer == "lars" else optimizers.sgd_momentum_cosine
+        ),
+        sharding_rules=REPLICATED,
+        eval_fn=eval_fn,
+    )
+
+
+# Streaming pipeline protocol (train/cli.py): tf.data TFRecords when
+# --data_dir holds `train-*` shards, synthetic stream otherwise.
+
+
+def make_train_iter(cfg: ImagenetConfig, start_step: int):
+    if imagenet_data.has_tfrecords(cfg.data_dir, "train"):
+        return imagenet_data.tfrecord_iter(
+            cfg.data_dir,
+            "train",
+            cfg.global_batch_size,
+            train=True,
+            image_size=cfg.image_size,
+            seed=cfg.seed,
+        )
+    return imagenet_data.synthetic_train_iter(
+        cfg.global_batch_size,
+        image_size=cfg.image_size,
+        num_classes=cfg.num_classes,
+        seed=cfg.seed,
+        start_step=start_step,
+    )
+
+
+def make_eval_iter(cfg: ImagenetConfig):
+    batch = cfg.eval_batch_size or cfg.global_batch_size
+    if imagenet_data.has_tfrecords(cfg.data_dir, "validation"):
+        return imagenet_data.tfrecord_iter(
+            cfg.data_dir,
+            "validation",
+            batch,
+            train=False,
+            image_size=cfg.image_size,
+        )
+    return imagenet_data.synthetic_eval_iter(
+        batch,
+        image_size=cfg.image_size,
+        num_classes=cfg.num_classes,
+        batches=cfg.eval_batches,
+    )

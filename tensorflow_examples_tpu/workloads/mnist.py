@@ -30,7 +30,7 @@ class MnistConfig(TrainConfig):
     dropout: float = 0.1
 
 
-def make_task(cfg: MnistConfig) -> Task:
+def make_task(cfg: MnistConfig, mesh=None) -> Task:
     model = MLP(
         features=(cfg.hidden,) * cfg.num_layers,
         num_classes=10,

@@ -18,6 +18,7 @@ Axis conventions (used by every model and sharding rule in the framework):
                 sharded over this axis and all-gathered just-in-time.
 - ``model``   — tensor parallelism (hidden/heads dims).
 - ``context`` — sequence/context parallelism (ring attention).
+- ``pipe``    — pipeline parallelism (layer stages, GPipe microbatching).
 
 A single-chip run is simply a 1×1×1×1 mesh; code written against the mesh
 runs unchanged from 1 chip to a multi-host slice.
@@ -41,8 +42,9 @@ class AxisNames:
     FSDP = "fsdp"
     MODEL = "model"
     CONTEXT = "context"
+    PIPE = "pipe"
 
-    ALL = (DATA, FSDP, MODEL, CONTEXT)
+    ALL = (DATA, FSDP, MODEL, CONTEXT, PIPE)
 
     # The batch dimension of activations is sharded over every
     # batch-like axis.
@@ -57,23 +59,25 @@ class MeshConfig:
     fsdp: int = 1
     model: int = 1
     context: int = 1
+    pipe: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        fixed = self.fsdp * self.model * self.context
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        fixed = self.fsdp * self.model * self.context * self.pipe
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*model*context={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"fsdp*model*context*pipe={fixed}"
                 )
             data = n_devices // fixed
         total = data * fixed
         if total != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.fsdp}x{self.model}x{self.context}={total} "
-                f"!= available devices {n_devices}"
+                f"mesh {data}x{self.fsdp}x{self.model}x{self.context}"
+                f"x{self.pipe}={total} != available devices {n_devices}"
             )
-        return (data, self.fsdp, self.model, self.context)
+        return (data, self.fsdp, self.model, self.context, self.pipe)
 
 
 def create_mesh(
@@ -81,7 +85,7 @@ def create_mesh(
     *,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build the framework-standard 4-axis mesh.
+    """Build the framework-standard 5-axis mesh.
 
     ``jax.experimental.mesh_utils`` is used when available so the mesh
     layout follows the physical ICI topology (keeps the fastest-varying

@@ -82,9 +82,7 @@ def import_gpt2(
     return cfg, params
 
 
-def import_bert(
-    hf_model_or_path: Any, num_labels: int | None = None
-) -> tuple[BertConfig, Mapping]:
+def import_bert(hf_model_or_path: Any) -> tuple[BertConfig, Mapping]:
     """Convert an HF ``BertModel``/``BertForSequenceClassification`` (or
     local path) to our ``BertClassifier`` params.
 
@@ -165,10 +163,6 @@ def import_bert(
     params: dict = {"bert": bert}
     if "classifier.weight" in sd:
         params["classifier"] = lin("classifier")
-    elif num_labels:
-        rng = np.random.default_rng(0)
-        params["classifier"] = {
-            "kernel": rng.normal(0, 0.02, (d, num_labels)).astype(np.float32),
-            "bias": np.zeros(num_labels, np.float32),
-        }
+    # No fabricated head otherwise: the caller keeps its fresh (seeded)
+    # task-head init when the checkpoint lacks a matching classifier.
     return cfg, params

@@ -54,6 +54,12 @@ class TransformerConfig:
     dropout: float = 0.1
     attention: str = "flash"  # flash | xla | ring | ulysses
     remat: bool = False
+    # Mixture-of-Experts (parallel/moe.py): 0 = dense MLP everywhere;
+    # E > 0 swaps the MLP of every ``moe_every``-th block for a top-1
+    # Switch MoE with E experts (sharded over `model` on a mesh = EP).
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -79,6 +85,11 @@ GPT2_RULES = ShardingRules(
         (r"mlp_fc/kernel", P(_F, _M)),
         (r"mlp_fc/bias", P(_M)),
         (r"mlp_proj/kernel", P(_M, _F)),
+        # MoE expert parallelism: experts ride the `model` axis.
+        (r"moe/w_in", P(_M, None, None)),
+        (r"moe/b_in", P(_M, None)),
+        (r"moe/w_out", P(_M, None, None)),
+        (r"moe/b_out", P(_M, None)),
         # Embeddings replicated: the tied head needs full-vocab logits for
         # the fused CE kernel (vocab-sharded CE is a later optimization).
     ]
@@ -174,11 +185,49 @@ class Attention(nn.Module):
         return jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
 
 
+class MoeMlp(nn.Module):
+    """Top-1 Switch MoE FFN (parallel/moe.py); aux loss sown into
+    the ``intermediates`` collection as ``moe_aux``."""
+
+    cfg: TransformerConfig
+    train: bool
+
+    @nn.compact
+    def __call__(self, x):
+        from tensorflow_examples_tpu.parallel.moe import moe_ffn
+
+        cfg = self.cfg
+        e, d, ff = cfg.moe_experts, cfg.d_model, cfg.ff_dim
+        init = nn.initializers.normal(0.02)
+        out_init = nn.initializers.normal(0.02 / (2 * cfg.num_layers) ** 0.5)
+        gate = self.param("gate", init, (d, e))
+        w_in = self.param("w_in", init, (e, d, ff))
+        b_in = self.param("b_in", nn.initializers.zeros, (e, ff))
+        w_out = self.param("w_out", out_init, (e, ff, d))
+        b_out = self.param("b_out", nn.initializers.zeros, (e, d))
+        rng = (
+            self.make_rng("dropout")
+            if self.train and self.has_rng("dropout")
+            else None
+        )
+        out, aux = moe_ffn(
+            gate,
+            w_in.astype(x.dtype), b_in.astype(x.dtype),
+            w_out.astype(x.dtype), b_out.astype(x.dtype),
+            x,
+            capacity_factor=cfg.moe_capacity_factor,
+            rng=rng,
+        )
+        self.sow("intermediates", "moe_aux", aux)
+        return out
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
     mesh: Mesh | None
     train: bool
     decode: bool
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -188,22 +237,25 @@ class Block(nn.Module):
         y = Attention(cfg, mesh, self.train, decode, name="attn")(y)
         x = _shard(x + y, mesh, _BATCH, ctx, None)
         y = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_2")(x)
-        y = nn.Dense(
-            cfg.ff_dim,
-            kernel_init=nn.initializers.normal(0.02),
-            dtype=x.dtype,
-            name="mlp_fc",
-        )(y)
-        y = nn.gelu(y, approximate=True)
-        y = _shard(y, mesh, _BATCH, ctx, AxisNames.MODEL)
-        y = nn.Dense(
-            cfg.d_model,
-            kernel_init=nn.initializers.normal(
-                0.02 / (2 * cfg.num_layers) ** 0.5
-            ),
-            dtype=x.dtype,
-            name="mlp_proj",
-        )(y)
+        if self.use_moe:
+            y = MoeMlp(cfg, self.train, name="moe")(y)
+        else:
+            y = nn.Dense(
+                cfg.ff_dim,
+                kernel_init=nn.initializers.normal(0.02),
+                dtype=x.dtype,
+                name="mlp_fc",
+            )(y)
+            y = nn.gelu(y, approximate=True)
+            y = _shard(y, mesh, _BATCH, ctx, AxisNames.MODEL)
+            y = nn.Dense(
+                cfg.d_model,
+                kernel_init=nn.initializers.normal(
+                    0.02 / (2 * cfg.num_layers) ** 0.5
+                ),
+                dtype=x.dtype,
+                name="mlp_proj",
+            )(y)
         y = nn.Dropout(cfg.dropout, deterministic=not self.train)(y)
         return _shard(x + y, mesh, _BATCH, ctx, None)
 
@@ -250,7 +302,10 @@ class Transformer(nn.Module):
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         for i in range(cfg.num_layers):
-            x = block(cfg, self.mesh, train, decode, name=f"h_{i}")(x)
+            use_moe = (
+                cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
+            )
+            x = block(cfg, self.mesh, train, decode, use_moe, name=f"h_{i}")(x)
 
         x = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_f")(x)
         # Tied LM head: logits = x @ wteᵀ (GPT-2 ties input/output embeds).
@@ -337,3 +392,66 @@ def generate(
     )
     gen = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
     return jnp.concatenate([prompt, gen], axis=1)
+
+
+# ------------------------------------------------------- pipeline pieces
+
+
+class EmbedHead(nn.Module):
+    """Embedding-in + tied-head-out halves of the LM, as one module.
+
+    Used by the pipeline-parallel GPT-2 path (workloads/gpt2.py +
+    parallel/pipeline.py): the block stack between ``encode`` and
+    ``logits`` lives as a [layers]-stacked param tree sharded over the
+    ``pipe`` mesh axis, while these (small) params stay replicated.
+    Param names match ``Transformer`` (wte/wpe/ln_f).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):  # init-time: touch every param once
+        return self.logits(self.encode(tokens))
+
+    @nn.compact
+    def encode(self, tokens):
+        cfg = self.cfg
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.02), name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.max_len, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.01), name="wpe",
+        )
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+        return wte(tokens) + wpe(positions)[None]
+
+    @nn.compact
+    def logits(self, x):
+        cfg = self.cfg
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            embedding_init=nn.initializers.normal(0.02), name="wte",
+        )
+        x = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_f")(x)
+        return wte.attend(x)
+
+
+def init_stacked_blocks(cfg: TransformerConfig, rng, *, train: bool = False):
+    """[num_layers]-stacked Block params (for the pipeline path)."""
+    block = Block(cfg, None, train, False)
+    dummy = jnp.zeros((1, cfg.max_len, cfg.d_model), jnp.float32)
+    keys = jax.random.split(rng, cfg.num_layers)
+    return jax.vmap(lambda k: block.init({"params": k}, dummy)["params"])(keys)
+
+
+def apply_stacked_blocks(cfg: TransformerConfig, params, x, *, train: bool = False):
+    """Sequentially apply a [k]-stacked Block param tree to x."""
+    block = Block(cfg, None, train, False)
+
+    def one(carry, p):
+        return block.apply({"params": p}, carry), None
+
+    y, _ = jax.lax.scan(one, x, params)
+    return y

@@ -1,0 +1,86 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP).
+
+Framework-native extension (SURVEY.md §2d notes the reference has no MoE
+workload; EP is provided as a first-class capability of the parallelism
+layer). Switch-Transformer-style top-1 routing, TPU-first:
+
+- Static shapes everywhere: tokens are routed with a fixed per-expert
+  ``capacity``; overflow tokens fall through the residual connection
+  (standard Switch behavior) — no dynamic shapes under jit.
+- Experts are the *same* FFN pytree with a leading [experts] axis. On a
+  mesh, experts shard over the ``model`` axis (EP reuses the tensor-
+  parallel axis, the common choice when EP and TP are not combined) and
+  dispatch/combine are einsums against one-hot dispatch masks — XLA
+  lowers them to all_to_all-equivalent collectives over ICI.
+- Router computes in f32 with jitter noise at train time and an
+  auxiliary load-balancing loss (mean fraction · mean prob per expert).
+
+``moe_ffn`` is pure (params in, tokens out) so it slots into flax
+modules (models/transformer.py MoeMlp) and composes with remat/scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_ffn(
+    gate_w: jax.Array,  # [d, E] router weights
+    w_in: jax.Array,    # [E, d, ff]
+    b_in: jax.Array,    # [E, ff]
+    w_out: jax.Array,   # [E, ff, d]
+    b_out: jax.Array,   # [E, d]
+    x: jax.Array,       # [B, S, d]
+    *,
+    capacity_factor: float = 1.25,
+    rng: jax.Array | None = None,
+    jitter: float = 1e-2,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-1 (Switch) MoE FFN. Returns (out [B,S,d], aux_loss scalar)."""
+    b, s, d = x.shape
+    e = gate_w.shape[-1]
+    n = b * s
+    tokens = x.reshape(n, d)
+
+    logits = (tokens.astype(jnp.float32)) @ gate_w.astype(jnp.float32)
+    if rng is not None and jitter > 0:
+        logits += jax.random.uniform(
+            rng, logits.shape, jnp.float32, -jitter, jitter
+        )
+    probs = jax.nn.softmax(logits, axis=-1)  # [n, E]
+    expert = jnp.argmax(probs, axis=-1)      # [n]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # Switch aux loss: E · Σ_e (fraction of tokens → e) · (mean prob of e).
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [n, E]
+    aux = e * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+
+    # Static-capacity dispatch: position of each token within its expert's
+    # queue; tokens past capacity are dropped (residual carries them).
+    capacity = max(1, int(capacity_factor * n / e))
+    position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot, [n, E]
+    keep = (position > 0) & (position <= capacity)
+    slot = jnp.clip(position.sum(axis=-1).astype(jnp.int32) - 1, 0, capacity - 1)
+    kept = keep.any(axis=-1)
+
+    # dispatch [n, E, C]: one-hot (expert, slot) for kept tokens.
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, None, :]
+        * kept[:, None, None]
+    )
+    # Expert inputs [E, C, d] — einsum against the mask; XLA turns this
+    # into a gather/all_to_all under sharding.
+    xin = jnp.einsum("nec,nd->ecd", dispatch, tokens.astype(jnp.float32))
+    xin = xin.astype(x.dtype)
+
+    h = jnp.einsum("ecd,edf->ecf", xin, w_in) + b_in[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    yout = jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
+
+    # Combine back with the gate value folded in.
+    combined = jnp.einsum(
+        "nec,ecd->nd", dispatch * gate[:, None, None], yout.astype(jnp.float32)
+    )
+    return combined.reshape(b, s, d).astype(x.dtype), aux

@@ -1,0 +1,121 @@
+"""Pipeline parallelism: GPipe microbatching over the ``pipe`` mesh axis.
+
+Framework-native extension (SURVEY.md §2d — the reference had no PP; the
+distributed design here treats it as a first-class mesh axis like
+dp/fsdp/tp/sp). TPU-first shape:
+
+- Stage parameters are the *same pytree* with a leading [stages] axis
+  sharded over ``pipe`` — placement is a sharding rule, not a code path,
+  exactly like tensor parallelism.
+- The schedule runs inside ``shard_map``: each device applies its own
+  stage; activations hop stage→stage with ``jax.lax.ppermute``
+  (nearest-neighbor ICI), microbatches streaming in GPipe order over
+  M + P - 1 ticks. No host round-trips, one compiled program.
+- Differentiable by construction: the backward pass is JAX's transpose
+  of the forward schedule (ppermute transposes to the reverse hop), i.e.
+  the classic reverse pipeline, with per-tick remat to keep the saved
+  state at O(M · microbatch) activations.
+
+``pipeline_apply`` is the jit-level entry; ``_gpipe_local`` is the
+per-device program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorflow_examples_tpu.core.mesh import AxisNames
+
+
+def _gpipe_local(stage_fn, params, x_mb, axis_name):
+    """Per-device GPipe schedule (runs inside shard_map).
+
+    params: this device's stage params (leading [1, ...] stage dim kept).
+    x_mb: [M, mb, ...] microbatched input, replicated over the pipe axis.
+    Returns [M, mb, ...] outputs, valid on every device (psum-broadcast).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    params = jax.tree.map(lambda p: p[0], params)  # drop the stage dim
+
+    def tick(carry, t):
+        state, out = carry
+        # Stage 0 ingests microbatch t (t < M), others take the incoming
+        # activation that arrived last tick.
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inp = jnp.where(stage == 0, x_mb[mb_idx], state)
+        y = stage_fn(params, inp)
+        # Microbatch k exits the last stage at tick k + P - 1.
+        done_idx = t - (n_stages - 1)
+        is_done = (stage == n_stages - 1) & (done_idx >= 0) & (done_idx < m)
+        out = jnp.where(
+            is_done, out.at[jnp.clip(done_idx, 0, m - 1)].set(y), out
+        )
+        # Hop the activation to the next stage (ring hop; the wraparound
+        # value into stage 0 is ignored — it re-ingests from x_mb).
+        state = lax.ppermute(y, axis_name, fwd_perm)
+        return (state, out), None
+
+    state0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+    (_, out), _ = lax.scan(
+        jax.checkpoint(tick), (state0, out0), jnp.arange(m + n_stages - 1)
+    )
+    # Only the last stage holds real outputs; broadcast to all pipe ranks
+    # so the (replicated) head/loss runs everywhere.
+    return lax.psum(
+        jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)), axis_name
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    batch_spec: P = P((AxisNames.DATA, AxisNames.FSDP)),
+) -> jax.Array:
+    """Apply a [stages]-stacked stage over ``x`` with GPipe scheduling.
+
+    stage_params: pytree with leading [stages] axis on every leaf,
+    sharded over ``pipe``. x: [batch, ...] activations. The batch is
+    split into ``num_microbatches`` along axis 0.
+    """
+    n_stages = mesh.shape[AxisNames.PIPE]
+    if n_stages == 1:
+        single = jax.tree.map(lambda p: p[0], stage_params)
+        return stage_fn(single, x)
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}"
+        )
+    x_mb = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda p: P(*((AxisNames.PIPE,) + (None,) * (p.ndim - 1))), stage_params
+    )
+    # Microbatched activations: batch dim is now axis 1.
+    act_spec = P(None, *batch_spec)
+    out = jax.shard_map(
+        lambda p, xm: _gpipe_local(stage_fn, p, xm, AxisNames.PIPE),
+        mesh=mesh,
+        in_specs=(param_specs, act_spec),
+        out_specs=act_spec,
+        check_vma=False,
+    )(
+        jax.lax.with_sharding_constraint(
+            stage_params, jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs)
+        ),
+        x_mb,
+    )
+    return out.reshape((b,) + x.shape[1:])

@@ -35,7 +35,7 @@ from tensorflow_examples_tpu.train.loop import Trainer
 def _setup(workload, default_cfg):
     logging.set_verbosity(logging.INFO)
     cfg = config_from_flags(default_cfg)
-    apply_device_flag(cfg.device)
+    apply_device_flag(cfg.device, debug_nans=cfg.debug_nans)
     distributed.initialize()
     return cfg
 

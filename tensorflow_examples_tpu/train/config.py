@@ -21,6 +21,7 @@ class TrainConfig:
     mesh_fsdp: int = 1
     mesh_model: int = 1
     mesh_context: int = 1
+    mesh_pipe: int = 1
 
     # Optimization
     global_batch_size: int = 128
@@ -45,8 +46,11 @@ class TrainConfig:
     data_dir: str = ""  # dataset location; "" → synthetic data
     resume: bool = True  # restore latest checkpoint from workdir
 
-    # Profiling
+    # Profiling / sanitizers
     profile: bool = False  # capture a profiler trace around steps 10-20
+    debug_nans: bool = False  # jax_debug_nans: fail fast at the op that
+    #   produced a NaN (SURVEY.md §5b — the functional model removes data
+    #   races by construction; NaN tracing is the remaining sanitizer)
 
     def mesh_config(self) -> MeshConfig:
         return MeshConfig(
@@ -54,6 +58,7 @@ class TrainConfig:
             fsdp=self.mesh_fsdp,
             model=self.mesh_model,
             context=self.mesh_context,
+            pipe=self.mesh_pipe,
         )
 
     def replace(self, **kw) -> "TrainConfig":
@@ -91,7 +96,7 @@ def config_from_flags(config: Any, flags_values=None) -> Any:
     return dataclasses.replace(config, **updates)
 
 
-def apply_device_flag(device: str) -> None:
+def apply_device_flag(device: str, *, debug_nans: bool = False) -> None:
     """Honor the reference's ``--device`` contract.
 
     ``--device=tpu`` is the default JAX platform selection; ``--device=cpu``
@@ -102,3 +107,5 @@ def apply_device_flag(device: str) -> None:
 
     if device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    if debug_nans:
+        jax.config.update("jax_debug_nans", True)

@@ -75,7 +75,7 @@ def make_task(cfg: BertGlueConfig, mesh=None) -> Task:
         if cfg.pretrained:
             from tensorflow_examples_tpu.models.hf_import import import_bert
 
-            _, params = import_bert(cfg.pretrained, num_labels=num_labels)
+            _, params = import_bert(cfg.pretrained)
             # Keep the fresh head if the checkpoint lacks a matching one.
             imported = jax.tree.map(jnp.asarray, params)
             if (
@@ -160,12 +160,12 @@ def make_task(cfg: BertGlueConfig, mesh=None) -> Task:
 
 
 def datasets(cfg: BertGlueConfig):
-    kw = dict(seq_len=cfg.seq_len, vocab_size=cfg.vocab_size)
     return (
-        load_glue(cfg.data_dir, cfg.task, "train", **kw),
-        load_glue(cfg.data_dir, cfg.task, "validation", **kw)
-        if _has_split(cfg, "validation")
-        else load_glue("", cfg.task, "validation", **kw),
+        load_glue(
+            cfg.data_dir, cfg.task, "train",
+            seq_len=cfg.seq_len, vocab_size=cfg.vocab_size,
+        ),
+        eval_dataset(cfg),
     )
 
 

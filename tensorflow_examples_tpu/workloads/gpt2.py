@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from tensorflow_examples_tpu.data.sources import load_lm_tokens
@@ -37,6 +38,15 @@ class Gpt2Config(TrainConfig):
     attention: str = "flash"  # flash | xla | ring | ulysses
     fused_ce: bool = True
     pretrained: str = ""  # local HF GPT2LMHeadModel path to start from
+    # Pipeline parallelism (mesh_pipe > 1): GPipe microbatching over the
+    # `pipe` axis (parallel/pipeline.py). Requires dropout == 0.
+    num_microbatches: int = 4
+    # Mixture-of-Experts: swap every `moe_every`-th block's MLP for a
+    # top-1 Switch MoE with this many experts (expert-parallel over the
+    # `model` mesh axis). 0 = dense GPT-2.
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_aux_weight: float = 0.01
 
     global_batch_size: int = 16
     train_steps: int = 20000
@@ -59,10 +69,16 @@ def model_config(cfg: Gpt2Config) -> transformer.TransformerConfig:
         dropout=cfg.dropout,
         attention=cfg.attention,
         remat=cfg.remat,
+        moe_experts=cfg.moe_experts,
+        moe_every=cfg.moe_every,
     )
 
 
 def make_task(cfg: Gpt2Config, mesh=None) -> Task:
+    from tensorflow_examples_tpu.core.mesh import AxisNames
+
+    if mesh is not None and mesh.shape[AxisNames.PIPE] > 1:
+        return _make_pipeline_task(cfg, mesh)
     model = transformer.Transformer(model_config(cfg), mesh=mesh)
 
     def init_fn(rng):
@@ -91,25 +107,32 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
     def token_nll(params, batch, *, rng, train):
         inputs = batch["tokens"][:, :-1]
         labels = batch["tokens"][:, 1:]
-        logits = model.apply(
+        out = model.apply(
             {"params": params},
             inputs,
             train=train,
             rngs={"dropout": rng} if train else None,
+            mutable=["intermediates"] if cfg.moe_experts else False,
         )
+        logits, aux = (out if cfg.moe_experts else (out, None))
         nll = cross_entropy_per_example(
             logits.reshape(-1, cfg.vocab_size),
             labels.reshape(-1),
             fused=cfg.fused_ce,
         )
-        return nll.reshape(labels.shape)
+        moe_aux = (
+            sum(jax.tree.leaves(aux["intermediates"])) if cfg.moe_experts else 0.0
+        )
+        return nll.reshape(labels.shape), moe_aux
 
     def loss_fn(params, model_state, batch, *, rng, train):
-        nll = token_nll(params, batch, rng=rng, train=train)
-        return jnp.mean(nll), {}, model_state
+        nll, moe_aux = token_nll(params, batch, rng=rng, train=train)
+        loss = jnp.mean(nll) + cfg.moe_aux_weight * moe_aux
+        metrics = {"moe_aux": moe_aux} if cfg.moe_experts else {}
+        return loss, metrics, model_state
 
     def eval_fn(params, model_state, batch):
-        nll = token_nll(params, batch, rng=None, train=False)
+        nll, _ = token_nll(params, batch, rng=None, train=False)
         per_example = jnp.mean(nll, axis=-1)
         mask = batch.get("mask")
         return {
@@ -125,6 +148,101 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
         loss_fn=loss_fn,
         make_optimizer=optimizers.adamw_cosine,
         sharding_rules=transformer.GPT2_RULES,
+        eval_fn=eval_fn,
+    )
+
+
+def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
+    """GPipe pipeline-parallel GPT-2 (mesh_pipe > 1).
+
+    The block stack lives as a [num_layers]-stacked param tree sharded
+    over ``pipe`` (rule below); embeddings/head stay replicated. The
+    GPipe schedule (parallel/pipeline.py) runs inside the same jitted
+    train step. Composes with dp/fsdp batch sharding; tp/sp belong to
+    the non-pipelined path (attention inside a stage is the plain Pallas
+    kernel). Decode/generate use the non-pipelined model.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflow_examples_tpu.core.mesh import AxisNames
+    from tensorflow_examples_tpu.core.sharding import ShardingRules
+    from tensorflow_examples_tpu.parallel.pipeline import pipeline_apply
+
+    if cfg.dropout != 0.0:
+        raise ValueError("pipeline parallelism requires --dropout=0")
+    if cfg.pretrained:
+        raise ValueError(
+            "--pretrained is not supported with --mesh_pipe>1 yet; "
+            "fine-tune on the non-pipelined path (dp/fsdp/tp/sp)"
+        )
+    n_stages = mesh.shape[AxisNames.PIPE]
+    if cfg.num_layers % n_stages:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pipe={n_stages}"
+        )
+    mcfg = model_config(cfg)
+    embed_head = transformer.EmbedHead(mcfg)
+
+    def init_fn(rng):
+        r1, r2 = jax.random.split(rng)
+        dummy = jnp.zeros((1, cfg.seq_len), jnp.int32)
+        embed = embed_head.init({"params": r1}, dummy)["params"]
+        blocks = transformer.init_stacked_blocks(mcfg, r2)
+        return {"params": {"embed": embed, "blocks": blocks}}
+
+    def logits_fn(params, tokens):
+        x = embed_head.apply(
+            {"params": params["embed"]}, tokens, method="encode"
+        )
+        per_stage = cfg.num_layers // n_stages
+        stage_params = jax.tree.map(
+            lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]),
+            params["blocks"],
+        )
+        x = pipeline_apply(
+            lambda sp, h: transformer.apply_stacked_blocks(mcfg, sp, h),
+            stage_params,
+            x,
+            mesh=mesh,
+            num_microbatches=cfg.num_microbatches,
+        )
+        return embed_head.apply(
+            {"params": params["embed"]}, x, method="logits"
+        )
+
+    def token_nll(params, batch):
+        inputs = batch["tokens"][:, :-1]
+        labels = batch["tokens"][:, 1:]
+        logits = logits_fn(params, inputs)
+        nll = cross_entropy_per_example(
+            logits.reshape(-1, cfg.vocab_size),
+            labels.reshape(-1),
+            fused=cfg.fused_ce,
+        )
+        return nll.reshape(labels.shape)
+
+    def loss_fn(params, model_state, batch, *, rng, train):
+        del rng, train  # dropout is 0 by construction
+        return jnp.mean(token_nll(params, batch)), {}, model_state
+
+    def eval_fn(params, model_state, batch):
+        per_example = jnp.mean(token_nll(params, batch), axis=-1)
+        mask = batch.get("mask")
+        return {
+            "nll": weighted_mean(per_example, mask),
+            "weight": jnp.sum(mask)
+            if mask is not None
+            else jnp.float32(per_example.shape[0]),
+        }
+
+    rules = ShardingRules([(r"^blocks/", P(AxisNames.PIPE))])
+    return Task(
+        name="gpt2_124m_pp",
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        make_optimizer=optimizers.adamw_cosine,
+        sharding_rules=rules,
         eval_fn=eval_fn,
     )
 

@@ -28,7 +28,9 @@ class TestMesh:
 
     def test_mixed_mesh(self, devices):
         mesh = create_mesh(MeshConfig(data=2, model=2, context=2))
-        assert dict(mesh.shape) == {"data": 2, "fsdp": 1, "model": 2, "context": 2}
+        assert dict(mesh.shape) == {
+            "data": 2, "fsdp": 1, "model": 2, "context": 2, "pipe": 1,
+        }
 
     def test_bad_mesh_raises(self, devices):
         with pytest.raises(ValueError):

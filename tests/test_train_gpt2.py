@@ -83,3 +83,70 @@ def test_grad_accumulation(mesh8):
     cfg = tiny_config(train_steps=8, grad_accum_steps=2)
     first, last, _ = run_tiny(cfg, mesh8)
     assert np.isfinite(last)
+
+
+def test_pipeline_parallel_matches_sequential():
+    """GPipe pipelined block stack == sequential application, fwd + grad."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.models import transformer
+    from tensorflow_examples_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    mcfg = transformer.TransformerConfig(
+        vocab_size=64, max_len=16, num_layers=4, num_heads=2, d_model=16,
+        dropout=0.0, attention="xla",
+    )
+    blocks = transformer.init_stacked_blocks(mcfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16), jnp.float32)
+
+    ref = transformer.apply_stacked_blocks(mcfg, blocks, x)
+    stage_params = jax.tree.map(
+        lambda p: p.reshape((4, 1) + p.shape[1:]), blocks
+    )
+    fn = lambda sp, h: pipeline_apply(
+        lambda p, y: transformer.apply_stacked_blocks(mcfg, p, y),
+        sp, h, mesh=mesh, num_microbatches=4,
+    )
+    out = jax.jit(fn)(stage_params, x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+    g_ref = jax.grad(lambda b: jnp.sum(
+        transformer.apply_stacked_blocks(mcfg, b, x) ** 2))(blocks)
+    g_pp = jax.jit(jax.grad(lambda sp: jnp.sum(fn(sp, x) ** 2)))(stage_params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a).reshape(np.asarray(b).shape), np.asarray(b), atol=5e-4
+        )
+
+
+def test_loss_decreases_pp():
+    """End-to-end GPipe training step through the shared loop."""
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    cfg = tiny_config(num_layers=4, train_steps=20, num_microbatches=4)
+    first, last, _ = run_tiny(cfg, mesh)
+    assert np.isfinite(first) and np.isfinite(last)
+    assert last < first - 0.1, f"no learning: {first} -> {last}"
+
+
+def test_moe_expert_parallel():
+    """Switch-MoE GPT-2: aux loss present, learns, EP-sharded on mesh."""
+    mesh = create_mesh(MeshConfig(data=2, model=4))
+    cfg = tiny_config(moe_experts=4, train_steps=25, learning_rate=2e-3)
+    task = gpt2.make_task(cfg, mesh=mesh)
+    trainer = Trainer(task, cfg, mesh=mesh)
+    train_ds, _ = gpt2.datasets(cfg)
+    it = train_iterator(train_ds, cfg.global_batch_size, seed=0)
+    losses = []
+    state = trainer.state
+    for _ in range(cfg.train_steps):
+        state, m = trainer._train_step(state, trainer._put_batch(next(it)))
+        losses.append(float(m["loss"]))
+        assert np.isfinite(float(m["moe_aux"]))
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1
+    # Expert params must actually shard over the model axis.
+    w_in = state.params["h_1"]["moe"]["w_in"]
+    spec = w_in.sharding.spec
+    assert spec and spec[0] == "model", spec

@@ -13,32 +13,67 @@ from __future__ import annotations
 import numpy as np
 
 
-def random_crop_flip(
-    images: np.ndarray, rng: np.random.Generator, *, pad: int = 4
+def _crop_flip(
+    images: np.ndarray, ys: np.ndarray, xs: np.ndarray, flips: np.ndarray, pad: int
 ) -> np.ndarray:
-    """CIFAR-standard augmentation: reflect-pad, random crop, random h-flip.
+    """Reflect-pad + per-example crop/h-flip with precomputed offsets.
 
-    images: [B, H, W, C] float. Vectorized: one gather per batch, no
-    per-image Python loop.
+    One advanced-indexing gather per batch; shared by the float path and
+    the uint8 fallback so the two cannot drift.
     """
-    b, h, w, c = images.shape
+    b, h, w, _ = images.shape
     padded = np.pad(
         images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
     )
-    ys = rng.integers(0, 2 * pad + 1, size=b)
-    xs = rng.integers(0, 2 * pad + 1, size=b)
-    # Gather crops via advanced indexing: rows [B, H, 1], cols [B, 1, W].
     row_idx = ys[:, None] + np.arange(h)[None, :]
     col_idx = xs[:, None] + np.arange(w)[None, :]
     out = padded[
         np.arange(b)[:, None, None], row_idx[:, :, None], col_idx[:, None, :]
     ]
-    flip = rng.random(b) < 0.5
-    out[flip] = out[flip, :, ::-1]
+    fl = flips.astype(bool)
+    out[fl] = out[fl, :, ::-1]
     return np.ascontiguousarray(out)
 
 
+def random_crop_flip(
+    images: np.ndarray, rng: np.random.Generator, *, pad: int = 4
+) -> np.ndarray:
+    """CIFAR-standard augmentation: reflect-pad, random crop, random h-flip."""
+    b = len(images)
+    ys = rng.integers(0, 2 * pad + 1, size=b)
+    xs = rng.integers(0, 2 * pad + 1, size=b)
+    flips = rng.random(b) < 0.5
+    return _crop_flip(images, ys, xs, flips, pad)
+
+
 def cifar_augment(batch: dict, rng: np.random.Generator) -> dict:
+    """Crop/flip a CIFAR batch; fused native path for uint8 batches.
+
+    float32 batches (synthetic / pre-normalized) take the numpy path.
+    uint8 batches (load_cifar10(normalized=False)) run pad+crop+flip+
+    normalize in one threaded C++ call (native/fastdata.cpp), with an
+    equivalent numpy fallback — determinism is identical: the rng draw
+    order (ys, xs, flips) is the same on every path.
+    """
     out = dict(batch)
-    out["image"] = random_crop_flip(batch["image"], rng, pad=4)
+    img = batch["image"]
+    if img.dtype != np.uint8:
+        out["image"] = random_crop_flip(img, rng, pad=4)
+        return out
+
+    from tensorflow_examples_tpu import native
+    from tensorflow_examples_tpu.data.sources import CIFAR10_MEAN, CIFAR10_STD
+
+    b = len(img)
+    ys = rng.integers(0, 9, size=b)
+    xs = rng.integers(0, 9, size=b)
+    flips = (rng.random(b) < 0.5).astype(np.uint8)
+    fast = native.crop_flip_normalize(
+        img, ys, xs, flips, CIFAR10_MEAN, CIFAR10_STD, pad=4
+    )
+    if fast is not None:
+        out["image"] = fast
+        return out
+    crop = _crop_flip(img.astype(np.float32) / 255.0, ys, xs, flips, pad=4)
+    out["image"] = ((crop - CIFAR10_MEAN) / CIFAR10_STD).astype(np.float32)
     return out

@@ -69,8 +69,19 @@ def load_mnist(data_dir: str = "", split: str = "train") -> InMemoryDataset:
 # ---------------------------------------------------------------- CIFAR-10
 
 
-def load_cifar10(data_dir: str = "", split: str = "train") -> InMemoryDataset:
-    """Reads the python-pickle CIFAR-10 distribution if present."""
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def load_cifar10(
+    data_dir: str = "", split: str = "train", *, normalized: bool = True
+) -> InMemoryDataset:
+    """Reads the python-pickle CIFAR-10 distribution if present.
+
+    ``normalized=False`` keeps uint8 pixels (4x smaller in memory) so the
+    train-time crop/flip/normalize can run fused in the native C++ host
+    library (data/augment.py); synthetic fallback data is always float.
+    """
     if data_dir:
         batch_dir = data_dir
         nested = os.path.join(data_dir, "cifar-10-batches-py")
@@ -97,15 +108,12 @@ def load_cifar10(data_dir: str = "", split: str = "train") -> InMemoryDataset:
             np.concatenate(xs)
             .reshape(-1, 3, 32, 32)
             .transpose(0, 2, 3, 1)
-            .astype(np.float32)
-            / 255.0
         )
-        mean = np.array([0.4914, 0.4822, 0.4465], np.float32)
-        std = np.array([0.2470, 0.2435, 0.2616], np.float32)
-        x = (x - mean) / std
-        return InMemoryDataset(
-            {"image": x, "label": np.concatenate(ys).astype(np.int32)}
-        )
+        y = np.concatenate(ys).astype(np.int32)
+        if not normalized:
+            return InMemoryDataset({"image": x.astype(np.uint8), "label": y})
+        x = (x.astype(np.float32) / 255.0 - CIFAR10_MEAN) / CIFAR10_STD
+        return InMemoryDataset({"image": x, "label": y})
     return synthetic_images(
         n=50000 if split == "train" else 10000,
         shape=(32, 32, 3),

@@ -267,7 +267,14 @@ class Transformer(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
+    def __call__(
+        self,
+        tokens,
+        *,
+        train: bool = False,
+        decode: bool = False,
+        return_hidden: bool = False,
+    ):
         cfg = self.cfg
         wte = nn.Embed(
             cfg.vocab_size, cfg.d_model,
@@ -308,6 +315,10 @@ class Transformer(nn.Module):
             x = block(cfg, self.mesh, train, decode, use_moe, name=f"h_{i}")(x)
 
         x = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_f")(x)
+        if return_hidden:
+            # Caller owns the head (e.g. the vocab-parallel fused CE in
+            # ops/cross_entropy.tp_cross_entropy_from_hidden).
+            return x
         # Tied LM head: logits = x @ wteᵀ (GPT-2 ties input/output embeds).
         return wte.attend(x)
 

@@ -189,3 +189,111 @@ def cross_entropy_loss(
     return weighted_mean(
         nll, None if weights is None else weights.reshape(-1)
     )
+
+
+# ------------------------------------------------- vocab-parallel (TP) CE
+
+
+def tp_cross_entropy_from_hidden(
+    hidden: jax.Array,   # [N, d] final hidden states (post ln_f)
+    wte: jax.Array,      # [V, d] tied embedding / LM head table
+    labels: jax.Array,   # [N] int
+    *,
+    mesh,
+    axis_name: str = "model",
+    block_v: int = 2048,
+) -> jax.Array:
+    """Per-example NLL with the vocab axis sharded over ``axis_name``.
+
+    The Megatron-style parallel LM head: each device holds a [V/m, d]
+    slice of the embedding table, computes its local logits on the MXU,
+    and only the online-softmax partials (max, sumexp, label-logit) cross
+    ICI via pmax/psum — the full [N, V] logits never exist anywhere, and
+    each device's HBM sees at most [N, V/m]. Degenerates to the fused
+    Pallas kernel when the axis is trivial.
+
+    Inside, the local [N, V/m] problem is consumed in ``block_v`` chunks
+    by a lax.scan (the XLA analogue of the Pallas kernel's vocab loop) so
+    peak memory is [N, block_v] regardless of shard width.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflow_examples_tpu.core.mesh import AxisNames
+
+    if mesh is None or mesh.shape[axis_name] == 1:
+        logits = jnp.einsum(
+            "nd,vd->nv", hidden, wte, preferred_element_type=jnp.float32
+        )
+        return cross_entropy_per_example(logits, labels)
+
+    n_shards = mesh.shape[axis_name]
+    vocab = wte.shape[0]
+    batch = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
+    bspec = P(batch if batch else None)
+
+    # Pad the vocab axis only to the shard count: when vocab % n_shards
+    # == 0 this is a no-op and the shard_map split lines up EXACTLY with
+    # the P(model, None) table sharding (no resharding collective). The
+    # inner chunking pads per-shard, locally.
+    v_local = pl.cdiv(vocab, n_shards)
+    wte_pad = jnp.pad(wte, ((0, v_local * n_shards - vocab), (0, 0)))
+    block = min(block_v, v_local)
+    num_blocks = pl.cdiv(v_local, block)
+
+    def local(hidden, wte_local, labels):
+        shard = lax.axis_index(axis_name)
+        base = shard * v_local
+        n = hidden.shape[0]
+        # Local pad so every dynamic_slice chunk is full-size; padded
+        # rows have global col >= vocab only when base + local idx maps
+        # past this shard's true rows — mask on the LOCAL index as well
+        # as the global vocab bound.
+        local_pad = num_blocks * block - v_local
+        wte_loc = jnp.pad(wte_local, ((0, local_pad), (0, 0)))
+
+        def chunk(carry, i):
+            m, l, t = carry
+            w = lax.dynamic_slice(
+                wte_loc, (i * block, 0), (block, wte_loc.shape[1])
+            )
+            s = jnp.einsum(
+                "nd,vd->nv", hidden, w, preferred_element_type=jnp.float32
+            )
+            local_idx = i * block + lax.broadcasted_iota(
+                jnp.int32, (n, block), 1
+            )
+            col = base + local_idx
+            s = jnp.where((local_idx < v_local) & (col < vocab), s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            l_new = l * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(s - m_new[:, None]), axis=1
+            )
+            t_new = t + jnp.sum(
+                jnp.where(col == labels[:, None], s, 0.0), axis=1
+            )
+            return (m_new, l_new, t_new), None
+
+        # Initial carries derived from hidden so they inherit its
+        # varying-axes type under shard_map (cf. parallel/ring.py).
+        zero = 0.0 * hidden[:, 0].astype(jnp.float32)
+        (m, l, t), _ = lax.scan(
+            chunk,
+            (zero + NEG_INF, zero, zero),
+            jnp.arange(num_blocks),
+        )
+        # Merge shards: global max, rescaled sumexp, label logit (the
+        # label lands in exactly one shard; others contribute 0). The max
+        # is a pure stabilizer — stop_gradient keeps the exact softmax
+        # gradient and sidesteps pmax's missing differentiation rule.
+        gm = lax.pmax(lax.stop_gradient(m), axis_name)
+        gl = lax.psum(l * jnp.exp(m - gm), axis_name)
+        gt = lax.psum(t, axis_name)
+        return gm + jnp.log(jnp.maximum(gl, 1e-30)) - gt
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(bspec, P(axis_name, None), bspec),
+        out_specs=bspec,
+        check_vma=False,
+    )(hidden, wte_pad, labels.astype(jnp.int32))

@@ -36,6 +36,9 @@ def _setup(workload, default_cfg):
     logging.set_verbosity(logging.INFO)
     cfg = config_from_flags(default_cfg)
     apply_device_flag(cfg.device, debug_nans=cfg.debug_nans)
+    from tensorflow_examples_tpu.utils.diagnostics import install_crash_handlers
+
+    install_crash_handlers(cfg.workdir)
     distributed.initialize()
     return cfg
 

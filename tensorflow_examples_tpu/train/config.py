@@ -51,6 +51,8 @@ class TrainConfig:
     debug_nans: bool = False  # jax_debug_nans: fail fast at the op that
     #   produced a NaN (SURVEY.md §5b — the functional model removes data
     #   races by construction; NaN tracing is the remaining sanitizer)
+    watchdog_secs: float = 600.0  # hang detector: dump all thread stacks
+    #   if no step completes for this long (0 disables; SURVEY.md §5c)
 
     def mesh_config(self) -> MeshConfig:
         return MeshConfig(

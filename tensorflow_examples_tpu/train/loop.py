@@ -227,84 +227,119 @@ class Trainer:
         num_steps = num_steps or cfg.train_steps
         start_step = int(self.state.step)
 
-        if cfg.workdir:
-            self._ckpt = CheckpointManager(cfg.workdir)
-            if cfg.resume:
-                restored = self._ckpt.restore_latest(self.state)
-                if restored is not None:
-                    self.state, start_step = restored[0], int(restored[1])
-            self._writer = _make_writer(cfg.workdir)
+        watchdog = None
+        if cfg.watchdog_secs > 0:
+            from tensorflow_examples_tpu.utils.diagnostics import Watchdog
 
-        if callable(train_data) and not hasattr(train_data, "__next__"):
-            train_iter = train_data(start_step)
-        else:
-            train_iter = train_data
-        # Async look-ahead transfer: batch N+1 streams into HBM while
-        # step N runs (the reference's prefetch-to-device equivalent).
-        train_iter = device_prefetch(train_iter, self._batch_sharding)
+            # Start paused: restore + first-step compile are legitimately
+            # slow. Detection arms at the first completed step's ping.
+            watchdog = Watchdog(cfg.watchdog_secs).start()
+            watchdog.pause()
 
-        profiling = False
-        evaluated_now = False
-        window: list[Mapping[str, jax.Array]] = []
-        last: dict[str, float] = {}
-        t_window = time.perf_counter()
-        for step in range(start_step, num_steps):
-            if cfg.profile and step == start_step + 10 and not profiling:
-                jax.profiler.start_trace(cfg.workdir or "/tmp/tpu_profile")
-                profiling = True
-            batch = next(train_iter)
-            self.state, metrics = self._train_step(self.state, batch)
-            window.append(metrics)
-            if profiling and step == start_step + 20:
-                jax.block_until_ready(self.state.params)
-                jax.profiler.stop_trace()
-                profiling = False
+        try:
+            if cfg.workdir:
+                self._ckpt = CheckpointManager(cfg.workdir)
+                if cfg.resume:
+                    restored = self._ckpt.restore_latest(self.state)
+                    if restored is not None:
+                        self.state, start_step = restored[0], int(restored[1])
+                self._writer = _make_writer(cfg.workdir)
 
-            if (cfg.log_every and (step + 1) % cfg.log_every == 0) or (
-                step + 1 == num_steps
-            ):
-                jax.block_until_ready(metrics)
-                dt = time.perf_counter() - t_window
-                last = {
-                    k: float(np.mean([float(m[k]) for m in window]))
-                    for k in window[0]
-                }
-                steps_done = len(window)
-                last["steps_per_sec"] = steps_done / dt
-                last["examples_per_sec"] = (
-                    steps_done * cfg.global_batch_size / dt
-                )
-                window.clear()
-                t_window = time.perf_counter()
-                _log_metrics(self._writer, step + 1, last, prefix="train")
+            if callable(train_data) and not hasattr(train_data, "__next__"):
+                train_iter = train_data(start_step)
+            else:
+                train_iter = train_data
+            # Async look-ahead transfer: batch N+1 streams into HBM while
+            # step N runs (the reference's prefetch-to-device equivalent).
+            train_iter = device_prefetch(train_iter, self._batch_sharding)
 
+            profiling = False
             evaluated_now = False
-            if cfg.eval_every and (step + 1) % cfg.eval_every == 0 and eval_iter_fn:
-                eval_metrics = self.evaluate(eval_iter_fn())
-                _log_metrics(self._writer, step + 1, eval_metrics, prefix="eval")
-                evaluated_now = step + 1 == num_steps
-                if evaluated_now:
-                    last.update({f"eval_{k}": v for k, v in eval_metrics.items()})
+            window: list[Mapping[str, jax.Array]] = []
+            last: dict[str, float] = {}
+            t_window = time.perf_counter()
+            for step in range(start_step, num_steps):
+                if cfg.profile and step == start_step + 10 and not profiling:
+                    jax.profiler.start_trace(cfg.workdir or "/tmp/tpu_profile")
+                    profiling = True
+                batch = next(train_iter)
+                self.state, metrics = self._train_step(self.state, batch)
+                if watchdog is not None:
+                    # Dispatch is async; sync points (log flushes) bound
+                    # how stale this is — good enough for hang detection.
+                    watchdog.resume()
+                    watchdog.ping(step)
+                window.append(metrics)
+                if profiling and step == start_step + 20:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
 
-            if (
-                self._ckpt
-                and cfg.checkpoint_every
-                and (step + 1) % cfg.checkpoint_every == 0
-            ):
-                self._ckpt.save(step + 1, self.state)
+                if (cfg.log_every and (step + 1) % cfg.log_every == 0) or (
+                    step + 1 == num_steps
+                ):
+                    jax.block_until_ready(metrics)
+                    dt = time.perf_counter() - t_window
+                    last = {
+                        k: float(np.mean([float(m[k]) for m in window]))
+                        for k in window[0]
+                    }
+                    steps_done = len(window)
+                    last["steps_per_sec"] = steps_done / dt
+                    last["examples_per_sec"] = (
+                        steps_done * cfg.global_batch_size / dt
+                    )
+                    window.clear()
+                    t_window = time.perf_counter()
+                    _log_metrics(self._writer, step + 1, last, prefix="train")
 
-        if profiling:
-            jax.profiler.stop_trace()
-        if eval_iter_fn is not None and not evaluated_now:
-            last.update(
-                {f"eval_{k}": v for k, v in self.evaluate(eval_iter_fn()).items()}
-            )
-        if self._ckpt:
-            self._ckpt.save(num_steps, self.state)
-            self._ckpt.close()
-        if self._writer:
-            self._writer.flush()
-        return last
+                evaluated_now = False
+                if (
+                    cfg.eval_every
+                    and (step + 1) % cfg.eval_every == 0
+                    and eval_iter_fn
+                ):
+                    if watchdog is not None:
+                        watchdog.pause()  # eval length ≠ step cadence
+                    eval_metrics = self.evaluate(eval_iter_fn())
+                    if watchdog is not None:
+                        watchdog.resume()
+                    _log_metrics(
+                        self._writer, step + 1, eval_metrics, prefix="eval"
+                    )
+                    evaluated_now = step + 1 == num_steps
+                    if evaluated_now:
+                        last.update(
+                            {f"eval_{k}": v for k, v in eval_metrics.items()}
+                        )
+
+                if (
+                    self._ckpt
+                    and cfg.checkpoint_every
+                    and (step + 1) % cfg.checkpoint_every == 0
+                ):
+                    self._ckpt.save(step + 1, self.state)
+
+            if profiling:
+                jax.profiler.stop_trace()
+            if watchdog is not None:
+                watchdog.pause()  # final eval + checkpoint close
+            if eval_iter_fn is not None and not evaluated_now:
+                last.update(
+                    {
+                        f"eval_{k}": v
+                        for k, v in self.evaluate(eval_iter_fn()).items()
+                    }
+                )
+            if self._ckpt:
+                self._ckpt.save(num_steps, self.state)
+                self._ckpt.close()
+            if self._writer:
+                self._writer.flush()
+            return last
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
 
     def evaluate(self, eval_iter: Iterable) -> dict[str, float]:
         """Metric-accumulating eval pass (SURVEY.md §3(3))."""

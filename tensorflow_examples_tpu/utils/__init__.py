@@ -1,0 +1,8 @@
+"""Aux utilities: failure detection, crash diagnostics (SURVEY.md §5c)."""
+
+from tensorflow_examples_tpu.utils.diagnostics import (
+    Watchdog,
+    install_crash_handlers,
+)
+
+__all__ = ["Watchdog", "install_crash_handlers"]

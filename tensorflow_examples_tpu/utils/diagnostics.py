@@ -1,0 +1,130 @@
+"""Failure detection + crash diagnostics (SURVEY.md §5c).
+
+The reference had nothing beyond checkpoint-restart; TPU-native failure
+handling here is three layers:
+
+1. **Crash handlers** (``install_crash_handlers``): faulthandler tracebacks
+   for hard faults (SIGSEGV/SIGABRT — e.g. a dying PJRT plugin) written to
+   ``workdir/debugging/``, plus ``cloud_tpu_diagnostics`` integration when
+   that package is importable (TPU-side stack traces on Cloud TPU VMs).
+2. **Hang watchdog** (``Watchdog``): a daemon thread the training loop
+   pings every step. If no progress for ``timeout_s`` (device hang, stuck
+   collective, wedged host↔TPU tunnel), it dumps every Python thread's
+   stack — turning a silent hang into a diagnosable event. Detection
+   only: it never kills the run (a pod-slice restart is the operator's /
+   scheduler's call).
+3. **Recovery** is checkpoint-resume, which the shared loop already does
+   (orbax latest-checkpoint restore + stateless-resumable input order).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+
+_fault_file = None  # singleton: faulthandler holds exactly one target
+
+
+def install_crash_handlers(workdir: str = "") -> None:
+    """Route hard-fault (SIGSEGV/SIGABRT/…) tracebacks somewhere durable.
+
+    With ``workdir``: to ``workdir/debugging/faults_<pid>.log`` (the path
+    is logged so operators know where to look — faulthandler writes to a
+    single target, so the file supersedes stderr). Without: to stderr.
+    Idempotent; repeated calls reuse the open file.
+    """
+    global _fault_file
+    if workdir:
+        debug_dir = os.path.join(workdir, "debugging")
+        os.makedirs(debug_dir, exist_ok=True)
+        path = os.path.join(debug_dir, f"faults_{os.getpid()}.log")
+        if _fault_file is None or _fault_file.name != path:
+            if _fault_file is not None:
+                _fault_file.close()
+            _fault_file = open(path, "w")  # noqa: SIM115 - outlives the call
+        faulthandler.enable(file=_fault_file)
+        log.info("hard-fault tracebacks -> %s", path)
+    else:
+        faulthandler.enable()
+    try:  # TPU-side stack traces on Cloud TPU VMs (optional dependency)
+        import cloud_tpu_diagnostics  # noqa: F401
+
+        log.info("cloud_tpu_diagnostics available for TPU-side traces")
+    except ImportError:
+        pass
+
+
+class Watchdog:
+    """Detects training-loop hangs; dumps all thread stacks once per hang.
+
+    >>> wd = Watchdog(timeout_s=600); wd.start()
+    >>> for step ...: wd.ping(step)
+    >>> wd.stop()
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        on_hang: Callable[[int, float], None] | None = None,
+        poll_s: float | None = None,
+    ):
+        self.timeout_s = timeout_s
+        self._on_hang = on_hang
+        self._poll_s = poll_s if poll_s is not None else min(timeout_s / 4, 30.0)
+        self._last_ping = time.monotonic()
+        self._last_step = -1
+        self._paused = False
+        self._fired_for = -2  # last step a hang was reported for
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "Watchdog":
+        self._thread = threading.Thread(
+            target=self._run, name="train-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def ping(self, step: int) -> None:
+        self._last_ping = time.monotonic()
+        self._last_step = step
+
+    def pause(self) -> None:
+        """Suspend hang detection (long known-slow phase: eval, ckpt,
+        first-step compile). Timer restarts on the next ping/resume."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+        self._last_ping = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            if self._paused:
+                continue
+            stalled = time.monotonic() - self._last_ping
+            if stalled >= self.timeout_s and self._fired_for != self._last_step:
+                self._fired_for = self._last_step
+                log.error(
+                    "WATCHDOG: no training progress for %.0fs (last step %d) "
+                    "— dumping all thread stacks",
+                    stalled,
+                    self._last_step,
+                )
+                faulthandler.dump_traceback(file=sys.stderr)
+                if self._on_hang is not None:
+                    self._on_hang(self._last_step, stalled)

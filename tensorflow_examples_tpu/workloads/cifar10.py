@@ -75,7 +75,10 @@ def make_task(cfg: Cifar10Config, mesh=None) -> Task:
 
 
 def datasets(cfg: Cifar10Config):
-    return load_cifar10(cfg.data_dir, "train"), load_cifar10(cfg.data_dir, "test")
+    # Real data keeps uint8 pixels on the train split so augmentation
+    # (pad/crop/flip/normalize) runs fused in the C++ host library.
+    train = load_cifar10(cfg.data_dir, "train", normalized=not cfg.augment)
+    return train, load_cifar10(cfg.data_dir, "test")
 
 
 def eval_dataset(cfg: Cifar10Config):

@@ -47,6 +47,10 @@ class Gpt2Config(TrainConfig):
     moe_experts: int = 0
     moe_every: int = 2
     moe_aux_weight: float = 0.01
+    # Vocab-parallel LM head + fused CE over the `model` axis (Megatron
+    # parallel cross-entropy): the [tokens, 50257] logits never exist;
+    # each shard holds [tokens, V/m]. Requires mesh_model > 1.
+    tp_vocab: bool = False
 
     global_batch_size: int = 16
     train_steps: int = 20000
@@ -104,6 +108,12 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
             variables["params"] = jax.tree.map(jnp.asarray, params)
         return variables
 
+    from tensorflow_examples_tpu.core.mesh import AxisNames as _A
+
+    tp_vocab = (
+        cfg.tp_vocab and mesh is not None and mesh.shape[_A.MODEL] > 1
+    )
+
     def token_nll(params, batch, *, rng, train):
         inputs = batch["tokens"][:, :-1]
         labels = batch["tokens"][:, 1:]
@@ -111,15 +121,28 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
             {"params": params},
             inputs,
             train=train,
+            return_hidden=tp_vocab,
             rngs={"dropout": rng} if train else None,
             mutable=["intermediates"] if cfg.moe_experts else False,
         )
-        logits, aux = (out if cfg.moe_experts else (out, None))
-        nll = cross_entropy_per_example(
-            logits.reshape(-1, cfg.vocab_size),
-            labels.reshape(-1),
-            fused=cfg.fused_ce,
-        )
+        hidden_or_logits, aux = (out if cfg.moe_experts else (out, None))
+        if tp_vocab:
+            from tensorflow_examples_tpu.ops.cross_entropy import (
+                tp_cross_entropy_from_hidden,
+            )
+
+            nll = tp_cross_entropy_from_hidden(
+                hidden_or_logits.reshape(-1, cfg.d_model),
+                params["wte"]["embedding"],
+                labels.reshape(-1),
+                mesh=mesh,
+            )
+        else:
+            nll = cross_entropy_per_example(
+                hidden_or_logits.reshape(-1, cfg.vocab_size),
+                labels.reshape(-1),
+                fused=cfg.fused_ce,
+            )
         moe_aux = (
             sum(jax.tree.leaves(aux["intermediates"])) if cfg.moe_experts else 0.0
         )
@@ -142,12 +165,23 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
             ),
         }
 
+    rules = transformer.GPT2_RULES
+    if tp_vocab and cfg.vocab_size % mesh.shape[_A.MODEL] == 0:
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflow_examples_tpu.core.sharding import ShardingRules
+
+        # Vocab-shard the tied table (first match wins → prepend). Only
+        # when the vocab divides evenly — jit param shardings must be
+        # exact; the parallel CE itself pads, so uneven vocabs still run
+        # tp_vocab with a replicated table.
+        rules = ShardingRules([(r"wte/embedding", P(_A.MODEL, None))]) + rules
     return Task(
         name="gpt2_124m",
         init_fn=init_fn,
         loss_fn=loss_fn,
         make_optimizer=optimizers.adamw_cosine,
-        sharding_rules=transformer.GPT2_RULES,
+        sharding_rules=rules,
         eval_fn=eval_fn,
     )
 

@@ -97,3 +97,52 @@ class TestRng:
         a2 = named_rngs(step_rng(key, jnp.int32(3)))
         assert not np.array_equal(a["dropout"], b["dropout"])
         np.testing.assert_array_equal(a["dropout"], a2["dropout"])
+
+
+class TestDiagnostics:
+    def test_watchdog_fires_on_hang(self):
+        import threading
+
+        from tensorflow_examples_tpu.utils.diagnostics import Watchdog
+
+        fired = threading.Event()
+        wd = Watchdog(
+            timeout_s=0.2,
+            on_hang=lambda step, stalled: fired.set(),
+            poll_s=0.05,
+        ).start()
+        try:
+            wd.ping(0)
+            assert fired.wait(timeout=2.0), "watchdog did not fire on hang"
+        finally:
+            wd.stop()
+
+    def test_watchdog_quiet_when_pinged(self):
+        import threading
+        import time
+
+        from tensorflow_examples_tpu.utils.diagnostics import Watchdog
+
+        fired = threading.Event()
+        wd = Watchdog(
+            timeout_s=0.5,
+            on_hang=lambda step, stalled: fired.set(),
+            poll_s=0.05,
+        ).start()
+        try:
+            for i in range(10):
+                wd.ping(i)
+                time.sleep(0.05)
+            assert not fired.is_set()
+        finally:
+            wd.stop()
+
+    def test_install_crash_handlers(self, tmp_path):
+        import os
+
+        from tensorflow_examples_tpu.utils.diagnostics import (
+            install_crash_handlers,
+        )
+
+        install_crash_handlers(str(tmp_path))
+        assert os.path.isdir(tmp_path / "debugging")

@@ -77,3 +77,29 @@ def test_ffi_cross_entropy_matches_reference():
     # And it must compose under jit.
     jit_nll, _ = jax.jit(native.ffi_cross_entropy)(logits, labels)
     np.testing.assert_allclose(np.asarray(jit_nll), np.asarray(ref), atol=1e-5)
+
+
+def test_cifar_augment_u8_matches_fallback():
+    """Native fused CIFAR augment == numpy fallback, same rng."""
+    from tensorflow_examples_tpu.data import augment
+
+    rng_img = np.random.default_rng(5)
+    batch = {
+        "image": rng_img.integers(0, 255, (8, 32, 32, 3), np.uint8),
+        "label": rng_img.integers(0, 10, 8, dtype=np.int32),
+    }
+    out_native = augment.cifar_augment(dict(batch), np.random.default_rng(9))
+
+    # Force the numpy fallback by hiding the library.
+    import tensorflow_examples_tpu.native as native_mod
+
+    orig = native_mod.crop_flip_normalize
+    native_mod.crop_flip_normalize = lambda *a, **k: None
+    try:
+        out_np = augment.cifar_augment(dict(batch), np.random.default_rng(9))
+    finally:
+        native_mod.crop_flip_normalize = orig
+    assert out_native["image"].dtype == np.float32
+    np.testing.assert_allclose(
+        out_native["image"], out_np["image"], atol=1e-5
+    )

@@ -65,15 +65,16 @@ def cifar_augment(batch: dict, rng: np.random.Generator) -> dict:
     from tensorflow_examples_tpu.data.sources import CIFAR10_MEAN, CIFAR10_STD
 
     b = len(img)
-    ys = rng.integers(0, 9, size=b)
-    xs = rng.integers(0, 9, size=b)
+    pad = 4
+    ys = rng.integers(0, 2 * pad + 1, size=b)
+    xs = rng.integers(0, 2 * pad + 1, size=b)
     flips = (rng.random(b) < 0.5).astype(np.uint8)
     fast = native.crop_flip_normalize(
-        img, ys, xs, flips, CIFAR10_MEAN, CIFAR10_STD, pad=4
+        img, ys, xs, flips, CIFAR10_MEAN, CIFAR10_STD, pad=pad
     )
     if fast is not None:
         out["image"] = fast
         return out
-    crop = _crop_flip(img.astype(np.float32) / 255.0, ys, xs, flips, pad=4)
+    crop = _crop_flip(img.astype(np.float32) / 255.0, ys, xs, flips, pad=pad)
     out["image"] = ((crop - CIFAR10_MEAN) / CIFAR10_STD).astype(np.float32)
     return out

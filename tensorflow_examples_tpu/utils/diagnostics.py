@@ -104,8 +104,10 @@ class Watchdog:
         self._paused = True
 
     def resume(self) -> None:
-        self._paused = False
+        # Refresh the ping BEFORE unpausing: the watcher thread must
+        # never see unpaused state with a stale timestamp.
         self._last_ping = time.monotonic()
+        self._paused = False
 
     def stop(self) -> None:
         self._stop.set()
@@ -126,5 +128,10 @@ class Watchdog:
                     self._last_step,
                 )
                 faulthandler.dump_traceback(file=sys.stderr)
+                if _fault_file is not None:
+                    # Also into the durable fault log (stderr may not be
+                    # captured on managed VMs — the motivating scenario).
+                    faulthandler.dump_traceback(file=_fault_file)
+                    _fault_file.flush()
                 if self._on_hang is not None:
                     self._on_hang(self._last_step, stalled)

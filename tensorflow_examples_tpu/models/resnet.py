@@ -158,3 +158,31 @@ def resnet50(num_classes: int = 1000) -> ResNet:
         num_filters=64,
         stem="imagenet",
     )
+
+
+def resnet18(num_classes: int = 1000) -> ResNet:
+    return ResNet(
+        stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
+        num_classes=num_classes, num_filters=64, stem="imagenet",
+    )
+
+
+def resnet34(num_classes: int = 1000) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock,
+        num_classes=num_classes, num_filters=64, stem="imagenet",
+    )
+
+
+def resnet101(num_classes: int = 1000) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock,
+        num_classes=num_classes, num_filters=64, stem="imagenet",
+    )
+
+
+def resnet152(num_classes: int = 1000) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock,
+        num_classes=num_classes, num_filters=64, stem="imagenet",
+    )

@@ -34,6 +34,8 @@ class TrainConfig:
     grad_accum_steps: int = 1
     precision: str = "bf16"  # f32 | bf16 | bf16_full
     remat: bool = False  # jax.checkpoint the model apply
+    zero1: bool = False  # shard optimizer state over the batch axes even
+    #   for replicated params (ZeRO-1 / weight-update sharding)
 
     # Loop cadence
     log_every: int = 100

@@ -119,12 +119,49 @@ class Trainer:
 
         jax.tree_util.tree_map_with_path(record, abstract_state.params, param_sh)
 
+        # ZeRO-1 (--zero1): shard optimizer moments over the batch axes
+        # even where the PARAM stays replicated (pure DP) — the
+        # weight-update sharding of arXiv:2004.13336. XLA then emits
+        # reduce-scatter(grads) → sharded moment update → all-gather of
+        # the applied update instead of replicating Adam state per chip.
+        from tensorflow_examples_tpu.core.mesh import AxisNames
+
+        batch_axes = tuple(
+            a for a in AxisNames.BATCH_AXES if self.mesh.shape[a] > 1
+        )
+        n_batch = int(np.prod([self.mesh.shape[a] for a in batch_axes] or [1]))
+        zero1 = getattr(self.config, "zero1", False) and n_batch > 1
+        z1_stats = {"sharded": 0, "total": 0}
+
+        def _zero1_spec(shape) -> NamedSharding | None:
+            """Shard the largest evenly-divisible dim over the batch axes
+            (dim 0 is often tiny — e.g. conv kernel height)."""
+            best = max(
+                (d for d in range(len(shape)) if shape[d] % n_batch == 0),
+                key=lambda d: shape[d],
+                default=None,
+            )
+            if best is None or shape[best] < n_batch:
+                return None
+            spec = [None] * len(shape)
+            spec[best] = batch_axes
+            return NamedSharding(self.mesh, P(*spec))
+
         def opt_sharding(path, leaf):
             parts = _path_str(path).split("/")
             for i in range(len(parts)):
                 entry = param_map.get("/".join(parts[i:]))
                 if entry is not None and getattr(leaf, "shape", None) == entry[0]:
-                    return entry[1]
+                    shape, sh = entry
+                    # Replicated == every spec entry None (P() and its
+                    # filtered P(None, ...) forms compare unequal).
+                    if zero1 and all(a is None for a in sh.spec) and shape:
+                        z1_stats["total"] += int(np.prod(shape))
+                        z1 = _zero1_spec(shape)
+                        if z1 is not None:
+                            z1_stats["sharded"] += int(np.prod(shape))
+                            return z1
+                    return sh
             return replicated
 
         opt_sh = jax.tree_util.tree_map_with_path(

@@ -14,31 +14,41 @@ import optax
 from tensorflow_examples_tpu.train.config import TrainConfig
 
 
+def _updates(cfg: TrainConfig, steps: int) -> int:
+    """Convert a micro-step count to optimizer-update count.
+
+    Schedules live inside the optax chain, which under ``MultiSteps``
+    ticks once per APPLIED update (every grad_accum_steps micro-steps) —
+    so config horizons, given in loop steps, are rescaled here."""
+    return max(steps // max(cfg.grad_accum_steps, 1), 1)
+
+
 def warmup_cosine(cfg: TrainConfig, *, end_value: float = 0.0) -> optax.Schedule:
-    warmup = max(cfg.warmup_steps, 1)
+    warmup = _updates(cfg, max(cfg.warmup_steps, 1))
     return optax.warmup_cosine_decay_schedule(
         init_value=0.0,
         peak_value=cfg.learning_rate,
         warmup_steps=warmup,
         # decay_steps includes warmup; keep the cosine span positive even
         # for short smoke runs where train_steps < warmup_steps.
-        decay_steps=max(cfg.train_steps, warmup + 1, 2),
+        decay_steps=max(_updates(cfg, cfg.train_steps), warmup + 1, 2),
         end_value=end_value,
     )
 
 
 def warmup_linear(cfg: TrainConfig) -> optax.Schedule:
     """BERT fine-tune schedule: linear warmup then linear decay to 0."""
+    warmup = _updates(cfg, max(cfg.warmup_steps, 1))
     return optax.join_schedules(
         [
-            optax.linear_schedule(0.0, cfg.learning_rate, max(cfg.warmup_steps, 1)),
+            optax.linear_schedule(0.0, cfg.learning_rate, warmup),
             optax.linear_schedule(
                 cfg.learning_rate,
                 0.0,
-                max(cfg.train_steps - cfg.warmup_steps, 1),
+                max(_updates(cfg, cfg.train_steps) - warmup, 1),
             ),
         ],
-        boundaries=[max(cfg.warmup_steps, 1)],
+        boundaries=[warmup],
     )
 
 

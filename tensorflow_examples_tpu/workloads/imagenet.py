@@ -15,7 +15,7 @@ import dataclasses
 
 from tensorflow_examples_tpu.core.sharding import REPLICATED
 from tensorflow_examples_tpu.data import imagenet as imagenet_data
-from tensorflow_examples_tpu.models.resnet import resnet50
+from tensorflow_examples_tpu.models import resnet
 from tensorflow_examples_tpu.ops.losses import accuracy_metrics, softmax_cross_entropy
 from tensorflow_examples_tpu.train import Task, TrainConfig
 from tensorflow_examples_tpu.train import optimizers
@@ -27,6 +27,7 @@ class ImagenetConfig(TrainConfig):
     # 5-epoch warmup, wd 1e-4, label smoothing 0.1.
     image_size: int = 224
     num_classes: int = 1000
+    model: str = "resnet50"  # resnet18|34|50|101|152
     label_smoothing: float = 0.1
     optimizer: str = "sgd"  # sgd | lars (large-batch)
     global_batch_size: int = 1024
@@ -40,7 +41,12 @@ class ImagenetConfig(TrainConfig):
 
 
 def make_task(cfg: ImagenetConfig, mesh=None) -> Task:
-    model = resnet50(num_classes=cfg.num_classes)
+    builder = getattr(resnet, cfg.model, None)
+    if builder is None:
+        raise ValueError(
+            f"unknown --model={cfg.model}; one of resnet18/34/50/101/152"
+        )
+    model = builder(num_classes=cfg.num_classes)
 
     def init_fn(rng):
         import jax.numpy as jnp
@@ -72,7 +78,7 @@ def make_task(cfg: ImagenetConfig, mesh=None) -> Task:
         return m
 
     return Task(
-        name="imagenet_resnet50",
+        name=f"imagenet_{cfg.model}",
         init_fn=init_fn,
         loss_fn=loss_fn,
         make_optimizer=(

@@ -146,3 +146,24 @@ class TestDiagnostics:
 
         install_crash_handlers(str(tmp_path))
         assert os.path.isdir(tmp_path / "debugging")
+
+
+class TestSchedules:
+    def test_grad_accum_rescales_schedule(self):
+        """With k-step accumulation the cosine must span train_steps/k
+        optimizer updates, reaching end_value at the run's true end."""
+        from tensorflow_examples_tpu.train.config import TrainConfig
+        from tensorflow_examples_tpu.train.optimizers import warmup_cosine
+
+        cfg = TrainConfig(
+            train_steps=1000, warmup_steps=100, learning_rate=1.0,
+            grad_accum_steps=4,
+        )
+        sched = warmup_cosine(cfg)
+        # 1000 micro-steps = 250 updates; update 250 is the end.
+        assert float(sched(250)) < 1e-6
+        assert float(sched(25)) == pytest.approx(1.0)  # end of warmup
+        # Without accumulation the same horizon is in raw steps.
+        sched1 = warmup_cosine(cfg.replace(grad_accum_steps=1))
+        assert float(sched1(1000)) < 1e-6
+        assert float(sched1(100)) == pytest.approx(1.0)

@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 
@@ -102,3 +103,29 @@ class TestAugment:
         out = random_crop_flip(x, np.random.default_rng(0))
         # Reflect-pad of a constant image is constant → crops identical.
         assert np.allclose(out, 1.0)
+
+
+def test_resnet_family_param_counts():
+    """Canonical torchvision parameter counts certify the architectures."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.models import resnet
+
+    expected = {
+        resnet.resnet18: 11_689_512,
+        resnet.resnet34: 21_797_672,
+        resnet.resnet50: 25_557_032,
+        resnet.resnet101: 44_549_160,
+        resnet.resnet152: 60_192_808,
+    }
+    for builder, want in expected.items():
+        model = builder(num_classes=1000)
+        shapes = jax.eval_shape(
+            lambda r, m=model: m.init(
+                {"params": r}, jnp.zeros((1, 224, 224, 3), jnp.float32)
+            ),
+            jax.random.PRNGKey(0),
+        )["params"]
+        n = sum(np.prod(s.shape) for s in jax.tree.leaves(shapes))
+        assert n == want, f"{builder.__name__}: {n} != {want}"

@@ -109,3 +109,38 @@ def _leaves(tree):
     import jax
 
     return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def test_zero1_matches_dp(mesh8):
+    """ZeRO-1 sharded optimizer state must not change the math."""
+    import jax
+
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.data.sources import synthetic_images
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import mnist
+
+    def run(zero1):
+        cfg = mnist.MnistConfig(
+            global_batch_size=16, train_steps=5, hidden=64, num_layers=2,
+            precision="f32", dropout=0.0, log_every=10**9,
+            checkpoint_every=0, zero1=zero1, watchdog_secs=0,
+        )
+        trainer = Trainer(mnist.make_task(cfg), cfg, mesh=mesh8)
+        ds = synthetic_images(n=256, shape=(28, 28, 1), num_classes=10, seed=0)
+        it = train_iterator(ds, cfg.global_batch_size, seed=0)
+        state, losses = trainer.state, []
+        for _ in range(cfg.train_steps):
+            state, m = trainer._train_step(state, trainer._put_batch(next(it)))
+            losses.append(float(m["loss"]))
+        return losses, state
+
+    losses_dp, _ = run(zero1=False)
+    losses_z1, state = run(zero1=True)
+    np.testing.assert_allclose(losses_dp, losses_z1, rtol=1e-6)
+    # Moments must actually be sharded over the data axis.
+    mu = jax.tree.leaves(
+        state.opt_state, is_leaf=lambda x: hasattr(x, "sharding")
+    )
+    specs = [x.sharding.spec for x in mu if hasattr(x, "ndim") and x.ndim >= 2]
+    assert any("data" in str(s) for s in specs), specs

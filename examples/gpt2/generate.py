@@ -4,9 +4,11 @@
     python examples/gpt2/generate.py --workdir=/path/to/run \
         --num_tokens=64 --temperature=0.8 --top_k=40
 
-Decodes through the static-shape KV cache (models/transformer.py). With
-byte-level corpora (vocab_size=256) --prompt is interpreted as text;
-otherwise supply comma-separated token ids via --prompt_ids.
+Decodes through the static-shape KV cache (models/transformer.py).
+--prompt is text when a BPE vocab is available (--vocab_dir, or
+vocab.json/merges.txt in --data_dir as written by tools/prepare_lm.py)
+or with byte-level corpora (vocab_size=256); otherwise supply
+comma-separated token ids via --prompt_ids.
 """
 
 import os
@@ -28,9 +30,20 @@ define_flags_from_config(gpt2.Gpt2Config())
 flags.DEFINE_integer("num_tokens", 64, "tokens to sample")
 flags.DEFINE_float("temperature", 0.8, "0 = greedy")
 flags.DEFINE_integer("top_k", 40, "0 disables top-k filtering")
-flags.DEFINE_string("prompt", "The ", "text prompt (byte-level vocab)")
+flags.DEFINE_string("prompt", "The ", "text prompt")
 flags.DEFINE_string("prompt_ids", "", "comma-separated token ids")
+flags.DEFINE_string("vocab_dir", "", "dir with vocab.json+merges.txt")
 FLAGS = flags.FLAGS
+
+
+def _load_tokenizer(cfg):
+    """BPE tokenizer from --vocab_dir or --data_dir, if vendored there."""
+    from tensorflow_examples_tpu.data.tokenizers import ByteLevelBPE
+
+    for d in (FLAGS.vocab_dir, cfg.data_dir):
+        if d and os.path.exists(os.path.join(d, "vocab.json")):
+            return ByteLevelBPE.from_dir(d)
+    return None
 
 
 def main(argv):
@@ -50,8 +63,11 @@ def main(argv):
         raise SystemExit(f"no checkpoint under {cfg.workdir}")
     params = jax.tree.map(jnp.asarray, restored[0].params)
 
+    tokenizer = _load_tokenizer(cfg)
     if FLAGS.prompt_ids:
         ids = [int(t) for t in FLAGS.prompt_ids.split(",")]
+    elif tokenizer is not None:
+        ids = tokenizer.encode(FLAGS.prompt)
     else:
         ids = list(FLAGS.prompt.encode())
     prompt = np.asarray([ids], np.int32)
@@ -68,7 +84,9 @@ def main(argv):
     )
     toks = np.asarray(out[0])
     print("token ids:", toks.tolist())
-    if cfg.vocab_size <= 256:
+    if tokenizer is not None:
+        print(tokenizer.decode(toks))
+    elif cfg.vocab_size <= 256:
         print(bytes(np.clip(toks, 0, 255).astype(np.uint8)).decode(errors="replace"))
 
 

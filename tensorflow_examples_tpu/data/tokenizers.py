@@ -1,0 +1,450 @@
+"""Tokenizers: GPT-2 byte-level BPE and BERT WordPiece, pure Python.
+
+The reference's text workloads leaned on external tokenizer assets:
+"BPE tokenizer use" for GPT-2 and "tokenizer/feature conversion" for
+BERT-GLUE (SURVEY.md §2a rows 4–5). This hermetic image has zero egress,
+so both tokenizers here are fully offline:
+
+- they load the standard on-disk formats (``vocab.json`` + ``merges.txt``
+  for byte-level BPE; one-token-per-line ``vocab.txt`` for WordPiece),
+  byte-compatible with the published GPT-2/BERT assets when vendored; and
+- each ships an in-repo trainer/builder so a working vocabulary can be
+  produced from any local corpus (``tools/prepare_lm.py`` /
+  ``tools/prepare_glue.py`` drive these).
+
+Encoding is host-side preprocessing (it feeds the ``.bin``/``.npz``
+formats in data/sources.py); nothing here touches jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import unicodedata
+
+import regex  # GPT-2's pre-tokenization pattern needs \p{L}/\p{N} classes
+
+# GPT-2's pre-tokenizer: contractions, letter runs, number runs, other
+# symbols, and whitespace (trailing-space lookahead keeps " word" units).
+_GPT2_SPLIT = regex.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+)
+
+END_OF_TEXT = "<|endoftext|>"
+
+
+def bytes_to_unicode() -> dict[int, str]:
+    """Reversible map from the 256 byte values to printable unicode chars.
+
+    Byte-level BPE needs every byte representable as a distinct visible
+    character in vocab/merges files; bytes that are already printable map
+    to themselves, the rest are offset into the U+0100 range.
+    """
+    printable = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    chars = printable[:]
+    n = 0
+    for b in range(256):
+        if b not in printable:
+            printable.append(b)
+            chars.append(256 + n)
+            n += 1
+    return dict(zip(printable, map(chr, chars)))
+
+
+_BYTE_ENCODER = bytes_to_unicode()
+_BYTE_DECODER = {c: b for b, c in _BYTE_ENCODER.items()}
+
+
+def _word_to_symbols(word_bytes: bytes) -> tuple[str, ...]:
+    return tuple(_BYTE_ENCODER[b] for b in word_bytes)
+
+
+def _get_pairs(symbols: tuple[str, ...]) -> set[tuple[str, str]]:
+    return set(zip(symbols, symbols[1:]))
+
+
+class ByteLevelBPE:
+    """GPT-2-style byte-level BPE: encode/decode any text, losslessly.
+
+    ``encoder`` maps merged byte-symbol strings → ids; ``merges`` is the
+    ordered merge list (rank = priority). The special ``<|endoftext|>``
+    token, when present in the vocab, is never produced by encode() on
+    plain text and is emitted explicitly as a document separator.
+    """
+
+    def __init__(self, encoder: dict[str, int], merges: list[tuple[str, str]]):
+        self.encoder = dict(encoder)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        self.ranks = {pair: i for i, pair in enumerate(merges)}
+        self.merges = list(merges)
+        self._cache: dict[str, list[str]] = {}
+
+    # ------------------------------------------------------------ files
+
+    @classmethod
+    def from_files(cls, vocab_json: str, merges_txt: str) -> "ByteLevelBPE":
+        with open(vocab_json, encoding="utf-8") as f:
+            encoder = json.load(f)
+        merges = []
+        with open(merges_txt, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, b = line.split(" ")
+                merges.append((a, b))
+        return cls(encoder, merges)
+
+    @classmethod
+    def from_dir(cls, vocab_dir: str) -> "ByteLevelBPE":
+        return cls.from_files(
+            os.path.join(vocab_dir, "vocab.json"),
+            os.path.join(vocab_dir, "merges.txt"),
+        )
+
+    def save(self, vocab_dir: str) -> None:
+        os.makedirs(vocab_dir, exist_ok=True)
+        with open(os.path.join(vocab_dir, "vocab.json"), "w", encoding="utf-8") as f:
+            json.dump(self.encoder, f, ensure_ascii=False)
+        with open(os.path.join(vocab_dir, "merges.txt"), "w", encoding="utf-8") as f:
+            f.write("#version: 0.2\n")
+            for a, b in self.merges:
+                f.write(f"{a} {b}\n")
+
+    # ---------------------------------------------------------- encode
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    @property
+    def eot_id(self) -> int | None:
+        return self.encoder.get(END_OF_TEXT)
+
+    def _bpe(self, piece: str) -> list[str]:
+        if piece in self._cache:
+            return self._cache[piece]
+        symbols = _word_to_symbols(piece.encode("utf-8"))
+        while len(symbols) > 1:
+            pairs = _get_pairs(symbols)
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            a, b = best
+            out, i = [], 0
+            while i < len(symbols):
+                if i < len(symbols) - 1 and symbols[i] == a and symbols[i + 1] == b:
+                    out.append(a + b)
+                    i += 2
+                else:
+                    out.append(symbols[i])
+                    i += 1
+            symbols = tuple(out)
+        result = list(symbols)
+        if len(self._cache) < 65536:
+            self._cache[piece] = result
+        return result
+
+    def encode(self, text: str) -> list[int]:
+        ids = []
+        for piece in _GPT2_SPLIT.findall(text):
+            for sym in self._bpe(piece):
+                ids.append(self.encoder[sym])
+        return ids
+
+    def decode(self, ids) -> str:
+        data = bytearray()
+        for i in ids:
+            sym = self.decoder.get(int(i))
+            if sym is None or sym == END_OF_TEXT:
+                continue
+            data.extend(_BYTE_DECODER[c] for c in sym)
+        return data.decode("utf-8", errors="replace")
+
+    # ----------------------------------------------------------- train
+
+    @classmethod
+    def train(
+        cls, texts, vocab_size: int, *, special_tokens=(END_OF_TEXT,)
+    ) -> "ByteLevelBPE":
+        """Byte-level BPE training: start from the 256 byte symbols and
+        repeatedly merge the most frequent adjacent pair across the
+        pre-tokenized corpus until ``vocab_size`` (minus specials)."""
+        word_freq: collections.Counter = collections.Counter()
+        for text in texts:
+            for piece in _GPT2_SPLIT.findall(text):
+                word_freq[piece] += 1
+        words = {
+            w: _word_to_symbols(w.encode("utf-8")) for w in word_freq
+        }
+
+        base = [_BYTE_ENCODER[b] for b in range(256)]
+        merges: list[tuple[str, str]] = []
+        n_target = vocab_size - len(base) - len(special_tokens)
+        for _ in range(max(0, n_target)):
+            pair_freq: collections.Counter = collections.Counter()
+            for w, symbols in words.items():
+                f = word_freq[w]
+                for pair in zip(symbols, symbols[1:]):
+                    pair_freq[pair] += f
+            if not pair_freq:
+                break
+            (a, b), freq = pair_freq.most_common(1)[0]
+            if freq < 2:
+                break
+            merges.append((a, b))
+            merged = a + b
+            new_words = {}
+            for w, symbols in words.items():
+                out, i = [], 0
+                while i < len(symbols):
+                    if (
+                        i < len(symbols) - 1
+                        and symbols[i] == a
+                        and symbols[i + 1] == b
+                    ):
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(symbols[i])
+                        i += 1
+                new_words[w] = tuple(out)
+            words = new_words
+
+        encoder = {sym: i for i, sym in enumerate(base)}
+        for a, b in merges:
+            encoder[a + b] = len(encoder)
+        for tok in special_tokens:
+            encoder[tok] = len(encoder)
+        return cls(encoder, merges)
+
+
+# ------------------------------------------------------------- WordPiece
+
+
+BERT_SPECIALS = ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (
+        0x4E00 <= cp <= 0x9FFF
+        or 0x3400 <= cp <= 0x4DBF
+        or 0xF900 <= cp <= 0xFAFF
+        or 0x20000 <= cp <= 0x2FA1F
+    )
+
+
+def basic_tokenize(text: str, *, lowercase: bool = True) -> list[str]:
+    """BERT's BasicTokenizer: clean, lowercase + strip accents, split on
+    whitespace/punctuation, and isolate CJK characters."""
+    out_chars = []
+    for ch in text:
+        cp = ord(ch)
+        if cp == 0 or cp == 0xFFFD or unicodedata.category(ch).startswith("C"):
+            continue
+        if _is_cjk(cp):
+            out_chars.append(f" {ch} ")
+        elif ch.isspace():
+            out_chars.append(" ")
+        else:
+            out_chars.append(ch)
+    tokens = []
+    for word in "".join(out_chars).split():
+        if lowercase:
+            word = word.lower()
+            word = "".join(
+                c
+                for c in unicodedata.normalize("NFD", word)
+                if unicodedata.category(c) != "Mn"
+            )
+        current = []
+        for ch in word:
+            if _is_punctuation(ch):
+                if current:
+                    tokens.append("".join(current))
+                    current = []
+                tokens.append(ch)
+            else:
+                current.append(ch)
+        if current:
+            tokens.append("".join(current))
+    return tokens
+
+
+class WordPiece:
+    """BERT WordPiece: greedy longest-match-first with ``##`` continuations.
+
+    Loads the standard one-token-per-line ``vocab.txt`` (line number = id,
+    the published BERT format) and produces the exact feature schema the
+    GLUE loader consumes (data/sources.py:load_glue): ``tokens``,
+    ``attention_mask``, ``token_type_ids`` with [CLS]/[SEP]/[PAD].
+    """
+
+    def __init__(
+        self,
+        vocab: dict[str, int],
+        *,
+        lowercase: bool = True,
+        max_chars_per_word: int = 100,
+    ):
+        self.vocab = dict(vocab)
+        self.inv_vocab = {v: k for k, v in self.vocab.items()}
+        self.lowercase = lowercase
+        self.max_chars_per_word = max_chars_per_word
+        for tok in ("[UNK]", "[CLS]", "[SEP]", "[PAD]"):
+            if tok not in self.vocab:
+                raise ValueError(f"WordPiece vocab missing special token {tok}")
+
+    @classmethod
+    def from_vocab_file(cls, path: str, *, lowercase: bool = True) -> "WordPiece":
+        vocab = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok = line.rstrip("\n")
+                if tok:
+                    vocab[tok] = i
+        return cls(vocab, lowercase=lowercase)
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            for tok, _ in sorted(self.vocab.items(), key=lambda kv: kv[1]):
+                f.write(tok + "\n")
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def tokenize(self, text: str) -> list[str]:
+        pieces = []
+        for word in basic_tokenize(text, lowercase=self.lowercase):
+            if len(word) > self.max_chars_per_word:
+                pieces.append("[UNK]")
+                continue
+            start, word_pieces, bad = 0, [], False
+            while start < len(word):
+                end = len(word)
+                cur = None
+                while start < end:
+                    sub = word[start:end]
+                    if start > 0:
+                        sub = "##" + sub
+                    if sub in self.vocab:
+                        cur = sub
+                        break
+                    end -= 1
+                if cur is None:
+                    bad = True
+                    break
+                word_pieces.append(cur)
+                start = end
+            pieces.extend(["[UNK]"] if bad else word_pieces)
+        return pieces
+
+    def encode(
+        self, text_a: str, text_b: str | None = None, *, seq_len: int = 128
+    ) -> dict:
+        """[CLS] a [SEP] (b [SEP])? → fixed-length id/mask/type arrays."""
+        import numpy as np
+
+        a = self.tokenize(text_a)
+        b = self.tokenize(text_b) if text_b is not None else []
+        # Truncate longest-first to fit [CLS] + a + [SEP] (+ b + [SEP]).
+        budget = seq_len - 2 - (1 if b else 0)
+        while len(a) + len(b) > budget:
+            (a if len(a) >= len(b) else b).pop()
+        toks = ["[CLS]"] + a + ["[SEP]"]
+        types = [0] * len(toks)
+        if b:
+            toks += b + ["[SEP]"]
+            types += [1] * (len(b) + 1)
+        ids = [self.vocab[t] for t in toks]
+        n = len(ids)
+        pad = self.vocab["[PAD]"]
+        return {
+            "tokens": np.asarray(ids + [pad] * (seq_len - n), np.int32),
+            "attention_mask": np.asarray([1] * n + [0] * (seq_len - n), np.int32),
+            "token_type_ids": np.asarray(types + [0] * (seq_len - n), np.int32),
+        }
+
+    def decode(self, ids) -> str:
+        words = []
+        for i in ids:
+            tok = self.inv_vocab.get(int(i), "[UNK]")
+            if tok in BERT_SPECIALS:
+                continue
+            if tok.startswith("##") and words:
+                words[-1] += tok[2:]
+            else:
+                words.append(tok)
+        return " ".join(words)
+
+    # ----------------------------------------------------------- build
+
+    @classmethod
+    def build(
+        cls, texts, vocab_size: int, *, lowercase: bool = True
+    ) -> "WordPiece":
+        """Build a WordPiece vocab from a corpus: specials + all seen
+        characters (+ ## forms), then BPE-style merges expressed as
+        subword units until ``vocab_size``."""
+        word_freq: collections.Counter = collections.Counter()
+        for text in texts:
+            for w in basic_tokenize(text, lowercase=lowercase):
+                word_freq[w] += 1
+
+        # Represent each word as char pieces: first char bare, rest ##'d.
+        words = {
+            w: tuple([w[0]] + ["##" + c for c in w[1:]]) for w in word_freq
+        }
+        vocab_set = set(BERT_SPECIALS)
+        for pieces in words.values():
+            vocab_set.update(pieces)
+
+        def strip(p):  # char content of a piece
+            return p[2:] if p.startswith("##") else p
+
+        while len(vocab_set) < vocab_size:
+            pair_freq: collections.Counter = collections.Counter()
+            for w, pieces in words.items():
+                f = word_freq[w]
+                for pair in zip(pieces, pieces[1:]):
+                    pair_freq[pair] += f
+            if not pair_freq:
+                break
+            (a, b), freq = pair_freq.most_common(1)[0]
+            if freq < 2:
+                break
+            merged = a + strip(b)
+            vocab_set.add(merged)
+            new_words = {}
+            for w, pieces in words.items():
+                out, i = [], 0
+                while i < len(pieces):
+                    if i < len(pieces) - 1 and pieces[i] == a and pieces[i + 1] == b:
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(pieces[i])
+                        i += 1
+                new_words[w] = tuple(out)
+            words = new_words
+
+        vocab = {}
+        for tok in BERT_SPECIALS:
+            vocab[tok] = len(vocab)
+        for tok in sorted(vocab_set - set(BERT_SPECIALS)):
+            vocab[tok] = len(vocab)
+        return cls(vocab, lowercase=lowercase)

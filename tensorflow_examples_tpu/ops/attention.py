@@ -4,22 +4,26 @@ TPU-native replacement for the reference's CUDA ``tf.custom_op`` kernels
 (BASELINE.json:north_star — "rewrite any tf.custom_op / CUDA kernels ...
 as Pallas or XLA custom-calls"; SURVEY.md §2c, §5g). The kernel is the
 single-device base for ring attention (``parallel/ring.py``): it computes
-attention over KV *blocks* with an online softmax, so the same math
-extends to KV blocks arriving over ICI.
+attention over KV *blocks* with an online softmax and can return the
+per-row logsumexp, so ring hops merge kernel outputs exactly.
 
 Design (TPU-first, not a CUDA translation):
-- Q is blocked over the grid; K/V live in VMEM per (batch*head) and are
-  consumed block-by-block inside a ``fori_loop`` — the online-softmax
-  running (max, sum, acc) ride in loop carries, which Mosaic keeps in
-  vector registers/VMEM.
+- The grid is (batch·head, q-block, kv-block) with the KV dimension
+  innermost: only ONE [block_kv, head_dim] K/V tile is VMEM-resident at
+  a time, so sequence length is bounded by HBM, not VMEM — 16k–32k+
+  tokens run with the same kernel. The online-softmax running
+  (max, sum, acc) live in VMEM scratch carried across the inner KV grid
+  steps; outputs are written on the last step.
 - All matmuls run on the MXU in f32 accumulation
   (``preferred_element_type``), inputs may be bf16.
-- Causal masking skips whole KV blocks above the diagonal by shortening
-  the loop bound (no wasted MXU work), and masks inside the diagonal
-  block with ``broadcasted_iota``.
+- Causal masking skips whole KV blocks above the diagonal (``pl.when``
+  guards: no MXU work issued) and masks inside the diagonal block with
+  ``broadcasted_iota``.
 - Backward is the standard two-kernel split (dkv by KV block, dq by Q
   block) using the saved logsumexp, so the [seq, seq] score matrix is
-  never materialized in HBM.
+  never materialized. When the forward exposed the logsumexp, its
+  cotangent is exact: d(lse_i)/d(s_ij) = p_ij folds into
+  ``ds = p · (dp − delta + dlse)``.
 
 On non-TPU backends the same kernels run in Pallas interpret mode (used
 by the CPU test suite) and an XLA reference implementation is provided
@@ -34,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -69,22 +74,31 @@ def attention_reference(
 # --------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_kv):
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, sm_scale, causal
+):
     block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
-    seq_kv = k_ref.shape[1]
-    num_kv = seq_kv // block_kv
-    qi = pl.program_id(1)
-    q_offset = qi * block_q
+    block_kv = k_ref.shape[1]
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    num_kv = pl.num_programs(2)
     # Bottom-right-aligned causal diagonal: query i attends keys
     # <= i + (seq_kv - seq_q), matching attention_reference.
-    offset = seq_kv - pl.num_programs(1) * block_q
+    offset = num_kv * block_kv - pl.num_programs(1) * block_q
+    q_offset = qi * block_q
+    kv_offset = kj * block_kv
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale
+    @pl.when(kj == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+    # Causal: KV blocks entirely above the diagonal contribute nothing —
+    # issue no MXU work for them.
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_kv]
@@ -92,62 +106,57 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
             )
-            col = j * block_kv + lax.broadcasted_iota(
+            col = kv_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(row + offset >= col, s, NEG_INF)
+        m = m_s[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(
+        m_s[...] = m_new
+        l_s[...] = l_s[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jnp.dot(
             p, v, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    # Causal: KV blocks entirely above the diagonal contribute nothing —
-    # shorten the loop instead of masking them (saves MXU work).
-    hi = (
-        jnp.clip(
-            lax.div(q_offset + block_q + offset + block_kv - 1, block_kv),
-            0,
-            num_kv,
-        )
-        if causal
-        else num_kv
-    )
-    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    if causal:
+        pl.when(q_offset + block_q - 1 + offset >= kv_offset)(_attend)
+    else:
+        _attend()
 
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[...], 1e-30)
+        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[...] + jnp.log(l)).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret):
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
-    grid = (bh, seq_q // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_kv=block_kv
-    )
+    grid = (bh, seq_q // block_q, seq_kv // block_kv)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
             jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -158,30 +167,36 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret):
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, sm_scale, causal, block_q,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+    dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal,
 ):
     block_kv, head_dim = k_ref.shape[1], k_ref.shape[2]
-    seq_q = q_ref.shape[1]
-    seq_kv = pl.num_programs(1) * block_kv
-    offset = seq_kv - seq_q
-    ki = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    ki, qj = pl.program_id(1), pl.program_id(2)
+    num_q = pl.num_programs(2)
+    offset = pl.num_programs(1) * block_kv - num_q * block_q
     kv_offset = ki * block_kv
+    q_offset = qj * block_q
 
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    @pl.when(qj == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
 
-    def body(j, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(j * block_q, block_q), :]  # [block_q, 1]
-        delta = delta_ref[0, pl.ds(j * block_q, block_q), :]
+    # Q blocks strictly above this KV block's diagonal see none of it.
+    def _accumulate():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [block_q, 1]
+        delta = delta_ref[0]
+        dlse = dlse_ref[0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_kv]
         if causal:
-            row = j * block_q + lax.broadcasted_iota(
+            row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
             )
             col = kv_offset + lax.broadcasted_iota(
@@ -190,51 +205,54 @@ def _bwd_dkv_kernel(
             s = jnp.where(row + offset >= col, s, NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_kv]
         # dv += p^T do
-        dv_new = dv + lax.dot_general(
+        dv_s[...] += lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        # dp = do v^T ; ds = p * (dp - delta)
+        # dp = do v^T ; ds = p * (dp - delta + dlse)
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
+        ds = p * (dp - delta + dlse)
         # dk += ds^T q * scale
-        dk_new = dk + sm_scale * lax.dot_general(
+        dk_s[...] += sm_scale * lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk_new, dv_new
 
-    zeros = jnp.zeros((block_kv, head_dim), jnp.float32)
-    # Causal: Q blocks strictly above this KV block's diagonal see none of
-    # it — start the loop at the first contributing Q block.
-    lo = (
-        jnp.clip(lax.div(kv_offset - offset, block_q), 0, seq_q // block_q)
-        if causal
-        else 0
-    )
-    dk, dv = lax.fori_loop(lo, seq_q // block_q, body, (zeros, zeros))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        pl.when(q_offset + block_q - 1 + offset >= kv_offset)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(qj == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, sm_scale, causal, block_kv,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+    dq_ref, dq_s, *, sm_scale, causal,
 ):
     block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
-    seq_kv = k_ref.shape[1]
-    offset = seq_kv - pl.num_programs(1) * block_q
-    qi = pl.program_id(1)
+    block_kv = k_ref.shape[1]
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    num_kv = pl.num_programs(2)
+    offset = num_kv * block_kv - pl.num_programs(1) * block_q
     q_offset = qi * block_q
+    kv_offset = kj * block_kv
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        dlse = dlse_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -242,7 +260,7 @@ def _bwd_dq_kernel(
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
             )
-            col = j * block_kv + lax.broadcasted_iota(
+            col = kv_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1
             )
             s = jnp.where(row + offset >= col, s, NEG_INF)
@@ -250,76 +268,68 @@ def _bwd_dq_kernel(
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
-        return dq + sm_scale * jnp.dot(
+        ds = p * (dp - delta + dlse)
+        dq_s[...] += sm_scale * jnp.dot(
             ds, k, preferred_element_type=jnp.float32
         )
 
-    hi = (
-        jnp.clip(
-            lax.div(q_offset + block_q + offset + block_kv - 1, block_kv),
-            0,
-            seq_kv // block_kv,
-        )
-        if causal
-        else seq_kv // block_kv
-    )
-    dq = lax.fori_loop(
-        0, hi, body, jnp.zeros((block_q, head_dim), jnp.float32)
-    )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(q_offset + block_q - 1 + offset >= kv_offset)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_kv, interpret, residuals, g):
+def _flash_bwd(
+    sm_scale, causal, block_q, block_kv, interpret, residuals, do, dlse
+):
     q, k, v, o, lse = residuals
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
-    do = g
     # delta_i = rowsum(do_i * o_i) — cheap, let XLA fuse it.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )
+    if dlse is None:
+        dlse = jnp.zeros_like(lse)
+    dlse = dlse.astype(jnp.float32).reshape(lse.shape)
 
-    full_q = pl.BlockSpec((1, seq_q, head_dim), lambda b, i: (b, 0, 0))
-    full_kv = pl.BlockSpec((1, seq_kv, head_dim), lambda b, i: (b, 0, 0))
-    full_vec = pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0))
+    q_blk = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, j, 0))
+    kv_blk = pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, i, 0))
+    vec_blk = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
 
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q
-        ),
-        grid=(bh, seq_kv // block_kv),
-        in_specs=[full_q,
-                  pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
-                  full_q, full_vec, full_vec],
-        out_specs=[
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, i: (b, i, 0)),
-        ],
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(bh, seq_kv // block_kv, seq_q // block_q),
+        in_specs=[q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk],
+        out_specs=[kv_blk, kv_blk],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, head_dim), jnp.float32),
+            pltpu.VMEM((block_kv, head_dim), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, dlse)
+
+    q_blk = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
+    kv_blk = pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0))
+    vec_blk = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_kv=block_kv
-        ),
-        grid=(bh, seq_q // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            full_kv, full_kv,
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(bh, seq_q // block_q, seq_kv // block_kv),
+        in_specs=[q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk],
+        out_specs=q_blk,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, dlse)
     return dq, dk, dv
 
 
@@ -341,29 +351,38 @@ def _make_flash(causal, block_q, block_kv, interpret):
 
     def bwd(sm_scale, residuals, g):
         return _flash_bwd(
-            sm_scale, causal, block_q, block_kv, interpret, residuals, g
+            sm_scale, causal, block_q, block_kv, interpret, residuals, g, None
         )
 
     flash.defvjp(fwd, bwd)
     return flash
 
 
-def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    causal: bool = True,
-    sm_scale: float | None = None,
-    block_q: int = 128,
-    block_kv: int = 128,
-    interpret: bool | None = None,
-) -> jax.Array:
-    """Blockwise attention, differentiable; q/k/v: [batch, heads, seq, dim].
+@functools.lru_cache(maxsize=None)
+def _make_flash_lse(causal, block_q, block_kv, interpret):
+    """Variant returning (o, lse) with the exact lse cotangent in bwd —
+    the building block ring attention merges across hops."""
 
-    Runs the Pallas TPU kernel on TPU; on other backends runs the same
-    kernel in interpret mode (tests) unless ``interpret=False``.
-    """
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+    def flash(q, k, v, sm_scale):
+        o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret)
+        return o, lse
+
+    def fwd(q, k, v, sm_scale):
+        o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret)
+        return (o, lse), (q, k, v, o, lse)
+
+    def bwd(sm_scale, residuals, g):
+        do, dlse = g
+        return _flash_bwd(
+            sm_scale, causal, block_q, block_kv, interpret, residuals, do, dlse
+        )
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+def _prepare(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, h, seq_q, head_dim = q.shape
@@ -384,10 +403,58 @@ def flash_attention(
         )
     if sm_scale is None:
         sm_scale = head_dim**-0.5
+    return float(sm_scale), block_q, block_kv, interpret
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Blockwise attention, differentiable; q/k/v: [batch, heads, seq, dim].
+
+    Runs the Pallas TPU kernel on TPU; on other backends runs the same
+    kernel in interpret mode (tests) unless ``interpret=False``.
+    """
+    sm_scale, block_q, block_kv, interpret = _prepare(
+        q, k, v, causal, sm_scale, block_q, block_kv, interpret
+    )
+    b, h, seq_q, head_dim = q.shape
     flash = _make_flash(bool(causal), block_q, block_kv, interpret)
     fold = lambda x: x.reshape(b * h, x.shape[2], head_dim)
-    out = flash(fold(q), fold(k), fold(v), float(sm_scale))
+    out = flash(fold(q), fold(k), fold(v), sm_scale)
     return out.reshape(b, h, seq_q, head_dim)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Like ``flash_attention`` but also returns the row logsumexp
+    [batch, heads, seq] (f32), differentiable in both outputs. Partial
+    attention results merge exactly via their lse — the primitive ring
+    attention builds on."""
+    sm_scale, block_q, block_kv, interpret = _prepare(
+        q, k, v, causal, sm_scale, block_q, block_kv, interpret
+    )
+    b, h, seq_q, head_dim = q.shape
+    flash = _make_flash_lse(bool(causal), block_q, block_kv, interpret)
+    fold = lambda x: x.reshape(b * h, x.shape[2], head_dim)
+    o, lse = flash(fold(q), fold(k), fold(v), sm_scale)
+    return o.reshape(b, h, seq_q, head_dim), lse.reshape(b, h, seq_q)
 
 
 def dot_product_attention(

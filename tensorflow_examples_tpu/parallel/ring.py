@@ -7,10 +7,13 @@ designed TPU-first:
 - ``ring_attention``: blockwise attention over a ``context`` mesh axis.
   Each device holds a sequence shard of Q/K/V; K/V shards rotate around
   the ring with ``jax.lax.ppermute`` (nearest-neighbor ICI traffic, no
-  all-gather), while an online softmax merges each arriving block into
-  f32 running (max, sum, acc) — the same math as the Pallas flash kernel
-  (ops/attention.py), lifted one level up so the "blocks" arrive over ICI
-  instead of from VMEM. Memory per device is O(S/c · d), never O(S²).
+  all-gather). Every hop attends the arriving KV shard with the Pallas
+  flash kernel (``flash_attention_with_lse``) and hop results merge
+  exactly through their logsumexp — so per-device memory is
+  O(S/c · d) activations + O(block²) VMEM, never O((S/c)²), and the
+  inner loop runs at full single-device kernel efficiency. Gradients
+  flow through the merge AND the lse (the kernel's custom VJP carries
+  the lse cotangent), so the whole ring differentiates exactly.
 - ``ulysses_attention``: the all-to-all alternative — reshard from
   sequence-sharded to head-sharded with ``all_to_all``, run the local
   flash kernel on full sequences for H/c heads, reshard back. Two
@@ -30,30 +33,24 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tensorflow_examples_tpu.ops.attention import NEG_INF, flash_attention
+from tensorflow_examples_tpu.ops.attention import (
+    NEG_INF,
+    flash_attention,
+    flash_attention_with_lse,
+)
 
-_STABLE_MIN = -0.7 * NEG_INF  # guard value well inside f32 range
 
+def _merge(out, lse, o_blk, lse_blk):
+    """Exact merge of two partial attentions via their logsumexp.
 
-def _block_attend(q, k, v, mask, sm_scale):
-    """One KV block's (scores→masked→exp) contribution, f32.
-
-    q: [B,H,Sq,D], k/v: [B,H,Sk,D], mask: broadcastable [Sq,Sk] bool.
-    Returns (m, l, acc) partials for online-softmax merging.
+    out/o_blk: [B,H,S,D] f32; lse/lse_blk: [B,H,S]. A hop whose
+    ``lse_blk`` is NEG_INF contributes weight exp(NEG_INF−lse)=0, which
+    is how fully-masked (future) shards drop out.
     """
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * sm_scale
-    s = jnp.where(mask, s, NEG_INF)
-    # Block-local max; clamp so fully-masked rows stay finite.
-    m = jnp.maximum(jnp.max(s, axis=-1), -_STABLE_MIN)
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    return m, l, acc
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+    return out * w_old + o_blk.astype(jnp.float32) * w_blk, lse_new
 
 
 def ring_attention(

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Convert GLUE TSV files into the tokenized ``.npz`` features for BERT.
+
+The GLUE loader (data/sources.py:load_glue) consumes
+``<task>_<split>.npz`` with ``tokens``/``attention_mask``/
+``token_type_ids``/``label`` — the output of a BERT tokenizer run
+offline. This tool is that run: it reads the standard GLUE TSV layout
+for each task and featurizes with the in-repo WordPiece tokenizer
+(data/tokenizers.py), loading a vendored ``vocab.txt`` (--vocab) or
+building a vocabulary from the task's own training text (--build_vocab N,
+saved to the output dir).
+
+    python tools/prepare_glue.py --task=sst2 --input=train.tsv \
+        --split=train --out_dir=/data/glue --build_vocab=8192
+    python examples/bert_glue/train.py --task=sst2 --data_dir=/data/glue
+"""
+
+import csv
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from absl import app, flags
+
+from tensorflow_examples_tpu.data.sources import GLUE_NUM_LABELS
+from tensorflow_examples_tpu.data.tokenizers import WordPiece
+
+flags.DEFINE_string("task", "sst2", f"one of {sorted(GLUE_NUM_LABELS)}")
+flags.DEFINE_string("input", "", "input TSV file for the split")
+flags.DEFINE_string("split", "train", "train | validation | test")
+flags.DEFINE_string("out_dir", "", "output dir for <task>_<split>.npz")
+flags.DEFINE_string("vocab", "", "path to a BERT vocab.txt")
+flags.DEFINE_integer("build_vocab", 0, "build a vocab of this size instead")
+flags.DEFINE_integer("seq_len", 128, "max sequence length")
+FLAGS = flags.FLAGS
+
+
+_ENTAIL = {"entailment": 0, "not_entailment": 1}
+_MNLI = {"entailment": 0, "neutral": 1, "contradiction": 2}
+
+# Per-task TSV schema: (text_a col, text_b col, label col, label map).
+# Column names follow the official GLUE distribution headers; CoLA has no
+# header (source/label/star/sentence columns).
+_TASKS = {
+    "cola": (3, None, 1, int),  # positional: no header row
+    "sst2": ("sentence", None, "label", int),
+    "mrpc": ("#1 String", "#2 String", "Quality", int),
+    "stsb": ("sentence1", "sentence2", "score", float),
+    "qqp": ("question1", "question2", "is_duplicate", int),
+    "mnli": ("sentence1", "sentence2", "gold_label", _MNLI),
+    "qnli": ("question", "sentence", "label", _ENTAIL),
+    "rte": ("sentence1", "sentence2", "label", _ENTAIL),
+    "wnli": ("sentence1", "sentence2", "label", int),
+}
+
+
+def read_tsv(path: str, task: str):
+    """Yield (text_a, text_b|None, raw_label) rows for the task."""
+    a_col, b_col, y_col, conv = _TASKS[task]
+    with open(path, encoding="utf-8") as f:
+        reader = csv.reader(f, delimiter="\t", quoting=csv.QUOTE_NONE)
+        rows = list(reader)
+    if isinstance(a_col, int):  # headerless (cola)
+        for r in rows:
+            yield r[a_col], None, conv(r[y_col])
+        return
+    header = rows[0]
+    idx = {name: i for i, name in enumerate(header)}
+    for r in rows[1:]:
+        if len(r) < len(header):
+            continue
+        a = r[idx[a_col]]
+        b = r[idx[b_col]] if b_col else None
+        raw = r[idx[y_col]]
+        label = conv[raw] if isinstance(conv, dict) else conv(raw)
+        yield a, b, label
+
+
+def main(argv):
+    del argv
+    task = FLAGS.task
+    if task not in _TASKS:
+        raise app.UsageError(f"unknown --task={task}")
+    if not FLAGS.input or not FLAGS.out_dir:
+        raise app.UsageError("--input and --out_dir are required")
+    if bool(FLAGS.vocab) == bool(FLAGS.build_vocab):
+        raise app.UsageError("exactly one of --vocab / --build_vocab")
+
+    rows = list(read_tsv(FLAGS.input, task))
+    if FLAGS.vocab:
+        wp = WordPiece.from_vocab_file(FLAGS.vocab)
+    else:
+        corpus = [a for a, _, _ in rows] + [b for _, b, _ in rows if b]
+        wp = WordPiece.build(corpus, FLAGS.build_vocab)
+        os.makedirs(FLAGS.out_dir, exist_ok=True)
+        wp.save(os.path.join(FLAGS.out_dir, "vocab.txt"))
+        print(f"built vocab: {wp.vocab_size} tokens -> {FLAGS.out_dir}/vocab.txt")
+
+    feats = [wp.encode(a, b, seq_len=FLAGS.seq_len) for a, b, _ in rows]
+    labels = np.asarray(
+        [y for _, _, y in rows],
+        np.float32 if task == "stsb" else np.int32,
+    )
+    out = {
+        "tokens": np.stack([f["tokens"] for f in feats]),
+        "attention_mask": np.stack([f["attention_mask"] for f in feats]),
+        "token_type_ids": np.stack([f["token_type_ids"] for f in feats]),
+        "label": labels,
+    }
+    os.makedirs(FLAGS.out_dir, exist_ok=True)
+    path = os.path.join(FLAGS.out_dir, f"{task}_{FLAGS.split}.npz")
+    np.savez(path, **out)
+    print(
+        f"{path}: {len(labels)} examples, seq_len={FLAGS.seq_len}, "
+        f"vocab={wp.vocab_size}"
+    )
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Tokenize a text corpus into the flat-token ``.bin`` format for GPT-2.
+
+The LM loader (data/sources.py:load_lm_tokens) consumes ``train.bin`` /
+``val.bin`` uint16 token streams — the common GPT-2 prep format. This
+tool produces them offline with the in-repo byte-level BPE
+(data/tokenizers.py): either load a vendored ``vocab.json``/``merges.txt``
+(--vocab_dir) or train a fresh vocabulary from the input corpus itself
+(--train_vocab N, saved next to the output for generate.py to decode
+with).
+
+    python tools/prepare_lm.py --input=corpus.txt --out_dir=/data/lm \
+        --train_vocab=8192 --val_fraction=0.01
+
+Then: python examples/gpt2/train.py --data_dir=/data/lm --vocab_size=8192
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from absl import app, flags
+
+from tensorflow_examples_tpu.data.tokenizers import ByteLevelBPE
+
+flags.DEFINE_list("input", [], "input .txt file(s), one document per file")
+flags.DEFINE_string("out_dir", "", "output directory for train.bin/val.bin")
+flags.DEFINE_string("vocab_dir", "", "load vocab.json+merges.txt from here")
+flags.DEFINE_integer("train_vocab", 0, "train a BPE vocab of this size instead")
+flags.DEFINE_float("val_fraction", 0.01, "fraction of tokens for val.bin")
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    if not FLAGS.input or not FLAGS.out_dir:
+        raise app.UsageError("--input and --out_dir are required")
+    if bool(FLAGS.vocab_dir) == bool(FLAGS.train_vocab):
+        raise app.UsageError("exactly one of --vocab_dir / --train_vocab")
+
+    texts = []
+    for path in FLAGS.input:
+        with open(path, encoding="utf-8") as f:
+            texts.append(f.read())
+
+    if FLAGS.vocab_dir:
+        tok = ByteLevelBPE.from_dir(FLAGS.vocab_dir)
+    else:
+        tok = ByteLevelBPE.train(texts, FLAGS.train_vocab)
+        tok.save(FLAGS.out_dir)
+        print(f"trained BPE vocab: {tok.vocab_size} tokens -> {FLAGS.out_dir}")
+    if tok.vocab_size > np.iinfo(np.uint16).max + 1:
+        raise ValueError(f"vocab {tok.vocab_size} exceeds uint16 .bin format")
+
+    ids = []
+    eot = tok.eot_id
+    for text in texts:
+        ids.extend(tok.encode(text))
+        if eot is not None:
+            ids.append(eot)
+    flat = np.asarray(ids, np.uint16)
+
+    os.makedirs(FLAGS.out_dir, exist_ok=True)
+    n_val = int(len(flat) * FLAGS.val_fraction)
+    splits = {"train": flat[: len(flat) - n_val], "val": flat[len(flat) - n_val:]}
+    for split, arr in splits.items():
+        out = os.path.join(FLAGS.out_dir, f"{split}.bin")
+        arr.tofile(out)
+        print(f"{out}: {len(arr)} tokens (vocab {tok.vocab_size})")
+
+
+if __name__ == "__main__":
+    app.run(main)

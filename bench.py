@@ -43,9 +43,19 @@ import time
 # pair in the output says whether the comparison crosses rig behavior.
 FLOORS = {
     "tpu": {
-        "_fingerprint_tflops": 61000.0,  # BASELINE.md:25 — tunnel artifact
-        "gpt2_124m_tokens_per_sec": 3224304.0,  # 2026-07-29 first bring-up
-        "mnist_mlp_step_time": 0.0702,  # ms/step, 2026-07-29 first bring-up
+        # 2026-07-29 round-2 full sweep — ONE coherent measurement set at
+        # one fingerprint (the r1 floors were taken when the tunnel
+        # measured ~61k TFLOP/s; it now measures ~31k, so r1's
+        # gpt2=3224304 tok/s and mnist=0.0702 ms are kept as history in
+        # BASELINE.md, not comparable floors).
+        "_fingerprint_tflops": 31055.0,
+        "resnet50_examples_per_sec_per_chip": 62392.0,
+        "resnet50_input_examples_per_sec_per_chip": 88.2,  # 1-CPU host!
+        "gpt2_124m_tokens_per_sec": 2931492.0,
+        "gpt2_long4k_tokens_per_sec": 2861037.0,
+        "gpt2_long16k_tokens_per_sec": 4157890.0,
+        "mnist_mlp_step_time": 0.18,  # ms/step
+        "allreduce_busbw": 3396.0,  # GB/s, n=1 (loopback; real ICI needs >1 chip)
     },
     "cpu": {
         # 2026-07-29 round 2 first CPU-fallback measurements (this host).
@@ -352,6 +362,22 @@ def bench_gpt2_long() -> dict:
     )
 
 
+def bench_gpt2_long16k() -> dict:
+    """16k-token single-chip training step (VERDICT r1 item 6): possible
+    because the flash kernel streams KV blocks through VMEM (grid over
+    KV) instead of holding the whole sequence resident, and remat bounds
+    activation memory. CPU fallback uses 1k (interpret-mode kernels)."""
+    tpu = BACKEND == "tpu"
+    return bench_gpt2(
+        steps=4 if tpu else 2,
+        warmup=2 if tpu else 1,
+        batch=1,
+        seq=16384 if tpu else 1024,
+        metric="gpt2_long16k_tokens_per_sec",
+        remat=True,
+    )
+
+
 # ----------------------------------------------------------------- mnist
 
 
@@ -455,12 +481,21 @@ BENCHES = {
     "resnet50_input": bench_resnet50_input,
     "gpt2": bench_gpt2,
     "gpt2_long": bench_gpt2_long,
+    "gpt2_long16k": bench_gpt2_long16k,
     "mnist": bench_mnist,
     "collectives": bench_collectives,
 }
 
 # Headline-first order for --bench=all.
-ALL_ORDER = ["resnet50", "resnet50_input", "gpt2", "mnist", "collectives"]
+ALL_ORDER = [
+    "resnet50",
+    "resnet50_input",
+    "gpt2",
+    "gpt2_long",
+    "gpt2_long16k",
+    "mnist",
+    "collectives",
+]
 
 
 def run_all() -> dict:

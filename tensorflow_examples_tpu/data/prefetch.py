@@ -16,15 +16,41 @@ import jax.numpy as jnp
 
 
 def put_batch(batch, sharding):
-    """The one host→device placement path (used by loop and prefetch)."""
+    """The one host→device placement path (used by loop and prefetch).
+
+    Global-view semantics: every process passes the SAME full global
+    batch and ``device_put`` materializes each process's addressable
+    shards from it. For per-host data sources use ``put_local_batch``.
+    """
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
 
 
-def device_prefetch(it: Iterator, sharding, *, depth: int = 2) -> Iterator:
+def put_local_batch(batch, sharding):
+    """Form a GLOBAL array from THIS process's local rows.
+
+    Per-host semantics (multi-host input sharding, SURVEY.md §3(5)):
+    each process contributes ``global_batch / process_count`` rows — its
+    own shard of the data — and the result is one global jax.Array on
+    ``sharding``. On a single process this is identical to ``put_batch``.
+    """
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        batch,
+    )
+
+
+def device_prefetch(
+    it: Iterator, sharding, *, depth: int = 2, local_batches: bool = False
+) -> Iterator:
     queue = collections.deque()
+    put_fn = put_local_batch if local_batches else put_batch
 
     def put(batch):
-        return put_batch(batch, sharding)
+        return put_fn(batch, sharding)
 
     try:
         for _ in range(depth):

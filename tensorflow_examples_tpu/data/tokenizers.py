@@ -23,13 +23,28 @@ import json
 import os
 import unicodedata
 
-import regex  # GPT-2's pre-tokenization pattern needs \p{L}/\p{N} classes
-
 # GPT-2's pre-tokenizer: contractions, letter runs, number runs, other
 # symbols, and whitespace (trailing-space lookahead keeps " word" units).
-_GPT2_SPLIT = regex.compile(
-    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
-)
+# The canonical pattern needs `regex` for \p{L}/\p{N}; without it, fall
+# back to stdlib `re` with [^\W\d_]/\d classes — equivalent for all
+# text whose "letters" re considers word characters (everything
+# common; exotic scripts may split differently, changing BPE merges).
+try:
+    import regex
+
+    _GPT2_SPLIT = regex.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+    )
+except ImportError:  # pragma: no cover - regex is a declared dependency
+    import re
+
+    # NB: the symbol class must include "_" explicitly — "_" is \w (so
+    # [^\s\w] excludes it) but not a letter under [^\W\d_]; without it
+    # findall() would silently drop underscores and break losslessness.
+    _GPT2_SPLIT = re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+|\s+(?!\S)|\s+",
+        re.UNICODE,
+    )
 
 END_OF_TEXT = "<|endoftext|>"
 

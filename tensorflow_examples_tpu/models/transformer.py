@@ -60,6 +60,7 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -186,8 +187,9 @@ class Attention(nn.Module):
 
 
 class MoeMlp(nn.Module):
-    """Top-1 Switch MoE FFN (parallel/moe.py); aux loss sown into
-    the ``intermediates`` collection as ``moe_aux``."""
+    """Top-k Switch/GShard MoE FFN (parallel/moe.py); aux loss and
+    dropped-token fraction sown into the ``intermediates`` collection as
+    ``moe_aux`` / ``moe_drop``."""
 
     cfg: TransformerConfig
     train: bool
@@ -210,15 +212,17 @@ class MoeMlp(nn.Module):
             if self.train and self.has_rng("dropout")
             else None
         )
-        out, aux = moe_ffn(
+        out, aux, drop = moe_ffn(
             gate,
             w_in.astype(x.dtype), b_in.astype(x.dtype),
             w_out.astype(x.dtype), b_out.astype(x.dtype),
             x,
             capacity_factor=cfg.moe_capacity_factor,
+            top_k=cfg.moe_top_k,
             rng=rng,
         )
         self.sow("intermediates", "moe_aux", aux)
+        self.sow("intermediates", "moe_drop", drop)
         return out
 
 

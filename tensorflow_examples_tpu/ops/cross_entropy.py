@@ -22,6 +22,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from tensorflow_examples_tpu.core import collectives as coll
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -285,9 +287,9 @@ def tp_cross_entropy_from_hidden(
         # label lands in exactly one shard; others contribute 0). The max
         # is a pure stabilizer — stop_gradient keeps the exact softmax
         # gradient and sidesteps pmax's missing differentiation rule.
-        gm = lax.pmax(lax.stop_gradient(m), axis_name)
-        gl = lax.psum(l * jnp.exp(m - gm), axis_name)
-        gt = lax.psum(t, axis_name)
+        gm = coll.pmax(lax.stop_gradient(m), axis_name)
+        gl = coll.psum(l * jnp.exp(m - gm), axis_name)
+        gt = coll.psum(t, axis_name)
         return gm + jnp.log(jnp.maximum(gl, 1e-30)) - gt
 
     return jax.shard_map(

@@ -2,18 +2,28 @@
 
 Framework-native extension (SURVEY.md §2d notes the reference has no MoE
 workload; EP is provided as a first-class capability of the parallelism
-layer). Switch-Transformer-style top-1 routing, TPU-first:
+layer). Switch/GShard-style top-k routing, TPU-first:
 
 - Static shapes everywhere: tokens are routed with a fixed per-expert
   ``capacity``; overflow tokens fall through the residual connection
-  (standard Switch behavior) — no dynamic shapes under jit.
-- Experts are the *same* FFN pytree with a leading [experts] axis. On a
-  mesh, experts shard over the ``model`` axis (EP reuses the tensor-
-  parallel axis, the common choice when EP and TP are not combined) and
-  dispatch/combine are einsums against one-hot dispatch masks — XLA
-  lowers them to all_to_all-equivalent collectives over ICI.
-- Router computes in f32 with jitter noise at train time and an
-  auxiliary load-balancing loss (mean fraction · mean prob per expert).
+  (standard Switch behavior) — no dynamic shapes under jit. The dropped
+  fraction is returned so training can LOG it (a silently-high drop rate
+  is the classic MoE failure mode).
+- Dispatch/combine are index ops — a scatter-add into the ``[E, C, d]``
+  expert buffers and a gather back — O(n·d) memory and data movement.
+  (The round-1 formulation built a dense one-hot ``[n, E, C]`` dispatch
+  tensor and einsummed against it: O(n·E·C) memory — fine for toy
+  shapes, dead at real n·E. VERDICT r1 item 8.)
+- Experts are the *same* FFN pytree with a leading [experts] axis,
+  sharded over the ``model`` mesh axis (GPT2_RULES). Activations inside
+  the blocks are replicated over ``model`` (TP shards heads/ff, not
+  tokens), so under XLA SPMD the scatter lands tokens directly on the
+  expert's shard and the combine gathers back — collectives over ICI
+  are inserted by the partitioner, the reference stack's hand-written
+  NCCL all-to-all has no user-space equivalent here (SURVEY.md §2c).
+- Router computes in f32 with jitter noise at train time and the Switch
+  auxiliary load-balancing loss (mean fraction · mean prob per expert,
+  over rank-0 assignments).
 
 ``moe_ffn`` is pure (params in, tokens out) so it slots into flax
 modules (models/transformer.py MoeMlp) and composes with remat/scan.
@@ -34,13 +44,20 @@ def moe_ffn(
     x: jax.Array,       # [B, S, d]
     *,
     capacity_factor: float = 1.25,
+    top_k: int = 1,
     rng: jax.Array | None = None,
     jitter: float = 1e-2,
-) -> tuple[jax.Array, jax.Array]:
-    """Top-1 (Switch) MoE FFN. Returns (out [B,S,d], aux_loss scalar)."""
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k MoE FFN.
+
+    Returns ``(out [B,S,d], aux_loss scalar, drop_fraction scalar)``;
+    ``drop_fraction`` is the fraction of (token, rank) assignments that
+    overflowed expert capacity and fell through the residual.
+    """
     b, s, d = x.shape
     e = gate_w.shape[-1]
     n = b * s
+    top_k = min(top_k, e)
     tokens = x.reshape(n, d)
 
     logits = (tokens.astype(jnp.float32)) @ gate_w.astype(jnp.float32)
@@ -49,38 +66,64 @@ def moe_ffn(
             rng, logits.shape, jnp.float32, -jitter, jitter
         )
     probs = jax.nn.softmax(logits, axis=-1)  # [n, E]
-    expert = jnp.argmax(probs, axis=-1)      # [n]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
 
-    # Switch aux loss: E · Σ_e (fraction of tokens → e) · (mean prob of e).
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [n, E]
-    aux = e * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+    # Sequential top-k: argmax, mask, repeat (k is tiny and static).
+    masked = probs
+    experts, gates = [], []
+    for _ in range(top_k):
+        ej = jnp.argmax(masked, axis=-1)  # [n]
+        pj = jnp.take_along_axis(masked, ej[:, None], axis=-1)[:, 0]
+        experts.append(ej)
+        gates.append(pj)
+        masked = masked * (1.0 - jax.nn.one_hot(ej, e, dtype=jnp.float32))
+    # top-1: keep the raw router probability as the gate (Switch) — it
+    # is how the router gets task-loss gradient. Renormalizing would
+    # make the gate identically 1.0 and silently detach the router.
+    # top-k>1: renormalize over the chosen experts (GShard) — relative
+    # weights still carry gradient there.
+    if top_k > 1:
+        denom = jnp.maximum(sum(gates), 1e-9)
+        gates = [g / denom for g in gates]
 
-    # Static-capacity dispatch: position of each token within its expert's
-    # queue; tokens past capacity are dropped (residual carries them).
-    capacity = max(1, int(capacity_factor * n / e))
-    position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot, [n, E]
-    keep = (position > 0) & (position <= capacity)
-    slot = jnp.clip(position.sum(axis=-1).astype(jnp.int32) - 1, 0, capacity - 1)
-    kept = keep.any(axis=-1)
+    # Switch aux loss over rank-0 assignments:
+    # E · Σ_e (fraction of tokens → e) · (mean prob of e).
+    onehot0 = jax.nn.one_hot(experts[0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(jnp.mean(onehot0, axis=0) * jnp.mean(probs, axis=0))
 
-    # dispatch [n, E, C]: one-hot (expert, slot) for kept tokens.
-    dispatch = (
-        onehot[:, :, None]
-        * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, None, :]
-        * kept[:, None, None]
-    )
-    # Expert inputs [E, C, d] — einsum against the mask; XLA turns this
-    # into a gather/all_to_all under sharding.
-    xin = jnp.einsum("nec,nd->ecd", dispatch, tokens.astype(jnp.float32))
-    xin = xin.astype(x.dtype)
+    # Static-capacity slotting: rank-0 assignments queue first, then
+    # rank-1, … — each (token, rank) gets a 1-based position in its
+    # expert's queue; positions past capacity are dropped.
+    capacity = max(1, int(capacity_factor * top_k * n / e))
+    counts = jnp.zeros((e,), jnp.int32)  # queue length so far, per expert
+    flat_slots, keeps = [], []
+    for ej in experts:
+        oh = jax.nn.one_hot(ej, e, dtype=jnp.int32)  # [n, E]
+        pos = (jnp.cumsum(oh, axis=0) + counts[None, :]) * oh  # [n, E]
+        posj = jnp.sum(pos, axis=-1)  # [n], 1-based
+        keeps.append(posj <= capacity)
+        flat_slots.append(ej * capacity + jnp.clip(posj - 1, 0, capacity - 1))
+        counts = counts + jnp.sum(oh, axis=0)
+    kept = sum(jnp.sum(k_) for k_ in keeps)
+    drop_frac = 1.0 - kept.astype(jnp.float32) / (n * top_k)
 
+    # Dispatch: scatter-add token rows into the expert buffers. Slots are
+    # unique per kept (token, rank) pair, so adds never collide.
+    xin = jnp.zeros((e * capacity, d), x.dtype)
+    for flat, keep in zip(flat_slots, keeps):
+        xin = xin.at[flat].add(
+            tokens * keep[:, None].astype(x.dtype),
+            mode="drop",
+        )
+    xin = xin.reshape(e, capacity, d)
+
+    # Expert FFN: one batched matmul pair over the expert axis (MXU).
     h = jnp.einsum("ecd,edf->ecf", xin, w_in) + b_in[:, None, :]
     h = jax.nn.gelu(h, approximate=True)
     yout = jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
 
-    # Combine back with the gate value folded in.
-    combined = jnp.einsum(
-        "nec,ecd->nd", dispatch * gate[:, None, None], yout.astype(jnp.float32)
-    )
-    return combined.reshape(b, s, d).astype(x.dtype), aux
+    # Combine: gather each (token, rank)'s output row, gate, and sum.
+    yflat = yout.reshape(e * capacity, d).astype(jnp.float32)
+    out = jnp.zeros((n, d), jnp.float32)
+    for flat, keep, gate in zip(flat_slots, keeps, gates):
+        out = out + yflat[flat] * (gate * keep)[:, None]
+    return out.reshape(b, s, d).astype(x.dtype), aux, drop_frac

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tensorflow_examples_tpu.core import collectives as coll
 from tensorflow_examples_tpu.core.mesh import AxisNames
 
 
@@ -42,7 +43,7 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name):
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m = x_mb.shape[0]
-    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    fwd_perm = coll.ring_perm(n_stages)
     params = jax.tree.map(lambda p: p[0], params)  # drop the stage dim
 
     def tick(carry, t):
@@ -60,7 +61,7 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name):
         )
         # Hop the activation to the next stage (ring hop; the wraparound
         # value into stage 0 is ignored — it re-ingests from x_mb).
-        state = lax.ppermute(y, axis_name, fwd_perm)
+        state = coll.ppermute(y, axis_name, fwd_perm)
         return (state, out), None
 
     state0 = jnp.zeros_like(x_mb[0])
@@ -70,7 +71,7 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name):
     )
     # Only the last stage holds real outputs; broadcast to all pipe ranks
     # so the (replicated) head/loss runs everywhere.
-    return lax.psum(
+    return coll.psum(
         jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)), axis_name
     )
 

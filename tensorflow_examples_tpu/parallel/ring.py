@@ -31,8 +31,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
+from tensorflow_examples_tpu.core import collectives as coll
 from tensorflow_examples_tpu.ops.attention import (
     NEG_INF,
     flash_attention,
@@ -69,60 +69,44 @@ def ring_attention(
     contiguous ascending (shard i holds tokens [i·s, (i+1)·s)), which is
     what ``NamedSharding(P(..., 'context', ...))`` produces.
     """
-    axis_size = lax.axis_size(axis_name)
+    axis_size = coll.axis_size(axis_name)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if axis_size == 1:
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
 
-    my_idx = lax.axis_index(axis_name)
-    s_loc = q.shape[2]
-    qf = q.astype(jnp.float32)
-    row = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
-    col = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    my_idx = coll.axis_index(axis_name)
+    perm = coll.ring_perm(axis_size)
 
-    def merge(carry, step, k_blk, v_blk):
-        m, l, acc = carry
-        # After `step` rotations this device holds KV shard (my_idx - step).
-        kv_idx = (my_idx - step) % axis_size
-        if causal:
-            # Global causality between shard indices: earlier KV shard →
-            # fully visible; same shard → triangular; later → fully masked.
-            mask = (kv_idx < my_idx) | ((kv_idx == my_idx) & (row >= col))
-        else:
-            mask = jnp.ones((s_loc, s_loc), bool)
-        bm, bl, bacc = _block_attend(qf, k_blk, v_blk, mask, sm_scale)
-        m_new = jnp.maximum(m, bm)
-        a_old = jnp.exp(m - m_new)
-        a_blk = jnp.exp(bm - m_new)
-        l_new = l * a_old + bl * a_blk
-        acc_new = acc * a_old[..., None] + bacc * a_blk[..., None]
-        return m_new, l_new, acc_new
+    # Hop 0 is the local (diagonal) shard: the only hop that needs the
+    # intra-shard causal triangle, so it uses the causal kernel variant.
+    out, lse = flash_attention_with_lse(q, k, v, causal=causal, sm_scale=sm_scale)
+    out = out.astype(jnp.float32)
 
     def body(carry, step):
-        m, l, acc, k_blk, v_blk = carry
-        m, l, acc = merge((m, l, acc), step, k_blk, v_blk)
-        # Rotate KV one hop around the ring (nearest-neighbor ICI).
-        k_nxt, v_nxt = lax.ppermute((k_blk, v_blk), axis_name, perm)
-        return (m, l, acc, k_nxt, v_nxt), None
+        out, lse, k_blk, v_blk = carry
+        # Rotate KV one hop around the ring (nearest-neighbor ICI). After
+        # `step` rotations this device holds KV shard (my_idx - step).
+        k_blk, v_blk = coll.ppermute((k_blk, v_blk), axis_name, perm)
+        o_blk, lse_blk = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=False, sm_scale=sm_scale
+        )
+        if causal:
+            # Global causality between shard indices: an earlier KV shard
+            # is fully visible, a later one fully masked — drop it by
+            # sending its lse to NEG_INF so the merge weight is exp→0.
+            kv_idx = (my_idx - step) % axis_size
+            lse_blk = jnp.where(kv_idx < my_idx, lse_blk, NEG_INF)
+        out, lse = _merge(out, lse, o_blk, lse_blk)
+        return (out, lse, k_blk, v_blk), None
 
-    # Initial carries derived from q (not fresh zeros) so they inherit
-    # q's varying-axes type under shard_map; XLA folds the dead arithmetic.
-    acc0 = jnp.zeros_like(qf)
-    m0 = acc0[..., 0] - _STABLE_MIN
-    l0 = acc0[..., 0]
-    # Remat the body: recompute each block's scores in backward instead of
-    # saving c × [s_loc, s_loc] score matrices. The final block merges
-    # outside the scan so its KV shard is not pointlessly rotated onward
-    # (saves 1/c of all ring traffic).
-    (m, l, acc, k_last, v_last), _ = lax.scan(
-        jax.checkpoint(body), (m0, l0, acc0, k, v), jnp.arange(axis_size - 1)
+    # Remat the body: recompute each hop's flash attend in backward
+    # instead of saving per-hop (o, lse) pairs. axis_size-1 iterations,
+    # so the last shard is never pointlessly rotated onward (saves 1/c of
+    # all ring traffic).
+    (out, lse, _, _), _ = jax.lax.scan(
+        jax.checkpoint(body), (out, lse, k, v), jnp.arange(1, axis_size)
     )
-    m, l, acc = jax.checkpoint(merge)(
-        (m, l, acc), axis_size - 1, k_last, v_last
-    )
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
 
@@ -141,7 +125,7 @@ def ulysses_attention(
     heads % axis_size == 0. Reshards seq→heads, runs the local Pallas
     flash kernel over the full sequence, reshards back.
     """
-    axis_size = lax.axis_size(axis_name)
+    axis_size = coll.axis_size(axis_name)
     if axis_size == 1:
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     h = q.shape[1]
@@ -150,11 +134,8 @@ def ulysses_attention(
 
     # [B, H, s, D] → [B, H/c, S, D]: gather seq, scatter heads.
     to_seq = functools.partial(
-        lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2,
-        tiled=True,
+        coll.all_to_all, axis=axis_name, split_axis=1, concat_axis=2
     )
     ql, kl, vl = to_seq(q), to_seq(k), to_seq(v)
     out = flash_attention(ql, kl, vl, causal=causal, sm_scale=sm_scale)
-    return lax.all_to_all(
-        out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
-    )
+    return coll.all_to_all(out, axis_name, split_axis=2, concat_axis=1)

@@ -51,6 +51,27 @@ def _build_trainer(workload, cfg):
     return Trainer(workload.make_task(cfg, mesh=mesh), cfg, mesh=mesh)
 
 
+def _host_eval_batches(test_ds, eval_bs):
+    """Per-host eval slice: host h evaluates rows h::P at batch B/P.
+
+    Matches Trainer.evaluate's multi-process default (per_host=True):
+    hosts read disjoint shards, the jitted step's global weighted sums
+    merge them, padding equalizes differing per-host batch counts.
+    Single-process: the identity (full set, full batch size).
+    """
+    import jax
+
+    from tensorflow_examples_tpu.data.memory import InMemoryDataset
+
+    nproc = jax.process_count()
+    if nproc == 1:
+        return eval_batches(test_ds, eval_bs)
+    local = InMemoryDataset(
+        {k: v[jax.process_index()::nproc] for k, v in test_ds.arrays.items()}
+    )
+    return eval_batches(local, max(eval_bs // nproc, 1))
+
+
 def _iterators(workload, cfg):
     """Resolve (train_iter_fn(start), eval_iter_fn()) from the protocol."""
     eval_bs = cfg.eval_batch_size or cfg.global_batch_size
@@ -61,7 +82,8 @@ def _iterators(workload, cfg):
             if hasattr(workload, "make_eval_iter")
             else None
         )
-        return train_fn, eval_fn
+        local = getattr(workload, "train_iter_is_per_host", lambda c: False)(cfg)
+        return train_fn, eval_fn, local
     train_ds, test_ds = workload.datasets(cfg)
     augment = (
         workload.train_augment(cfg) if hasattr(workload, "train_augment") else None
@@ -73,8 +95,8 @@ def _iterators(workload, cfg):
         start_step=start,
         augment=augment,
     )
-    eval_fn = lambda: eval_batches(test_ds, eval_bs)
-    return train_fn, eval_fn
+    eval_fn = lambda: _host_eval_batches(test_ds, eval_bs)
+    return train_fn, eval_fn, False  # in-memory iterators are global-view
 
 
 def _eval_iterator(workload, cfg):
@@ -88,7 +110,7 @@ def _eval_iterator(workload, cfg):
         return None
     else:
         _, test_ds = workload.datasets(cfg)
-    return lambda: eval_batches(test_ds, eval_bs)
+    return lambda: _host_eval_batches(test_ds, eval_bs)
 
 
 def train_main(workload, default_cfg):
@@ -98,9 +120,11 @@ def train_main(workload, default_cfg):
     def main(argv):
         del argv
         cfg = _setup(workload, default_cfg)
-        train_fn, eval_fn = _iterators(workload, cfg)
+        train_fn, eval_fn, local = _iterators(workload, cfg)
         trainer = _build_trainer(workload, cfg)
-        metrics = trainer.fit(train_fn, eval_iter_fn=eval_fn)
+        metrics = trainer.fit(
+            train_fn, eval_iter_fn=eval_fn, local_batches=local
+        )
         print({k: round(v, 4) for k, v in metrics.items()})
 
     return main

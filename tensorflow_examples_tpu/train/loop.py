@@ -252,6 +252,7 @@ class Trainer:
         *,
         eval_iter_fn: Callable[[], Iterable] | None = None,
         num_steps: int | None = None,
+        local_batches: bool = False,
     ) -> dict[str, float]:
         """Run the training loop; returns final logged metrics.
 
@@ -259,6 +260,11 @@ class Trainer:
         callable ``(start_step) -> iterator`` invoked after checkpoint
         restore, so a resumed run consumes exactly the batches the
         uninterrupted run would have.
+
+        ``local_batches``: the iterator yields THIS process's
+        ``global_batch / process_count`` rows (per-host data sources
+        like TFRecord shards) assembled via ``put_local_batch``; False
+        (default) = global-view batches identical on every process.
         """
         cfg = self.config
         num_steps = num_steps or cfg.train_steps
@@ -288,7 +294,11 @@ class Trainer:
                 train_iter = train_data
             # Async look-ahead transfer: batch N+1 streams into HBM while
             # step N runs (the reference's prefetch-to-device equivalent).
-            train_iter = device_prefetch(train_iter, self._batch_sharding)
+            train_iter = device_prefetch(
+                train_iter,
+                self._batch_sharding,
+                local_batches=local_batches and jax.process_count() > 1,
+            )
 
             profiling = False
             evaluated_now = False
@@ -378,15 +388,39 @@ class Trainer:
             if watchdog is not None:
                 watchdog.stop()
 
-    def evaluate(self, eval_iter: Iterable) -> dict[str, float]:
-        """Metric-accumulating eval pass (SURVEY.md §3(3))."""
+    def evaluate(
+        self, eval_iter: Iterable, *, per_host: bool | None = None
+    ) -> dict[str, float]:
+        """Metric-accumulating eval pass (SURVEY.md §3(3)).
+
+        ``per_host``: treat ``eval_iter`` as THIS process's shard of the
+        eval set — each batch ``global_batch / process_count`` rows of
+        data only this host read (e.g. per-host TFRecord shards). Hosts
+        may hold differing numbers of batches: shorter hosts feed
+        zero-weight padding until the longest is exhausted, and because
+        the jitted eval step reduces its weighted sums over the GLOBAL
+        batch, every host returns the identical merged metric — the
+        cross-process reduction the reference got from NCCL metric
+        all-reduce (SURVEY.md §3(3)). Defaults to True when
+        ``jax.process_count() > 1``.
+        """
         if self._eval_step is None:
             return {}
+        if per_host is None:
+            per_host = jax.process_count() > 1
+        per_host = per_host and jax.process_count() > 1
+        batches = (
+            _pad_per_host_batches(iter(eval_iter))
+            if per_host
+            else iter(eval_iter)
+        )
         # Accumulate on device; convert to host floats once at the end so
         # eval steps pipeline instead of syncing per batch.
         totals: dict[str, jax.Array] = {}
         count = None
-        for batch in device_prefetch(iter(eval_iter), self._batch_sharding):
+        for batch in device_prefetch(
+            batches, self._batch_sharding, local_batches=per_host
+        ):
             m = dict(
                 self._eval_step(self.state.params, self.state.model_state, batch)
             )
@@ -402,6 +436,50 @@ class Trainer:
         if self.task.eval_finalize is not None:
             means = dict(self.task.eval_finalize(means))
         return means
+
+
+def _pad_per_host_batches(it: Iterator) -> Iterator:
+    """Equalize per-host eval streams: every host yields batches until
+    the longest host's stream is exhausted, padding with zero-weight
+    copies — STREAMING, one batch resident at a time (a buffered
+    formulation would hold a host's whole decoded eval shard in RAM).
+
+    Per batch, hosts allgather a have-more flag (a scalar host-level
+    sync — negligible next to the eval step itself). Each real batch
+    gets an explicit per-row ``mask`` (ones if absent) so a padding
+    batch — mask of zeros — contributes zero weight to the jitted
+    step's global weighted sums. A host with ZERO local batches cannot
+    fabricate a padding template, so that condition raises the same
+    error on every host at the first flag exchange — a clean collective
+    failure instead of peers deadlocking in the next collective.
+    """
+    from jax.experimental import multihost_utils
+
+    pad = None
+    first = True
+    while True:
+        batch = next(it, None)
+        flags = multihost_utils.process_allgather(
+            np.asarray(0 if batch is None else 1)
+        )
+        if first and flags.min() != flags.max():
+            raise ValueError(
+                "per-host eval requires at least one local batch on "
+                "every host (needed as the zero-weight padding "
+                f"template); have-batch flags across hosts: {flags}"
+            )
+        first = False
+        if flags.max() == 0:
+            return
+        if batch is None:
+            yield pad
+            continue
+        batch = dict(batch)
+        if "mask" not in batch:
+            rows = len(next(iter(batch.values())))
+            batch["mask"] = np.ones(rows, np.float32)
+        pad = {k: np.zeros_like(v) for k, v in batch.items()}
+        yield batch
 
 
 def _make_writer(workdir: str):

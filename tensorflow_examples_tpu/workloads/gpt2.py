@@ -46,6 +46,7 @@ class Gpt2Config(TrainConfig):
     # `model` mesh axis). 0 = dense GPT-2.
     moe_experts: int = 0
     moe_every: int = 2
+    moe_top_k: int = 1
     moe_aux_weight: float = 0.01
     # Vocab-parallel LM head + fused CE over the `model` axis (Megatron
     # parallel cross-entropy): the [tokens, 50257] logits never exist;
@@ -75,6 +76,7 @@ def model_config(cfg: Gpt2Config) -> transformer.TransformerConfig:
         remat=cfg.remat,
         moe_experts=cfg.moe_experts,
         moe_every=cfg.moe_every,
+        moe_top_k=cfg.moe_top_k,
     )
 
 
@@ -143,19 +145,28 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
                 labels.reshape(-1),
                 fused=cfg.fused_ce,
             )
-        moe_aux = (
-            sum(jax.tree.leaves(aux["intermediates"])) if cfg.moe_experts else 0.0
-        )
-        return nll.reshape(labels.shape), moe_aux
+        moe_aux, moe_drop = jnp.float32(0.0), jnp.float32(0.0)
+        if cfg.moe_experts:
+            # Sown intermediates: {"h_i": {"moe": {"moe_aux": (v,),
+            # "moe_drop": (v,)}}} — sum the aux losses, average the
+            # dropped-token fractions over the MoE layers.
+            flat = jax.tree_util.tree_flatten_with_path(aux["intermediates"])[0]
+            auxes = [v for p, v in flat if "moe_aux" in jax.tree_util.keystr(p)]
+            drops = [v for p, v in flat if "moe_drop" in jax.tree_util.keystr(p)]
+            moe_aux = sum(auxes)
+            moe_drop = sum(drops) / max(len(drops), 1)
+        return nll.reshape(labels.shape), moe_aux, moe_drop
 
     def loss_fn(params, model_state, batch, *, rng, train):
-        nll, moe_aux = token_nll(params, batch, rng=rng, train=train)
+        nll, moe_aux, moe_drop = token_nll(params, batch, rng=rng, train=train)
         loss = jnp.mean(nll) + cfg.moe_aux_weight * moe_aux
-        metrics = {"moe_aux": moe_aux} if cfg.moe_experts else {}
+        metrics = (
+            {"moe_aux": moe_aux, "moe_drop": moe_drop} if cfg.moe_experts else {}
+        )
         return loss, metrics, model_state
 
     def eval_fn(params, model_state, batch):
-        nll, _ = token_nll(params, batch, rng=None, train=False)
+        nll, _, _ = token_nll(params, batch, rng=None, train=False)
         per_example = jnp.mean(nll, axis=-1)
         mask = batch.get("mask")
         return {

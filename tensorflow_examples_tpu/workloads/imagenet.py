@@ -93,12 +93,32 @@ def make_task(cfg: ImagenetConfig, mesh=None) -> Task:
 # --data_dir holds `train-*` shards, synthetic stream otherwise.
 
 
+def train_iter_is_per_host(cfg: ImagenetConfig) -> bool:
+    """Pipeline protocol (train/cli.py): the TFRecord path shards files
+    by host, so each host yields only its global_batch/P rows — fed via
+    put_local_batch. The synthetic stream is seeded identically on all
+    hosts (global view)."""
+    return imagenet_data.has_tfrecords(cfg.data_dir, "train")
+
+
 def make_train_iter(cfg: ImagenetConfig, start_step: int):
+    import jax
+
     if imagenet_data.has_tfrecords(cfg.data_dir, "train"):
+        nproc = jax.process_count()
+        if cfg.global_batch_size % nproc:
+            raise ValueError(
+                f"global_batch_size {cfg.global_batch_size} not divisible "
+                f"by process_count {nproc}"
+            )
+        # Per-host rows only: each host decodes exactly the examples its
+        # own devices consume (global-view feeding would decode the full
+        # global batch on EVERY host and discard (P-1)/P of the work —
+        # on the benchmark-critical input pipeline).
         return imagenet_data.tfrecord_iter(
             cfg.data_dir,
             "train",
-            cfg.global_batch_size,
+            cfg.global_batch_size // nproc,
             train=True,
             image_size=cfg.image_size,
             seed=cfg.seed,
@@ -113,7 +133,14 @@ def make_train_iter(cfg: ImagenetConfig, start_step: int):
 
 
 def make_eval_iter(cfg: ImagenetConfig):
-    batch = cfg.eval_batch_size or cfg.global_batch_size
+    import jax
+
+    # Per-host eval semantics (Trainer.evaluate(per_host=True) in
+    # multi-process runs): each host reads its own shard and yields
+    # global_batch / process_count rows per batch; the jitted step's
+    # global reduction merges hosts exactly.
+    nproc = jax.process_count()
+    batch = max((cfg.eval_batch_size or cfg.global_batch_size) // nproc, 1)
     if imagenet_data.has_tfrecords(cfg.data_dir, "validation"):
         return imagenet_data.tfrecord_iter(
             cfg.data_dir,
@@ -127,4 +154,5 @@ def make_eval_iter(cfg: ImagenetConfig):
         image_size=cfg.image_size,
         num_classes=cfg.num_classes,
         batches=cfg.eval_batches,
+        seed=1 + jax.process_index(),
     )

@@ -167,3 +167,49 @@ class TestSchedules:
         sched1 = warmup_cosine(cfg.replace(grad_accum_steps=1))
         assert float(sched1(1000)) < 1e-6
         assert float(sched1(100)) == pytest.approx(1.0)
+
+
+class TestCollectivesFacade:
+    """core/collectives.py (SURVEY.md §5h): the shard_map collective
+    surface — semantics checked against numpy on an 8-device axis."""
+
+    def test_psum_allgather_reducescatter_ppermute(self, devices):
+        from jax.sharding import Mesh
+
+        from tensorflow_examples_tpu.core import collectives as coll
+
+        n = 8
+        mesh = Mesh(np.asarray(jax.devices()[:n]), ("x",))
+        x = np.arange(n * 8, dtype=np.float32).reshape(n, 8)
+
+        def f(v):
+            v = v[0]  # local shard [8]
+            return {
+                "psum": coll.psum(v, "x"),
+                "gather": coll.all_gather(v, "x"),
+                "rs": coll.reduce_scatter(v, "x"),
+                "hop": coll.ppermute(v, "x", coll.ring_perm(n)),
+            }
+
+        out = jax.jit(
+            jax.shard_map(
+                f,
+                mesh=mesh,
+                in_specs=P("x"),
+                out_specs={
+                    "psum": P(),
+                    "gather": P(),
+                    "rs": P("x"),
+                    "hop": P("x"),
+                },
+                check_vma=False,
+            )
+        )(x)
+        np.testing.assert_allclose(np.asarray(out["psum"]), x.sum(0))
+        np.testing.assert_allclose(np.asarray(out["gather"]), x.reshape(-1))
+        # reduce_scatter: every rank keeps 1/8 of the summed [8] vector;
+        # out_specs P("x") re-assembles the shards back into the full sum.
+        np.testing.assert_allclose(np.asarray(out["rs"]), x.sum(0))
+        np.testing.assert_allclose(
+            np.asarray(out["hop"]), np.roll(x, 1, axis=0).reshape(-1)
+        )

@@ -211,3 +211,24 @@ def test_prepare_glue_pair_task(tmp_path):
     d = np.load(out / "rte_validation.npz")
     assert d["token_type_ids"].max() == 1  # pair → second segment present
     assert set(d["label"].tolist()) == {0, 1}
+
+
+def test_stdlib_re_fallback_pattern_is_lossless():
+    """The `re` fallback pre-tokenizer (used only when the `regex`
+    package is absent) must still cover every character — underscores
+    are the trap: "_" is \\w but not a letter class member."""
+    import re
+
+    # Mirror of the fallback pattern in data/tokenizers.py.
+    pat = re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+"
+        r"|\s+(?!\S)|\s+",
+        re.UNICODE,
+    )
+    for text in [
+        "foo_bar",
+        "__init__ = a_1 + b_2",
+        "mixed _lead and trail_ cases",
+        "the quick brown fox! 42 times?",
+    ]:
+        assert "".join(pat.findall(text)) == text, text

@@ -382,18 +382,50 @@ def _make_flash_lse(causal, block_q, block_kv, interpret):
     return flash
 
 
+_DEFAULT_BLOCK = 256  # fastest measured end-to-end at GPT-2 shapes (v5e)
+
+
+def _fit_block(target: int, seq: int) -> int:
+    """Auto block size: the largest divisor of ``seq`` ≤ ``target`` that
+    is a multiple of 128 (TPU lane width), else of 8 (sublane), else —
+    no hardware-legal tiling exists — a clear error. The full sequence
+    as one block is always legal (Pallas pads internally)."""
+    b = min(target, seq)
+    if seq % b == 0:
+        return b
+    for cand in range(b - b % 128, 0, -128):
+        if seq % cand == 0:
+            return cand
+    for cand in range(b - b % 8, 0, -8):
+        if seq % cand == 0:
+            return cand
+    raise ValueError(
+        f"sequence length {seq} has no multiple-of-8 block divisor "
+        f"<= {target}; pad the sequence to a multiple of 8"
+    )
+
+
+def _resolve_block(block: int | None, seq: int) -> int:
+    """Explicit block sizes are honored exactly (divisibility enforced,
+    never silently overridden); None selects the auto fit."""
+    if block is None:
+        return _fit_block(_DEFAULT_BLOCK, seq)
+    b = min(block, seq)
+    if seq % b:
+        raise ValueError(
+            f"sequence length {seq} is not divisible by block size {b}; "
+            "pass block sizes that divide it, or None for auto"
+        )
+    return b
+
+
 def _prepare(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, h, seq_q, head_dim = q.shape
     seq_kv = k.shape[2]
-    block_q = min(block_q, seq_q)
-    block_kv = min(block_kv, seq_kv)
-    if seq_q % block_q or seq_kv % block_kv:
-        raise ValueError(
-            f"seq lengths ({seq_q}, {seq_kv}) must be divisible by block "
-            f"sizes ({block_q}, {block_kv})"
-        )
+    block_q = _resolve_block(block_q, seq_q)
+    block_kv = _resolve_block(block_kv, seq_kv)
     if causal and seq_q > seq_kv:
         # Rows with zero visible keys are degenerate (the reference
         # softmaxes an all-masked row into uniform weights; the kernel
@@ -413,14 +445,18 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Blockwise attention, differentiable; q/k/v: [batch, heads, seq, dim].
 
     Runs the Pallas TPU kernel on TPU; on other backends runs the same
     kernel in interpret mode (tests) unless ``interpret=False``.
+    block_q/block_kv None = auto: 256-targeted (measured ~1.3% faster
+    end-to-end than 128 on GPT-2 124M, b8 s1024, single v5e chip,
+    within-run comparison), fitted down to a hardware-legal divisor of
+    the sequence; explicit sizes are enforced exactly.
     """
     sm_scale, block_q, block_kv, interpret = _prepare(
         q, k, v, causal, sm_scale, block_q, block_kv, interpret
@@ -439,8 +475,8 @@ def flash_attention_with_lse(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Like ``flash_attention`` but also returns the row logsumexp

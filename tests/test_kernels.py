@@ -144,3 +144,35 @@ class TestFusedCrossEntropy:
         nll = cross_entropy_per_example(logits, labels, fused=True)
         ref = cross_entropy_reference(logits.astype(jnp.float32), labels)
         np.testing.assert_allclose(nll, ref, atol=2e-2, rtol=2e-2)
+
+
+def test_block_autofit_odd_lengths():
+    """Auto (None) block sizes must fit sequences the 256 target doesn't
+    divide, stepping down through hardware-legal (multiple-of-128, then
+    multiple-of-8) divisors; pathological lengths raise instead of
+    degenerating, and explicit block sizes are enforced, not overridden."""
+    import jax
+    import pytest
+
+    from tensorflow_examples_tpu.ops.attention import (
+        _fit_block,
+        _resolve_block,
+        attention_reference,
+        flash_attention,
+    )
+
+    assert _fit_block(256, 384) == 128  # prefers the 128-multiple divisor
+    assert _fit_block(256, 320) == 160  # no 128-multiple divides 320; 8-mult
+    assert _fit_block(256, 256) == 256
+    assert _fit_block(256, 100) == 100  # whole sequence as one block
+    with pytest.raises(ValueError):  # 1021 prime: no legal tiling
+        _fit_block(256, 1021)
+    with pytest.raises(ValueError):  # explicit size that doesn't divide
+        _resolve_block(192, 1024)
+    for s in (320, 384):
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, s, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, s, 64))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, s, 64))
+        out = flash_attention(q, k, v, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

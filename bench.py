@@ -37,33 +37,33 @@ import subprocess
 import sys
 import time
 
-# Regression floors: first value measured per (backend, metric), each
-# annotated with the rig fingerprint at measurement time. vs_baseline is
-# only computed against a floor for the SAME backend; the fingerprint
-# pair in the output says whether the comparison crosses rig behavior.
+# Regression floors: first (value, rig_fingerprint_tflops) measured per
+# (backend, metric). The fingerprint is the raw-matmul probe AT THE TIME
+# that floor was taken — this tunnel's behavior drifts 31k–61k TFLOP/s
+# between runs, so vs_baseline is only interpretable next to the
+# fingerprint pair, which every result emits (floor's and current).
+# r1's gpt2=3224304 tok/s and mnist=0.0702 ms were taken at the 61k
+# fingerprint and are kept as history in BASELINE.md, not floors.
 FLOORS = {
     "tpu": {
-        # 2026-07-29 round-2 full sweep — ONE coherent measurement set at
-        # one fingerprint (the r1 floors were taken when the tunnel
-        # measured ~61k TFLOP/s; it now measures ~31k, so r1's
-        # gpt2=3224304 tok/s and mnist=0.0702 ms are kept as history in
-        # BASELINE.md, not comparable floors).
-        "_fingerprint_tflops": 31055.0,
-        "resnet50_examples_per_sec_per_chip": 62392.0,
-        "resnet50_input_examples_per_sec_per_chip": 88.2,  # 1-CPU host!
-        "gpt2_124m_tokens_per_sec": 2931492.0,
-        "gpt2_long4k_tokens_per_sec": 2861037.0,
-        "gpt2_long16k_tokens_per_sec": 4157890.0,
-        "mnist_mlp_step_time": 0.18,  # ms/step
-        "allreduce_busbw": 3396.0,  # GB/s, n=1 (loopback; real ICI needs >1 chip)
+        # 2026-07-29 round-2 measurements.
+        "resnet50_examples_per_sec_per_chip": (62392.0, 31055.0),
+        "resnet50_input_examples_per_sec_per_chip": (88.2, 31055.0),  # 1-CPU host!
+        "gpt2_124m_tokens_per_sec": (2931492.0, 31055.0),
+        "gpt2_long4k_tokens_per_sec": (2861037.0, 31055.0),
+        "gpt2_long16k_tokens_per_sec": (4157890.0, 31055.0),
+        "gpt2_decode_tokens_per_sec": (1808924.0, 44536.0),
+        "bert_base_examples_per_sec_per_chip": (22286.0, 42508.0),
+        "cifar10_resnet20_examples_per_sec_per_chip": (242176.0, 46991.0),
+        "mnist_mlp_step_time": (0.18, 31055.0),  # ms/step
+        "allreduce_busbw": (3396.0, 31055.0),  # GB/s, n=1 loopback
     },
     "cpu": {
         # 2026-07-29 round 2 first CPU-fallback measurements (this host).
-        "_fingerprint_tflops": 0.08,
-        "resnet50_examples_per_sec_per_chip": 0.62,
-        "resnet50_input_examples_per_sec_per_chip": 0.63,
-        "gpt2_124m_tokens_per_sec": 48.4,
-        "mnist_mlp_step_time": 2.39,  # ms/step
+        "resnet50_examples_per_sec_per_chip": (0.62, 0.08),
+        "resnet50_input_examples_per_sec_per_chip": (0.63, 0.08),
+        "gpt2_124m_tokens_per_sec": (48.4, 0.08),
+        "mnist_mlp_step_time": (2.39, 0.08),  # ms/step
     },
 }
 
@@ -143,7 +143,7 @@ def fingerprint_tflops() -> float:
 
 
 def _result(metric: str, value: float, unit: str, **extra) -> dict:
-    floor = FLOORS.get(BACKEND, {}).get(metric, 0.0)
+    floor, floor_fp = FLOORS.get(BACKEND, {}).get(metric, (0.0, 0.0))
     if "step_time" in metric or "ms" in unit:
         vs = floor / value if floor else 1.0  # lower is better
     else:
@@ -153,6 +153,10 @@ def _result(metric: str, value: float, unit: str, **extra) -> dict:
         "value": round(value, 4),
         "unit": unit,
         "vs_baseline": round(vs, 4),
+        # The fingerprint this metric's floor was measured at — compare
+        # with the top-level current fingerprint before reading
+        # vs_baseline as a real regression/improvement.
+        "floor_fingerprint_tflops": floor_fp,
         **extra,
     }
 
@@ -378,6 +382,122 @@ def bench_gpt2_long16k() -> dict:
     )
 
 
+def bench_gpt2_decode() -> dict:
+    """KV-cache sampling throughput (the reference's eval.py sampling
+    path): prefill 128-token prompts, decode 128 tokens per sequence
+    through the static-shape cache, one jitted program."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.models import transformer
+    from tensorflow_examples_tpu.workloads import gpt2
+
+    tpu = BACKEND == "tpu"
+    batch = 8 if tpu else 1
+    dec = 128 if tpu else 16
+    cfg = (
+        gpt2.Gpt2Config(dropout=0.0, attention="xla")
+        if tpu
+        else gpt2.Gpt2Config(
+            vocab_size=256, seq_len=64, num_layers=2, num_heads=2,
+            d_model=64, dropout=0.0, attention="xla",
+        )
+    )
+    model = transformer.Transformer(gpt2.model_config(cfg))
+    prompt = jnp.ones((batch, 128 if tpu else 16), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, prompt)["params"]
+    if tpu:
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+
+    gen = jax.jit(
+        lambda p, pr, rng: transformer.generate(
+            model, p, pr, num_tokens=dec, rng=rng, temperature=1.0, top_k=40
+        )
+    )
+    rng = jax.random.PRNGKey(1)
+    gen(params, prompt, rng).block_until_ready()
+    iters = 5 if tpu else 2
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = gen(params, prompt, jax.random.PRNGKey(i))
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return _result(
+        "gpt2_decode_tokens_per_sec",
+        iters * batch * dec / dt,
+        "tokens/sec/chip",
+        batch=batch,
+        decode_len=dec,
+    )
+
+
+def bench_bert() -> dict:
+    """BERT-base GLUE fine-tune throughput (examples/sec/chip, seq 128)."""
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import bert_glue
+
+    tpu = BACKEND == "tpu"
+    cfg = bert_glue.BertGlueConfig(
+        global_batch_size=32 if tpu else 4,
+        precision="bf16" if tpu else "f32",
+        dropout=0.0,
+        log_every=10**9,
+        checkpoint_every=0,
+        eval_every=0,
+        train_steps=10**6,
+        watchdog_secs=0,
+        **({} if tpu else dict(
+            seq_len=32, vocab_size=512, num_layers=2, num_heads=2,
+            d_model=32, d_ff=64,
+        )),
+    )
+    steps, warmup = (20, 5) if tpu else (3, 1)
+    trainer = Trainer(bert_glue.make_task(cfg), cfg, mesh=_chip_mesh())
+    ds, _ = bert_glue.datasets(cfg)
+    it = train_iterator(ds, cfg.global_batch_size, seed=0)
+    batches = [trainer._put_batch(next(it)) for _ in range(2)]
+    dt = _time_steps(trainer, batches, steps, warmup)
+    return _result(
+        "bert_base_examples_per_sec_per_chip",
+        steps * cfg.global_batch_size / dt,
+        "examples/sec/chip",
+        batch=cfg.global_batch_size,
+        seq=cfg.seq_len,
+    )
+
+
+def bench_cifar10() -> dict:
+    """CIFAR-10 ResNet-20 training throughput (single-device workload)."""
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.data.sources import synthetic_images
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import cifar10
+
+    tpu = BACKEND == "tpu"
+    cfg = cifar10.Cifar10Config(
+        global_batch_size=128 if tpu else 16,
+        precision="bf16" if tpu else "f32",
+        log_every=10**9,
+        checkpoint_every=0,
+        eval_every=0,
+        train_steps=10**6,
+        watchdog_secs=0,
+    )
+    steps, warmup = (30, 5) if tpu else (3, 1)
+    trainer = Trainer(cifar10.make_task(cfg), cfg, mesh=_chip_mesh())
+    ds = synthetic_images(n=2048, shape=(32, 32, 3), num_classes=10, seed=0)
+    it = train_iterator(ds, cfg.global_batch_size, seed=0)
+    batches = [trainer._put_batch(next(it)) for _ in range(4)]
+    dt = _time_steps(trainer, batches, steps, warmup)
+    return _result(
+        "cifar10_resnet20_examples_per_sec_per_chip",
+        steps * cfg.global_batch_size / dt,
+        "examples/sec/chip",
+        batch=cfg.global_batch_size,
+    )
+
+
 # ----------------------------------------------------------------- mnist
 
 
@@ -482,6 +602,9 @@ BENCHES = {
     "gpt2": bench_gpt2,
     "gpt2_long": bench_gpt2_long,
     "gpt2_long16k": bench_gpt2_long16k,
+    "gpt2_decode": bench_gpt2_decode,
+    "bert": bench_bert,
+    "cifar10": bench_cifar10,
     "mnist": bench_mnist,
     "collectives": bench_collectives,
 }
@@ -493,6 +616,9 @@ ALL_ORDER = [
     "gpt2",
     "gpt2_long",
     "gpt2_long16k",
+    "gpt2_decode",
+    "bert",
+    "cifar10",
     "mnist",
     "collectives",
 ]
@@ -530,9 +656,6 @@ def main() -> int:
         out = run_all() if which == "all" else BENCHES[which]()
         out["backend"] = BACKEND
         out["fingerprint_tflops"] = fp
-        out["floor_fingerprint_tflops"] = FLOORS.get(BACKEND, {}).get(
-            "_fingerprint_tflops", 0.0
-        )
     except Exception as e:
         out = {
             "error": f"{type(e).__name__}: {e}",

@@ -170,8 +170,15 @@ class Attention(nn.Module):
         )
         idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
         i0 = idx.value
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, i0, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, i0, 0, 0))
+        # The cache may have been allocated under a different param dtype
+        # (init_cache builds it via eval_shape with f32 init; sampling
+        # often runs bf16 params) — store in the cache's dtype.
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(ck.value.dtype), (0, i0, 0, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cv.value.dtype), (0, i0, 0, 0)
+        )
         idx.value = i0 + q_len
 
         s = jnp.einsum(
@@ -334,20 +341,28 @@ def sharding_rules(extra: ShardingRules | None = None) -> ShardingRules:
 # ---------------------------------------------------------------- decoding
 
 
-def init_cache(model: Transformer, batch_size: int):
+def init_cache(model: Transformer, batch_size: int, dtype=None):
     """Allocate an empty KV cache (flax 'cache' collection).
 
     Built from eval_shape + zeros rather than ``model.init``: a real init
     call *runs* the decode step, which would advance the cache index past
-    the dummy token.
+    the dummy token. ``dtype`` overrides the floating leaves (pass the
+    params dtype so a bf16 model keeps a bf16 cache — half the HBM).
     """
     tokens = jnp.zeros((batch_size, 1), jnp.int32)
     shapes = jax.eval_shape(
         lambda: model.init({"params": jax.random.PRNGKey(0)}, tokens, decode=True)
     )
-    return jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
-    )
+
+    def zeros(s):
+        use = (
+            dtype
+            if dtype is not None and jnp.issubdtype(s.dtype, jnp.floating)
+            else s.dtype
+        )
+        return jnp.zeros(s.shape, use)
+
+    return jax.tree.map(zeros, shapes["cache"])
 
 
 def generate(
@@ -371,7 +386,10 @@ def generate(
             f"prompt ({prompt_len}) + num_tokens ({num_tokens}) exceeds "
             f"max_len ({model.cfg.max_len})"
         )
-    cache = init_cache(model, b)
+    # Cache dtype follows the token-embedding table — the deliberate
+    # compute-dtype anchor (an arbitrary first leaf could be an f32
+    # master bias in a mixed-precision tree and double the KV HBM).
+    cache = init_cache(model, b, dtype=params["wte"]["embedding"].dtype)
     logits, vars_out = model.apply(
         {"params": params, "cache": cache}, prompt, decode=True,
         mutable=["cache"],

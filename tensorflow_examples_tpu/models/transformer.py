@@ -447,7 +447,7 @@ class EmbedHead(nn.Module):
         return self.logits(self.encode(tokens))
 
     @nn.compact
-    def encode(self, tokens):
+    def encode(self, tokens, train: bool = False):
         cfg = self.cfg
         wte = nn.Embed(
             cfg.vocab_size, cfg.d_model,
@@ -458,7 +458,10 @@ class EmbedHead(nn.Module):
             embedding_init=nn.initializers.normal(0.01), name="wpe",
         )
         positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
-        return wte(tokens) + wpe(positions)[None]
+        x = wte(tokens) + wpe(positions)[None]
+        # Same embedding dropout as Transformer.__call__ — the PP and
+        # non-PP paths must train the same effective model.
+        return nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
     @nn.compact
     def logits(self, x):
@@ -479,12 +482,34 @@ def init_stacked_blocks(cfg: TransformerConfig, rng, *, train: bool = False):
     return jax.vmap(lambda k: block.init({"params": k}, dummy)["params"])(keys)
 
 
-def apply_stacked_blocks(cfg: TransformerConfig, params, x, *, train: bool = False):
-    """Sequentially apply a [k]-stacked Block param tree to x."""
+def apply_stacked_blocks(
+    cfg: TransformerConfig, params, x, *, train: bool = False, rng=None
+):
+    """Sequentially apply a [k]-stacked Block param tree to x.
+
+    ``rng``: dropout key when ``train`` and ``cfg.dropout > 0`` — folded
+    per layer so each block in the stack drops independently."""
     block = Block(cfg, None, train, False)
+    k = jax.tree.leaves(params)[0].shape[0]
+    use_rng = rng is not None and train and cfg.dropout > 0
 
-    def one(carry, p):
-        return block.apply({"params": p}, carry), None
+    def one(carry, pi):
+        p, i = pi
+        rngs = {"dropout": jax.random.fold_in(rng, i)} if use_rng else None
+        return block.apply({"params": p}, carry, rngs=rngs), None
 
-    y, _ = jax.lax.scan(one, x, params)
+    y, _ = jax.lax.scan(one, x, (params, jnp.arange(k)))
     return y
+
+
+def stack_params_for_pipeline(params, num_layers: int):
+    """Convert a standard ``Transformer`` param tree (wte/wpe/h_i/ln_f —
+    e.g. from models/hf_import.import_gpt2) into the pipeline layout:
+    ``{"embed": {wte, wpe, ln_f}, "blocks": [L]-stacked h_i}``.
+    ``EmbedHead`` uses the same param names, so embed slots in as-is."""
+    embed = {k: params[k] for k in ("wte", "wpe", "ln_f")}
+    blocks = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[params[f"h_{i}"] for i in range(num_layers)],
+    )
+    return {"embed": embed, "blocks": blocks}

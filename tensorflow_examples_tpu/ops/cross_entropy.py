@@ -151,12 +151,17 @@ def cross_entropy_per_example(
     logits: jax.Array,
     labels: jax.Array,
     *,
-    block_n: int = 128,
-    block_v: int = 2048,
+    block_n: int = 256,
+    block_v: int = 4096,
     fused: bool | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Per-example NLL [N] (f32) from logits [N, V] and int labels [N]."""
+    """Per-example NLL [N] (f32) from logits [N, V] and int labels [N].
+
+    Default blocks 256×4096: ~4% faster fwd and grad than 128×2048 at
+    the GPT-2 shape (8192 tokens × 50257 vocab, bf16, single v5e,
+    within-run sweep); 512×4096 exceeds the compiler's VMEM budget.
+    Blocks clamp to the actual (n, vocab) for small shapes."""
     if fused is None:
         fused = True
     if interpret is None:

@@ -33,11 +33,13 @@ from tensorflow_examples_tpu.core import collectives as coll
 from tensorflow_examples_tpu.core.mesh import AxisNames
 
 
-def _gpipe_local(stage_fn, params, x_mb, axis_name):
+def _gpipe_local(stage_fn, params, x_mb, axis_name, rng=None):
     """Per-device GPipe schedule (runs inside shard_map).
 
     params: this device's stage params (leading [1, ...] stage dim kept).
     x_mb: [M, mb, ...] microbatched input, replicated over the pipe axis.
+    rng: optional dropout key — folded per (stage, tick), which is
+    per (stage, microbatch) since a stage sees one microbatch per tick.
     Returns [M, mb, ...] outputs, valid on every device (psum-broadcast).
     """
     n_stages = lax.axis_size(axis_name)
@@ -45,6 +47,8 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name):
     m = x_mb.shape[0]
     fwd_perm = coll.ring_perm(n_stages)
     params = jax.tree.map(lambda p: p[0], params)  # drop the stage dim
+    if rng is not None:
+        rng = jax.random.fold_in(rng, stage)
 
     def tick(carry, t):
         state, out = carry
@@ -52,7 +56,10 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name):
         # activation that arrived last tick.
         mb_idx = jnp.clip(t, 0, m - 1)
         inp = jnp.where(stage == 0, x_mb[mb_idx], state)
-        y = stage_fn(params, inp)
+        if rng is None:
+            y = stage_fn(params, inp)
+        else:
+            y = stage_fn(params, inp, jax.random.fold_in(rng, t))
         # Microbatch k exits the last stage at tick k + P - 1.
         done_idx = t - (n_stages - 1)
         is_done = (stage == n_stages - 1) & (done_idx >= 0) & (done_idx < m)
@@ -84,17 +91,21 @@ def pipeline_apply(
     mesh: Mesh,
     num_microbatches: int,
     batch_spec: P = P((AxisNames.DATA, AxisNames.FSDP)),
+    rng=None,
 ) -> jax.Array:
     """Apply a [stages]-stacked stage over ``x`` with GPipe scheduling.
 
     stage_params: pytree with leading [stages] axis on every leaf,
     sharded over ``pipe``. x: [batch, ...] activations. The batch is
-    split into ``num_microbatches`` along axis 0.
+    split into ``num_microbatches`` along axis 0. With ``rng``,
+    ``stage_fn`` is called as ``stage_fn(params, x, key)`` with a key
+    unique per (stage, microbatch) — the dropout path; without, as
+    ``stage_fn(params, x)``.
     """
     n_stages = mesh.shape[AxisNames.PIPE]
     if n_stages == 1:
         single = jax.tree.map(lambda p: p[0], stage_params)
-        return stage_fn(single, x)
+        return stage_fn(single, x) if rng is None else stage_fn(single, x, rng)
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(
@@ -107,16 +118,27 @@ def pipeline_apply(
     )
     # Microbatched activations: batch dim is now axis 1.
     act_spec = P(None, *batch_spec)
-    out = jax.shard_map(
-        lambda p, xm: _gpipe_local(stage_fn, p, xm, AxisNames.PIPE),
-        mesh=mesh,
-        in_specs=(param_specs, act_spec),
-        out_specs=act_spec,
-        check_vma=False,
-    )(
-        jax.lax.with_sharding_constraint(
-            stage_params, jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs)
-        ),
-        x_mb,
+    constrained = jax.lax.with_sharding_constraint(
+        stage_params, jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs)
     )
+    if rng is None:
+        out = jax.shard_map(
+            lambda p, xm: _gpipe_local(stage_fn, p, xm, AxisNames.PIPE),
+            mesh=mesh,
+            in_specs=(param_specs, act_spec),
+            out_specs=act_spec,
+            check_vma=False,
+        )(constrained, x_mb)
+    else:
+        # rng rides in as an explicit replicated argument (a closure
+        # capture inside shard_map is not reliably supported).
+        out = jax.shard_map(
+            lambda p, xm, r: _gpipe_local(
+                stage_fn, p, xm, AxisNames.PIPE, rng=r
+            ),
+            mesh=mesh,
+            in_specs=(param_specs, act_spec, P()),
+            out_specs=act_spec,
+            check_vma=False,
+        )(constrained, x_mb, rng)
     return out.reshape((b,) + x.shape[1:])

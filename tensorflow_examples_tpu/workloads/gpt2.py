@@ -39,7 +39,7 @@ class Gpt2Config(TrainConfig):
     fused_ce: bool = True
     pretrained: str = ""  # local HF GPT2LMHeadModel path to start from
     # Pipeline parallelism (mesh_pipe > 1): GPipe microbatching over the
-    # `pipe` axis (parallel/pipeline.py). Requires dropout == 0.
+    # `pipe` axis (parallel/pipeline.py).
     num_microbatches: int = 4
     # Mixture-of-Experts: swap every `moe_every`-th block's MLP for a
     # top-1 Switch MoE with this many experts (expert-parallel over the
@@ -214,13 +214,6 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
     from tensorflow_examples_tpu.core.sharding import ShardingRules
     from tensorflow_examples_tpu.parallel.pipeline import pipeline_apply
 
-    if cfg.dropout != 0.0:
-        raise ValueError("pipeline parallelism requires --dropout=0")
-    if cfg.pretrained:
-        raise ValueError(
-            "--pretrained is not supported with --mesh_pipe>1 yet; "
-            "fine-tune on the non-pipelined path (dp/fsdp/tp/sp)"
-        )
     n_stages = mesh.shape[AxisNames.PIPE]
     if cfg.num_layers % n_stages:
         raise ValueError(
@@ -230,36 +223,64 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
     embed_head = transformer.EmbedHead(mcfg)
 
     def init_fn(rng):
+        if cfg.pretrained:
+            from tensorflow_examples_tpu.models.hf_import import import_gpt2
+
+            _, full = import_gpt2(cfg.pretrained, mcfg)
+            full = jax.tree.map(jnp.asarray, full)
+            return {
+                "params": transformer.stack_params_for_pipeline(
+                    full, cfg.num_layers
+                )
+            }
         r1, r2 = jax.random.split(rng)
         dummy = jnp.zeros((1, cfg.seq_len), jnp.int32)
         embed = embed_head.init({"params": r1}, dummy)["params"]
         blocks = transformer.init_stacked_blocks(mcfg, r2)
         return {"params": {"embed": embed, "blocks": blocks}}
 
-    def logits_fn(params, tokens):
+    def logits_fn(params, tokens, *, rng=None, train=False):
+        dropout = train and cfg.dropout > 0 and rng is not None
+        r_embed, r_blocks = (
+            jax.random.split(rng) if dropout else (None, None)
+        )
         x = embed_head.apply(
-            {"params": params["embed"]}, tokens, method="encode"
+            {"params": params["embed"]},
+            tokens,
+            dropout,  # embedding dropout, same as the non-PP model
+            method="encode",
+            rngs={"dropout": r_embed} if dropout else None,
         )
         per_stage = cfg.num_layers // n_stages
         stage_params = jax.tree.map(
             lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]),
             params["blocks"],
         )
+        stage_fn = (
+            (
+                lambda sp, h, key: transformer.apply_stacked_blocks(
+                    mcfg, sp, h, train=True, rng=key
+                )
+            )
+            if dropout
+            else (lambda sp, h: transformer.apply_stacked_blocks(mcfg, sp, h))
+        )
         x = pipeline_apply(
-            lambda sp, h: transformer.apply_stacked_blocks(mcfg, sp, h),
+            stage_fn,
             stage_params,
             x,
             mesh=mesh,
             num_microbatches=cfg.num_microbatches,
+            rng=r_blocks,
         )
         return embed_head.apply(
             {"params": params["embed"]}, x, method="logits"
         )
 
-    def token_nll(params, batch):
+    def token_nll(params, batch, *, rng=None, train=False):
         inputs = batch["tokens"][:, :-1]
         labels = batch["tokens"][:, 1:]
-        logits = logits_fn(params, inputs)
+        logits = logits_fn(params, inputs, rng=rng, train=train)
         nll = cross_entropy_per_example(
             logits.reshape(-1, cfg.vocab_size),
             labels.reshape(-1),
@@ -268,8 +289,8 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
         return nll.reshape(labels.shape)
 
     def loss_fn(params, model_state, batch, *, rng, train):
-        del rng, train  # dropout is 0 by construction
-        return jnp.mean(token_nll(params, batch)), {}, model_state
+        nll = token_nll(params, batch, rng=rng, train=train)
+        return jnp.mean(nll), {}, model_state
 
     def eval_fn(params, model_state, batch):
         per_example = jnp.mean(token_nll(params, batch), axis=-1)

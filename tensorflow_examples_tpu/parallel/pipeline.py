@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe microbatching over the ``pipe`` mesh axis.
+"""Pipeline parallelism over the ``pipe`` mesh axis: GPipe and 1F1B.
 
 Framework-native extension (SURVEY.md §2d — the reference had no PP; the
 distributed design here treats it as a first-class mesh axis like
@@ -7,17 +7,33 @@ dp/fsdp/tp/sp). TPU-first shape:
 - Stage parameters are the *same pytree* with a leading [stages] axis
   sharded over ``pipe`` — placement is a sharding rule, not a code path,
   exactly like tensor parallelism.
-- The schedule runs inside ``shard_map``: each device applies its own
+- Schedules run inside ``shard_map``: each device applies its own
   stage; activations hop stage→stage with ``jax.lax.ppermute``
-  (nearest-neighbor ICI), microbatches streaming in GPipe order over
-  M + P - 1 ticks. No host round-trips, one compiled program.
-- Differentiable by construction: the backward pass is JAX's transpose
-  of the forward schedule (ppermute transposes to the reverse hop), i.e.
-  the classic reverse pipeline, with per-tick remat to keep the saved
-  state at O(M · microbatch) activations.
+  (nearest-neighbor ICI). No host round-trips, one compiled program.
 
-``pipeline_apply`` is the jit-level entry; ``_gpipe_local`` is the
-per-device program.
+Two schedules:
+
+- **GPipe** (``pipeline_apply``): forward-only building block whose
+  backward is JAX's transpose of the schedule (ppermute transposes to
+  the reverse hop). Microbatches stream over M + P - 1 ticks; bubble
+  ticks SKIP the stage compute via ``lax.cond`` (VERDICT r2 item 3 —
+  previously they burned full FLOPs on clipped garbage). Saved state is
+  O(M · microbatch) activations under per-tick remat.
+- **1F1B** (``make_pipeline_1f1b``): the real training schedule. The
+  per-microbatch loss is computed at the LAST stage inside the
+  scheduled program, so microbatch m's backward starts as soon as its
+  forward leaves the pipe — forwards and backwards interleave in the
+  classic one-forward-one-backward steady state, stages idle only in
+  the unavoidable 2(P-1)-tick ramp, and in-flight activations are
+  bounded by P - s per stage (the 1F1B memory bound) instead of M.
+  Gradients never come from transposing the scan: each backward tick
+  recomputes its stage forward from the stashed input (remat) and
+  accumulates explicit per-stage param grads, which leave the
+  shard_map still sharded over ``pipe``. The schedule itself is
+  simulated in numpy at trace time (`_schedule_1f1b`) — per-tick op
+  tables with machine-checked queue/stash invariants — and the whole
+  thing is wrapped in ``jax.custom_vjp`` so the surrounding
+  embed/optimizer code auto-differentiates through it unchanged.
 """
 
 from __future__ import annotations
@@ -26,6 +42,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -56,10 +73,17 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name, rng=None):
         # activation that arrived last tick.
         mb_idx = jnp.clip(t, 0, m - 1)
         inp = jnp.where(stage == 0, x_mb[mb_idx], state)
-        if rng is None:
-            y = stage_fn(params, inp)
-        else:
-            y = stage_fn(params, inp, jax.random.fold_in(rng, t))
+
+        def run(inp):
+            if rng is None:
+                return stage_fn(params, inp)
+            return stage_fn(params, inp, jax.random.fold_in(rng, t))
+
+        # Stage s only holds a real microbatch during ticks
+        # [s, s + M - 1]; outside that window (the GPipe bubble) skip the
+        # stage compute entirely instead of burning FLOPs on garbage.
+        in_window = (t >= stage) & (t <= stage + m - 1)
+        y = lax.cond(in_window, run, lambda inp: jnp.zeros_like(inp), inp)
         # Microbatch k exits the last stage at tick k + P - 1.
         done_idx = t - (n_stages - 1)
         is_done = (stage == n_stages - 1) & (done_idx >= 0) & (done_idx < m)
@@ -142,3 +166,349 @@ def pipeline_apply(
             check_vma=False,
         )(constrained, x_mb, rng)
     return out.reshape((b,) + x.shape[1:])
+
+
+# ------------------------------------------------------------------ 1F1B
+
+
+def _schedule_1f1b(m: int, p: int):
+    """Simulate the non-interleaved 1F1B schedule for M microbatches
+    over P stages (unit-cost ops, backward-priority) and return static
+    per-tick op tables.
+
+    Greedy rules per tick, per stage s:
+    - run backward of microbatch b if its cotangent is available
+      (last stage: own forward of b done an earlier tick; else: stage
+      s+1 ran backward of b an earlier tick) — backward has priority;
+    - else run forward of microbatch f if its activation is available
+      (stage 0: always; else stage s-1 forwarded f earlier) AND fewer
+      than P - s microbatches are in flight here (the 1F1B bound);
+    - else idle.
+
+    Returns (op[T,P], mb[T,P]) int32 arrays, op ∈ {0 idle, 1 fwd,
+    2 bwd}, plus T. Asserts the invariants the runtime relies on:
+    2-slot receive queues never overwrite unconsumed data, and the
+    P-deep activation stash never overwrites an un-consumed input.
+    """
+    next_f = [0] * p
+    next_b = [0] * p
+    f_tick: dict = {}
+    b_tick: dict = {}
+    ops, mbs = [], []
+    t = 0
+    while any(next_b[s] < m for s in range(p)):
+        if t > 4 * (m + p):  # defensive: schedule must terminate
+            raise AssertionError("1F1B schedule failed to converge")
+        op_row = [0] * p
+        mb_row = [0] * p
+        for s in range(p):
+            b = next_b[s]
+            can_b = b < m and (
+                (s == p - 1 and f_tick.get((b, s), t) < t)
+                or (s < p - 1 and b_tick.get((b, s + 1), t) < t)
+            )
+            f = next_f[s]
+            can_f = (
+                f < m
+                and (s == 0 or f_tick.get((f, s - 1), t) < t)
+                and next_f[s] - next_b[s] < p - s
+            )
+            if can_b:
+                op_row[s], mb_row[s] = 2, b
+                b_tick[(b, s)] = t
+                next_b[s] += 1
+            elif can_f:
+                op_row[s], mb_row[s] = 1, f
+                f_tick[(f, s)] = t
+                next_f[s] += 1
+        ops.append(op_row)
+        mbs.append(mb_row)
+        t += 1
+    # Queue invariant: arrival of microbatch k+2 (same direction, same
+    # edge) must not precede consumption of microbatch k.
+    for s in range(1, p):
+        for k in range(m - 2):
+            assert f_tick[(k, s)] <= f_tick[(k + 2, s - 1)], (s, k)
+    for s in range(p - 1):
+        for k in range(m - 2):
+            assert b_tick[(k, s)] <= b_tick[(k + 2, s + 1)], (s, k)
+    # Stash invariant: backward of k precedes forward of k+P (slot reuse).
+    for s in range(p):
+        for k in range(m - p):
+            assert b_tick[(k, s)] < f_tick[(k + p, s)], (s, k)
+    return np.asarray(ops, np.int32), np.asarray(mbs, np.int32), t
+
+
+def _1f1b_local(
+    stage_fn,
+    head_loss_fn,
+    params,
+    head_params,
+    x_mb,
+    labels_mb,
+    rng,
+    axis_name,
+    op_tbl,
+    mb_tbl,
+):
+    """Per-device 1F1B program (runs inside shard_map).
+
+    params: this device's stage params (leading [1, ...] dim kept).
+    x_mb: [M, mb, ...] microbatched stage-0 input (embed output),
+    labels_mb: [M, mb, ...] labels for the last stage's loss.
+    Returns (loss_sum_local, dparams, dhead_local, dx_mb_local) — the
+    caller reduces loss/dhead/dx over the pipe axis (each is produced
+    on one stage, zeros elsewhere).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    is_last = stage == n_stages - 1
+    m = x_mb.shape[0]
+    fwd_perm = coll.ring_perm(n_stages)
+    bwd_perm = [(d, s) for (s, d) in fwd_perm]
+    params = jax.tree.map(lambda p_: p_[0], params)
+    if rng is not None:
+        rng = jax.random.fold_in(rng, stage)
+
+    def fwd_loss(p_, hp, x, lbl, mb):
+        """Uniform stage program: block stack + (last stage only) loss."""
+        if rng is None:
+            y = stage_fn(p_, x)
+        else:
+            y = stage_fn(p_, x, jax.random.fold_in(rng, mb))
+        loss = lax.cond(
+            is_last,
+            lambda: head_loss_fn(hp, y, lbl),
+            lambda: jnp.float32(0.0),
+        )
+        return y, loss
+
+    zeros_x = jnp.zeros_like(x_mb[0])
+    d_params0 = jax.tree.map(jnp.zeros_like, params)
+    d_head0 = jax.tree.map(jnp.zeros_like, head_params)
+
+    def tick(carry, t):
+        in_q, d_q, stash, d_par, d_head, dx_out, loss_acc, y_pay, d_pay = carry
+        # Deliver last tick's hops (receive side): a forward activation
+        # arrives iff my predecessor ran F last tick; a cotangent arrives
+        # iff my successor ran B last tick. Slot = microbatch % 2.
+        prev_op = op_tbl[t - 1]  # t=0 reads row -1, gated off below
+        prev_mb = mb_tbl[t - 1]
+        y_arr = coll.ppermute(y_pay, axis_name, fwd_perm)
+        d_arr = coll.ppermute(d_pay, axis_name, bwd_perm)
+        pred, succ = (stage - 1) % n_stages, (stage + 1) % n_stages
+        f_arrived = (t > 0) & (prev_op[pred] == 1) & (stage > 0)
+        b_arrived = (t > 0) & (prev_op[succ] == 2) & (stage < n_stages - 1)
+        in_q = jnp.where(
+            f_arrived, in_q.at[prev_mb[pred] % 2].set(y_arr), in_q
+        )
+        d_q = jnp.where(
+            b_arrived, d_q.at[prev_mb[succ] % 2].set(d_arr), d_q
+        )
+
+        op = op_tbl[t, stage]
+        mb = mb_tbl[t, stage]
+        lbl = labels_mb[mb]
+
+        def do_idle(_):
+            return (stash, d_par, d_head, dx_out, loss_acc, zeros_x, zeros_x)
+
+        def do_fwd(_):
+            x_in = jnp.where(stage == 0, x_mb[mb], in_q[mb % 2])
+            y, loss = fwd_loss(params, head_params, x_in, lbl, mb)
+            return (
+                stash.at[mb % n_stages].set(x_in),
+                d_par,
+                d_head,
+                dx_out,
+                loss_acc + loss,
+                y,
+                zeros_x,
+            )
+
+        def do_bwd(_):
+            x_in = stash[mb % n_stages]
+            _, vjp = jax.vjp(
+                lambda p_, hp, x: fwd_loss(p_, hp, x, lbl, mb),
+                params,
+                head_params,
+                x_in,
+            )
+            dy = jnp.where(is_last, jnp.zeros_like(zeros_x), d_q[mb % 2])
+            g_loss = jnp.where(is_last, jnp.float32(1.0), jnp.float32(0.0))
+            dp, dhp, dx = vjp((dy, g_loss))
+            new_dx_out = jnp.where(
+                stage == 0, dx_out.at[mb].set(dx), dx_out
+            )
+            return (
+                stash,
+                jax.tree.map(jnp.add, d_par, dp),
+                jax.tree.map(jnp.add, d_head, dhp),
+                new_dx_out,
+                loss_acc,
+                zeros_x,
+                dx,
+            )
+
+        stash, d_par, d_head, dx_out, loss_acc, y_pay, d_pay = lax.switch(
+            op, [do_idle, do_fwd, do_bwd], None
+        )
+        return (
+            in_q,
+            d_q,
+            stash,
+            d_par,
+            d_head,
+            dx_out,
+            loss_acc,
+            y_pay,
+            d_pay,
+        ), None
+
+    carry0 = (
+        jnp.stack([zeros_x, zeros_x]),  # fwd receive queue (2 slots)
+        jnp.stack([zeros_x, zeros_x]),  # bwd receive queue (2 slots)
+        jnp.stack([zeros_x] * n_stages),  # activation stash (1F1B bound)
+        d_params0,
+        d_head0,
+        jnp.zeros_like(x_mb),  # dx per microbatch (stage 0 only)
+        jnp.float32(0.0),
+        zeros_x,  # forward hop payload
+        zeros_x,  # backward hop payload
+    )
+    n_ticks = op_tbl.shape[0]
+    (in_q, d_q, stash, d_par, d_head, dx_out, loss_acc, y_pay, d_pay), _ = (
+        lax.scan(tick, carry0, jnp.arange(n_ticks))
+    )
+    return loss_acc, d_par, d_head, dx_out
+
+
+def make_pipeline_1f1b(
+    stage_fn: Callable,
+    head_loss_fn: Callable,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    batch_spec: P = P((AxisNames.DATA, AxisNames.FSDP)),
+):
+    """Build the 1F1B pipelined loss:
+    ``run(stage_params, head_params, x, labels, rng) -> scalar loss``.
+
+    - ``stage_fn(stage_params, x[, rng_key]) -> y`` — one stage's block
+      stack (same contract as ``pipeline_apply``).
+    - ``head_loss_fn(head_params, y, labels) -> scalar`` — the
+      mean-per-microbatch loss, executed at the LAST stage only (so the
+      head matmul is never replicated across stages).
+
+    The returned function is a ``jax.custom_vjp``: its *forward* runs
+    the interleaved 1F1B schedule, producing the loss AND the explicit
+    gradients (stage grads stay ``pipe``-sharded; head/dx reduce over
+    the pipe axis once); its backward just scales those cached
+    gradients by the incoming cotangent. The surrounding program —
+    embedding before, optimizer after — differentiates through it with
+    plain ``jax.grad``. Memory: P-deep activation stash per stage (the
+    1F1B bound), never M-deep.
+    """
+    n_stages = mesh.shape[AxisNames.PIPE]
+    pipe_axis = AxisNames.PIPE
+
+    def _mb_split(a, m):
+        return a.reshape((m, a.shape[0] // m) + a.shape[1:])
+
+    def _impl(stage_params, head_params, x, labels, rng):
+        m = num_microbatches
+        if x.shape[0] % m:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by num_microbatches {m}"
+            )
+        op_np, mb_np, _ = _schedule_1f1b(m, n_stages)
+        op_tbl, mb_tbl = jnp.asarray(op_np), jnp.asarray(mb_np)
+        x_mb, labels_mb = _mb_split(x, m), _mb_split(labels, m)
+
+        param_specs = jax.tree.map(
+            lambda p: P(*((pipe_axis,) + (None,) * (p.ndim - 1))),
+            stage_params,
+        )
+        act_spec = P(None, *batch_spec)
+        head_specs = jax.tree.map(lambda _: P(), head_params)
+        constrained = jax.lax.with_sharding_constraint(
+            stage_params,
+            jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs),
+        )
+
+        batch_axes = batch_spec[0]
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))
+
+        def local(sp, hp, xm, lm, r=None):
+            loss, d_sp, d_hp, dx = _1f1b_local(
+                stage_fn, head_loss_fn, sp, hp, xm, lm, r,
+                pipe_axis, op_tbl, mb_tbl,
+            )
+            stage = lax.axis_index(pipe_axis)
+            is_last = stage == n_stages - 1
+            # Loss and head grads exist on the last stage, dx on stage
+            # 0; one psum each replicates them over the pipe (zeros
+            # elsewhere). Each batch shard computed the loss over ITS
+            # rows only, so the global mean needs a pmean over the
+            # batch axes — for the param grads this IS the DP gradient
+            # all-reduce, landed inside the one compiled program.
+            loss = coll.psum(jnp.where(is_last, loss, 0.0), pipe_axis)
+            loss = lax.pmean(loss, batch_axes)
+            d_hp = coll.psum(
+                jax.tree.map(
+                    lambda g: jnp.where(is_last, g, jnp.zeros_like(g)),
+                    d_hp,
+                ),
+                pipe_axis,
+            )
+            d_hp = jax.tree.map(lambda g: lax.pmean(g, batch_axes), d_hp)
+            d_sp = jax.tree.map(lambda g: lax.pmean(g, batch_axes), d_sp)
+            # dx stays batch-sharded: the global-mean loss weights each
+            # shard's rows by 1/n_batch_shards.
+            dx = coll.psum(dx, pipe_axis) / n_batch_shards  # zeros off st. 0
+            # Re-add the leading stage dim the in_spec split off.
+            d_sp = jax.tree.map(lambda g: g[None], d_sp)
+            return loss / m, d_sp, d_hp, dx
+
+        if rng is None:
+            # A None rng can't cross the shard_map boundary as an arg.
+            return jax.shard_map(
+                lambda sp, hp, xm, lm: local(sp, hp, xm, lm),
+                mesh=mesh,
+                in_specs=(param_specs, head_specs, act_spec, act_spec),
+                out_specs=(P(), param_specs, head_specs, act_spec),
+                check_vma=False,
+            )(constrained, head_params, x_mb, labels_mb)
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(param_specs, head_specs, act_spec, act_spec, P()),
+            out_specs=(P(), param_specs, head_specs, act_spec),
+            check_vma=False,
+        )(constrained, head_params, x_mb, labels_mb, rng)
+
+    @jax.custom_vjp
+    def run(stage_params, head_params, x, labels, rng):
+        loss, _, _, _ = _impl(stage_params, head_params, x, labels, rng)
+        return loss
+
+    def run_fwd(stage_params, head_params, x, labels, rng):
+        loss, d_sp, d_hp, dx_mb = _impl(stage_params, head_params, x, labels, rng)
+        dx = dx_mb.reshape((x.shape[0],) + x.shape[1:]) / num_microbatches
+        d_sp = jax.tree.map(lambda g: g / num_microbatches, d_sp)
+        d_hp = jax.tree.map(lambda g: g / num_microbatches, d_hp)
+        return loss, (d_sp, d_hp, dx, labels, rng)
+
+    def run_bwd(res, g):
+        d_sp, d_hp, dx, labels, rng = res
+        scale = lambda t: jax.tree.map(lambda a: a * g, t)
+        zero_lbl = np.zeros(labels.shape, jax.dtypes.float0)
+        zero_rng = (
+            None if rng is None else np.zeros(rng.shape, jax.dtypes.float0)
+        )
+        return scale(d_sp), scale(d_hp), dx * g, zero_lbl, zero_rng
+
+    run.defvjp(run_fwd, run_bwd)
+    return run

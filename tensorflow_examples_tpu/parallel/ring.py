@@ -14,6 +14,20 @@ designed TPU-first:
   inner loop runs at full single-device kernel efficiency. Gradients
   flow through the merge AND the lse (the kernel's custom VJP carries
   the lse cotangent), so the whole ring differentiates exactly.
+
+  **Causal load balance (VERDICT r2 item 2)**: with contiguous shards,
+  causality makes device 0 need 1 hop of real work and device c-1 all
+  c — and because SPMD devices move in lockstep, masked hops cost full
+  wall time even when skipped. The fix is **zigzag sharding** (the
+  ring-flash / llama-3 style): the sequence is split into 2c chunks and
+  ring position d works on chunks (d, 2c-1-d) — one early, one late —
+  so every device does exactly 2 half-chunk attends per hop, the causal
+  minimum, ~half the FLOPs AND wall time of the naive ring. The
+  permutation happens *inside* the shard_map with half-shard ppermutes
+  (`_to_zigzag`/`_from_zigzag`), so callers still see contiguous
+  sharding in and out. Causal calls default to it; the contiguous path
+  remains for odd shard sizes and skips fully-masked hops with
+  ``lax.cond`` (no FLOPs burned, though lockstep means no wall gain).
 - ``ulysses_attention``: the all-to-all alternative — reshard from
   sequence-sharded to head-sharded with ``all_to_all``, run the local
   flash kernel on full sequences for H/c heads, reshard back. Two
@@ -53,6 +67,120 @@ def _merge(out, lse, o_blk, lse_blk):
     return out * w_old + o_blk.astype(jnp.float32) * w_blk, lse_new
 
 
+def _zigzag_perms(c: int):
+    """Static ppermute tables for the contiguous ↔ zigzag exchange.
+
+    Chunk g ∈ [0, 2c) lives contiguously on device g//2 and zigzag on
+    device z(g) = g if g < c else 2c-1-g. Each table routes one chunk
+    per device, so the whole exchange is two half-shard ppermutes each
+    way (even chunks and odd chunks are separately a bijection over
+    devices)."""
+    z = lambda g: g if g < c else 2 * c - 1 - g
+    fwd_even = [(i, z(2 * i)) for i in range(c)]
+    fwd_odd = [(i, z(2 * i + 1)) for i in range(c)]
+    bwd_even = [(z(2 * i), i) for i in range(c)]
+    bwd_odd = [(z(2 * i + 1), i) for i in range(c)]
+    return fwd_even, fwd_odd, bwd_even, bwd_odd
+
+
+def _to_zigzag(x, axis_name: str, c: int, my_idx):
+    """[B,H,2·sc,D] contiguous shard → (early, late) zigzag chunks.
+
+    Zigzag device d's early chunk (global chunk d) has d's parity, its
+    late chunk (2c-1-d) the opposite — hence the parity select."""
+    fwd_even, fwd_odd, _, _ = _zigzag_perms(c)
+    sc = x.shape[2] // 2
+    recv_even = coll.ppermute(x[:, :, :sc], axis_name, fwd_even)
+    recv_odd = coll.ppermute(x[:, :, sc:], axis_name, fwd_odd)
+    is_even = (my_idx % 2) == 0
+    early = jnp.where(is_even, recv_even, recv_odd)
+    late = jnp.where(is_even, recv_odd, recv_even)
+    return early, late
+
+
+def _from_zigzag(early, late, axis_name: str, c: int, my_idx):
+    """(early, late) zigzag chunks → [B,H,2·sc,D] contiguous shard."""
+    _, _, bwd_even, bwd_odd = _zigzag_perms(c)
+    is_even = (my_idx % 2) == 0
+    a = coll.ppermute(
+        jnp.where(is_even, early, late), axis_name, bwd_even
+    )
+    b = coll.ppermute(
+        jnp.where(is_even, late, early), axis_name, bwd_odd
+    )
+    return jnp.concatenate([a, b], axis=2)
+
+
+def _ring_causal_zigzag(q, k, v, axis_name: str, axis_size: int, sm_scale):
+    """Causal ring attention on zigzag-exchanged shards: every hop costs
+    exactly 2 half-chunk attends on every device — the causal minimum,
+    perfectly balanced (see module docstring)."""
+    c = axis_size
+    my = coll.axis_index(axis_name)
+    qe, ql = _to_zigzag(q, axis_name, c, my)
+    ke, kl = _to_zigzag(k, axis_name, c, my)
+    ve, vl = _to_zigzag(v, axis_name, c, my)
+    sc = qe.shape[2]
+
+    # Hop 0 — the diagonal: both local chunks attend themselves causally
+    # and the late chunk additionally sees the whole early chunk.
+    oe, lse_e = flash_attention_with_lse(qe, ke, ve, causal=True, sm_scale=sm_scale)
+    ol, lse_l = flash_attention_with_lse(ql, kl, vl, causal=True, sm_scale=sm_scale)
+    oe = oe.astype(jnp.float32)
+    o_le, lse_le = flash_attention_with_lse(
+        ql, ke, ve, causal=False, sm_scale=sm_scale
+    )
+    ol, lse_l = _merge(ol.astype(jnp.float32), lse_l, o_le, lse_le)
+
+    perm = coll.ring_perm(c)
+
+    def body(carry, step):
+        oe, lse_e, ol, lse_l, ke, kl, ve, vl = carry
+        # Rotate the KV chunk pair one hop; after `step` hops this
+        # device holds ring position j = (my - step) % c, i.e. global
+        # chunks j (early) and 2c-1-j (late).
+        ke, kl, ve, vl = coll.ppermute((ke, kl, ve, vl), axis_name, perm)
+        j = (my - step) % c
+
+        def earlier(_):
+            # j < my: K-chunk j is in both local chunks' past; the late
+            # K-chunk 2c-1-j is in neither's. One kernel call over the
+            # stacked Q chunks.
+            qcat = jnp.concatenate([qe, ql], axis=2)
+            o, lse = flash_attention_with_lse(
+                qcat, ke, ve, causal=False, sm_scale=sm_scale
+            )
+            return o[:, :, :sc], lse[:, :, :sc], o[:, :, sc:], lse[:, :, sc:]
+
+        def later(_):
+            # j > my: only the local late chunk (2c-1-my) sees anything,
+            # and it sees both arriving chunks (j and 2c-1-j < 2c-1-my).
+            kcat = jnp.concatenate([ke, kl], axis=2)
+            vcat = jnp.concatenate([ve, vl], axis=2)
+            o, lse = flash_attention_with_lse(
+                ql, kcat, vcat, causal=False, sm_scale=sm_scale
+            )
+            return (
+                jnp.zeros(qe.shape, o.dtype),
+                jnp.full(lse.shape, NEG_INF, lse.dtype),
+                o,
+                lse,
+            )
+
+        d_oe, d_lse_e, d_ol, d_lse_l = jax.lax.cond(j < my, earlier, later, None)
+        oe, lse_e = _merge(oe, lse_e, d_oe, d_lse_e)
+        ol, lse_l = _merge(ol, lse_l, d_ol, d_lse_l)
+        return (oe, lse_e, ol, lse_l, ke, kl, ve, vl), None
+
+    (oe, _, ol, _, *_), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (oe, lse_e, ol, lse_l, ke, kl, ve, vl),
+        jnp.arange(1, c),
+    )
+    out = _from_zigzag(oe, ol, axis_name, c, my)
+    return out.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -61,6 +189,7 @@ def ring_attention(
     axis_name: str = "context",
     causal: bool = True,
     sm_scale: float | None = None,
+    zigzag: bool | None = None,
 ) -> jax.Array:
     """Context-parallel attention; call inside ``shard_map``.
 
@@ -68,12 +197,25 @@ def ring_attention(
     shard on this device. Sharding along ``axis_name`` is assumed to be
     contiguous ascending (shard i holds tokens [i·s, (i+1)·s)), which is
     what ``NamedSharding(P(..., 'context', ...))`` produces.
+
+    ``zigzag`` (causal only): balance the causal load by internally
+    re-sharding to the zigzag layout — ~2× fewer FLOPs and wall time
+    than the contiguous ring (module docstring). ``None`` = auto: on
+    whenever causal and the shard length is even.
     """
     axis_size = coll.axis_size(axis_name)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if axis_size == 1:
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if zigzag is None:
+        zigzag = causal and q.shape[2] % 2 == 0
+    if zigzag and not causal:
+        raise ValueError("zigzag ring attention only applies to causal")
+    if zigzag and q.shape[2] % 2:
+        raise ValueError(f"zigzag needs an even shard length, got {q.shape[2]}")
+    if zigzag:
+        return _ring_causal_zigzag(q, k, v, axis_name, axis_size, sm_scale)
 
     my_idx = coll.axis_index(axis_name)
     perm = coll.ring_perm(axis_size)
@@ -88,15 +230,29 @@ def ring_attention(
         # Rotate KV one hop around the ring (nearest-neighbor ICI). After
         # `step` rotations this device holds KV shard (my_idx - step).
         k_blk, v_blk = coll.ppermute((k_blk, v_blk), axis_name, perm)
-        o_blk, lse_blk = flash_attention_with_lse(
-            q, k_blk, v_blk, causal=False, sm_scale=sm_scale
-        )
+
+        def attend(_):
+            return flash_attention_with_lse(
+                q, k_blk, v_blk, causal=False, sm_scale=sm_scale
+            )
+
         if causal:
-            # Global causality between shard indices: an earlier KV shard
-            # is fully visible, a later one fully masked — drop it by
-            # sending its lse to NEG_INF so the merge weight is exp→0.
+            # Global causality between shard indices: an earlier KV
+            # shard is fully visible, a later one fully masked — skip
+            # the attend entirely (lax.cond; lockstep means no wall-time
+            # win, but the FLOPs and HBM traffic aren't burned) and
+            # contribute NEG_INF lse so the merge weight is exp→0.
             kv_idx = (my_idx - step) % axis_size
-            lse_blk = jnp.where(kv_idx < my_idx, lse_blk, NEG_INF)
+
+            def skip(_):
+                return (
+                    jnp.zeros(q.shape, q.dtype),
+                    jnp.full(q.shape[:3], NEG_INF, jnp.float32),
+                )
+
+            o_blk, lse_blk = jax.lax.cond(kv_idx < my_idx, attend, skip, None)
+        else:
+            o_blk, lse_blk = attend(None)
         out, lse = _merge(out, lse, o_blk, lse_blk)
         return (out, lse, k_blk, v_blk), None
 

@@ -38,9 +38,12 @@ class Gpt2Config(TrainConfig):
     attention: str = "flash"  # flash | xla | ring | ulysses
     fused_ce: bool = True
     pretrained: str = ""  # local HF GPT2LMHeadModel path to start from
-    # Pipeline parallelism (mesh_pipe > 1): GPipe microbatching over the
-    # `pipe` axis (parallel/pipeline.py).
+    # Pipeline parallelism (mesh_pipe > 1): microbatching over the
+    # `pipe` axis (parallel/pipeline.py). Schedules: "1f1b" (default —
+    # interleaved fwd/bwd, P-bounded activation memory, bubble ticks
+    # idle) or "gpipe" (transpose-scheduled backward).
     num_microbatches: int = 4
+    pipeline_schedule: str = "1f1b"
     # Mixture-of-Experts: swap every `moe_every`-th block's MLP for a
     # top-1 Switch MoE with this many experts (expert-parallel over the
     # `model` mesh axis). 0 = dense GPT-2.
@@ -212,15 +215,52 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
 
     from tensorflow_examples_tpu.core.mesh import AxisNames
     from tensorflow_examples_tpu.core.sharding import ShardingRules
-    from tensorflow_examples_tpu.parallel.pipeline import pipeline_apply
+    from tensorflow_examples_tpu.parallel.pipeline import (
+        make_pipeline_1f1b,
+        pipeline_apply,
+    )
 
     n_stages = mesh.shape[AxisNames.PIPE]
     if cfg.num_layers % n_stages:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pipe={n_stages}"
         )
+    if cfg.pipeline_schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown pipeline_schedule={cfg.pipeline_schedule}")
     mcfg = model_config(cfg)
     embed_head = transformer.EmbedHead(mcfg)
+    per_stage = cfg.num_layers // n_stages
+
+    def split_stages(blocks):
+        return jax.tree.map(
+            lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]), blocks
+        )
+
+    def head_loss_fn(hp, y, lbl):
+        """ln_f + tied LM head + fused CE, mean over the microbatch —
+        runs at the LAST pipe stage only under the 1F1B schedule."""
+        logits = embed_head.apply({"params": hp}, y, method="logits")
+        nll = cross_entropy_per_example(
+            logits.reshape(-1, cfg.vocab_size),
+            lbl.reshape(-1),
+            fused=cfg.fused_ce,
+        )
+        return jnp.mean(nll)
+
+    run_1f1b_drop = make_pipeline_1f1b(
+        lambda sp, h, key: transformer.apply_stacked_blocks(
+            mcfg, sp, h, train=True, rng=key
+        ),
+        head_loss_fn,
+        mesh=mesh,
+        num_microbatches=cfg.num_microbatches,
+    )
+    run_1f1b_plain = make_pipeline_1f1b(
+        lambda sp, h: transformer.apply_stacked_blocks(mcfg, sp, h),
+        head_loss_fn,
+        mesh=mesh,
+        num_microbatches=cfg.num_microbatches,
+    )
 
     def init_fn(rng):
         if cfg.pretrained:
@@ -251,11 +291,7 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
             method="encode",
             rngs={"dropout": r_embed} if dropout else None,
         )
-        per_stage = cfg.num_layers // n_stages
-        stage_params = jax.tree.map(
-            lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]),
-            params["blocks"],
-        )
+        stage_params = split_stages(params["blocks"])
         stage_fn = (
             (
                 lambda sp, h, key: transformer.apply_stacked_blocks(
@@ -289,6 +325,33 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
         return nll.reshape(labels.shape)
 
     def loss_fn(params, model_state, batch, *, rng, train):
+        if train and cfg.pipeline_schedule == "1f1b":
+            # 1F1B: loss computed inside the pipeline schedule (the
+            # microbatch backward starts as soon as its forward exits);
+            # embed encode/decode stay outside and differentiate through
+            # the custom_vjp.
+            inputs = batch["tokens"][:, :-1]
+            labels = batch["tokens"][:, 1:]
+            dropout = cfg.dropout > 0 and rng is not None
+            r_embed, r_blocks = (
+                jax.random.split(rng) if dropout else (None, None)
+            )
+            x = embed_head.apply(
+                {"params": params["embed"]},
+                inputs,
+                dropout,
+                method="encode",
+                rngs={"dropout": r_embed} if dropout else None,
+            )
+            run = run_1f1b_drop if dropout else run_1f1b_plain
+            loss = run(
+                split_stages(params["blocks"]),
+                params["embed"],
+                x,
+                labels,
+                r_blocks,
+            )
+            return loss, {}, model_state
         nll = token_nll(params, batch, rng=rng, train=train)
         return jnp.mean(nll), {}, model_state
 

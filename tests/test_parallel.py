@@ -91,6 +91,92 @@ def test_mesh_attention_dispatch(mesh_cfg, impl):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
 
 
+@pytest.mark.parametrize("zigzag", [True, False])
+def test_ring_zigzag_and_contiguous_match_reference(ctx_mesh, zigzag):
+    """Both causal ring schedules — zigzag (default) and contiguous with
+    lax.cond hop skipping — against the full-sequence reference."""
+    q, k, v = qkv(s=64, seed=3)
+    ref = attention_reference(q, k, v, causal=True)
+    local = functools.partial(
+        ring_attention, axis_name="context", causal=True, zigzag=zigzag
+    )
+    spec = P("data", None, "context", None)
+    sharded = jax.shard_map(
+        local, mesh=ctx_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    out = jax.jit(sharded)(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+    def loss(f, q, k, v):
+        return jnp.sum(f(q, k, v) ** 2)
+
+    g_ref = jax.grad(functools.partial(loss, attention_reference), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    g_out = jax.jit(
+        jax.grad(functools.partial(loss, sharded), argnums=(0, 1, 2))
+    )(q, k, v)
+    for r, o in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(o), atol=5e-4)
+
+
+def test_ring_odd_shard_falls_back_to_contiguous(ctx_mesh):
+    """Auto zigzag must not fire on odd shard lengths (s=20 over c=4 →
+    shard 5); the contiguous path covers it."""
+    q, k, v = qkv(s=20, seed=5)
+    ref = attention_reference(q, k, v, causal=True)
+    local = functools.partial(ring_attention, axis_name="context", causal=True)
+    spec = P("data", None, "context", None)
+    out = jax.jit(
+        jax.shard_map(
+            local, mesh=ctx_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_ring_causal_zigzag_costs_about_half_of_noncausal(ctx_mesh):
+    """The load-balance claim, measured: a causal zigzag ring step should
+    cost ~half the wall time of the non-causal ring at the same shape
+    (causal attends half the pairs; the naive contiguous ring burned the
+    full non-causal cost on causal inputs). Generous 0.8 bound — CPU
+    interpret-mode timing is noisy, but 'no better than non-causal'
+    (ratio ~1.0, the round-2 behavior) fails clearly."""
+    import time
+
+    q, k, v = qkv(b=1, h=2, s=2048, d=32, seed=7)
+    spec = P(None, None, "context", None)
+
+    def build(causal):
+        local = functools.partial(
+            ring_attention, axis_name="context", causal=causal
+        )
+        return jax.jit(
+            jax.shard_map(
+                local, mesh=ctx_mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False,
+            )
+        )
+
+    def timeit(f):
+        f(q, k, v).block_until_ready()  # compile
+        best = float("inf")
+        for _ in range(5):  # best-of-5: shields against CI load spikes
+            t0 = time.perf_counter()
+            f(q, k, v).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_causal = timeit(build(True))
+    t_full = timeit(build(False))
+    assert t_causal < 0.85 * t_full, (
+        f"causal zigzag {t_causal:.4f}s vs non-causal {t_full:.4f}s "
+        f"(ratio {t_causal / t_full:.2f}; expected ~0.5)"
+    )
+
+
 def test_mesh_attention_no_mesh():
     q, k, v = qkv()
     ref = attention_reference(q, k, v, causal=True)

@@ -1,0 +1,14 @@
+"""TPU-gated kernel tests — ambient backend, NO cpu pin.
+
+Unlike ``tests/conftest.py`` (which pins the cpu backend and 8 fake
+devices so everything runs hardware-free), this directory runs against
+whatever backend jax resolves — the point is compiled-kernel numerics on
+the real chip (VERDICT r2 item 6: all Pallas parity tests ran in
+interpret mode on CPU; the compiled TPU kernels were exercised only by
+benches, which never compare numerics). Every module here skips itself
+unless ``jax.default_backend() == "tpu"``.
+
+Run: ``python -m pytest tests_tpu/ -q`` on a TPU host, or via
+``python bench.py --bench=selftest`` (subprocess with a hard timeout —
+this rig's TPU plugin can hang at init).
+"""

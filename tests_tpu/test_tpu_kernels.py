@@ -1,0 +1,113 @@
+"""Compiled-kernel numerics on the live TPU (SURVEY.md §4).
+
+The CPU suite proves the Pallas kernels in interpret mode; this module
+proves the SAME kernels compiled by Mosaic on the real chip, at real
+workload shapes, against the XLA reference implementations. Skipped
+entirely off-TPU (the cpu-pinned suite under ``tests/`` owns that path).
+
+Tolerances: inputs are bf16 (the production precision policy), softmax /
+logsumexp accumulate in f32 in both the kernel and the reference, so
+disagreement is bf16 rounding of inputs/outputs plus reordered f32
+accumulation — a few ULP of bf16, hence the 2e-2 absolute bands below.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled-kernel parity needs the TPU backend",
+)
+
+from tensorflow_examples_tpu.ops.attention import (  # noqa: E402
+    attention_reference,
+    flash_attention,
+    flash_attention_with_lse,
+)
+from tensorflow_examples_tpu.ops.cross_entropy import (  # noqa: E402
+    cross_entropy_per_example,
+    cross_entropy_reference,
+)
+
+
+def _qkv(b, h, s, d, dtype=jnp.bfloat16, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, h, s, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in keys)
+
+
+def _max_abs(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_fwd_compiled_parity(causal):
+    # GPT-2 124M attention shape: 12 heads, seq 1024, head_dim 64.
+    q, k, v = _qkv(2, 12, 1024, 64)
+    out = flash_attention(q, k, v, causal=causal, interpret=False)
+    ref = attention_reference(q, k, v, causal=causal)
+    assert out.dtype == q.dtype
+    assert _max_abs(out, ref) < 2e-2
+
+
+def test_flash_bwd_compiled_parity():
+    q, k, v = _qkv(2, 12, 1024, 64)
+    g = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+
+    def loss(f):
+        def inner(q, k, v):
+            return jnp.sum(f(q, k, v).astype(jnp.float32) * g.astype(jnp.float32))
+
+        return jax.grad(inner, argnums=(0, 1, 2))
+
+    flash = lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=False)
+    ref = lambda q, k, v: attention_reference(q, k, v, causal=True)
+    for got, want in zip(jax.jit(loss(flash))(q, k, v), jax.jit(loss(ref))(q, k, v)):
+        # Gradients sum seq-many bf16 contributions; scale tolerance with
+        # the reference's magnitude rather than assuming unit scale.
+        band = 2e-2 * (1.0 + float(jnp.max(jnp.abs(want.astype(jnp.float32)))))
+        assert _max_abs(got, want) < band
+
+
+def test_flash_lse_compiled_parity():
+    q, k, v = _qkv(1, 8, 2048, 64, seed=3)
+    out, lse = flash_attention_with_lse(q, k, v, causal=True, interpret=False)
+    ref = attention_reference(q, k, v, causal=True)
+    # Reference lse computed directly (f32, causal-masked).
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (64**-0.5)
+    row = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+    s = jnp.where(row >= col, s, -1e30)
+    ref_lse = jax.nn.logsumexp(s, axis=-1)
+    assert _max_abs(out, ref) < 2e-2
+    assert _max_abs(lse, ref_lse) < 2e-2
+
+
+def test_fused_ce_compiled_parity():
+    # GPT-2 LM-head shape: one step's tokens against the full 50257 vocab.
+    n, v = 2048, 50257
+    logits = jax.random.normal(jax.random.PRNGKey(0), (n, v), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (n,), 0, v)
+    nll = cross_entropy_per_example(logits, labels, interpret=False)
+    ref = cross_entropy_reference(logits, labels)
+    assert nll.dtype == jnp.float32
+    assert _max_abs(nll, ref) < 2e-2
+
+
+def test_fused_ce_bwd_compiled_parity():
+    n, v = 1024, 50257
+    logits = jax.random.normal(jax.random.PRNGKey(2), (n, v), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (n,), 0, v)
+
+    def mean_nll(fn):
+        return jax.jit(jax.grad(lambda lg: jnp.mean(fn(lg, labels))))
+
+    got = mean_nll(
+        lambda lg, lb: cross_entropy_per_example(lg, lb, interpret=False)
+    )(logits)
+    want = mean_nll(cross_entropy_reference)(logits)
+    # dlogits entries are O(softmax/n) — tiny; absolute band scaled by n.
+    assert _max_abs(got, want) < 2e-2 / n * 50

@@ -99,7 +99,11 @@ def _tf():
     return tf
 
 
-def _parse_and_decode(tf, record, *, train: bool, image_size: int):
+def _parse_and_decode(tf, record, *, train: bool, image_size: int, aug_seed=None):
+    """Decode one example. ``aug_seed`` (a [2] int tensor) switches the
+    train augmentations to their STATELESS variants keyed on it — the
+    exact-resume path, where the same stream position must produce the
+    same crop/flip on every run."""
     feats = tf.io.parse_single_example(
         record,
         {
@@ -110,22 +114,34 @@ def _parse_and_decode(tf, record, *, train: bool, image_size: int):
     img_bytes = feats["image/encoded"]
     if train:
         # Classic ResNet crop: random area 8–100%, aspect 3/4–4/3.
-        bbox = tf.zeros([1, 0, 4], tf.float32)
-        begin, size, _ = tf.image.sample_distorted_bounding_box(
-            tf.io.extract_jpeg_shape(img_bytes),
-            bounding_boxes=bbox,
+        crop_kw = dict(
+            bounding_boxes=tf.zeros([1, 0, 4], tf.float32),
             area_range=(0.08, 1.0),
             aspect_ratio_range=(3 / 4, 4 / 3),
             max_attempts=10,
             use_image_if_no_bounding_boxes=True,
         )
+        shape = tf.io.extract_jpeg_shape(img_bytes)
+        if aug_seed is not None:
+            begin, size, _ = tf.image.stateless_sample_distorted_bounding_box(
+                shape, seed=aug_seed, **crop_kw
+            )
+        else:
+            begin, size, _ = tf.image.sample_distorted_bounding_box(
+                shape, **crop_kw
+            )
         y, x, _ = tf.unstack(begin)
         h, w, _ = tf.unstack(size)
         img = tf.image.decode_and_crop_jpeg(
             img_bytes, tf.stack([y, x, h, w]), channels=3
         )
         img = tf.image.resize(img, [image_size, image_size])
-        img = tf.image.random_flip_left_right(img)
+        if aug_seed is not None:
+            img = tf.image.stateless_random_flip_left_right(
+                img, seed=aug_seed + 1
+            )
+        else:
+            img = tf.image.random_flip_left_right(img)
     else:
         img = tf.io.decode_jpeg(img_bytes, channels=3)
         shape = tf.shape(img)
@@ -143,6 +159,47 @@ def _parse_and_decode(tf, record, *, train: bool, image_size: int):
     return {"image": img, "label": label}
 
 
+def _count_records(tf, files: list, data_dir: str, tag: str) -> int:
+    """Total record count across ``files`` — one IO-only pass (no JPEG
+    decode), cached in a sidecar next to the shards keyed by the shard
+    list + sizes, so it runs once per dataset, not once per resume.
+    Read-only data dirs just skip the cache write."""
+    import hashlib
+    import json
+
+    sig = hashlib.sha1(
+        "|".join(
+            f"{os.path.basename(f)}:{tf.io.gfile.stat(f).length}"
+            for f in files
+        ).encode()
+    ).hexdigest()[:16]
+    cache = os.path.join(data_dir, f".record_count-{tag}-{sig}.json")
+    try:
+        with tf.io.gfile.GFile(cache, "r") as fh:
+            return int(json.load(fh)["count"])
+    except Exception:
+        pass
+    n = int(
+        tf.data.TFRecordDataset(files)
+        .batch(4096)
+        .reduce(
+            np.int64(0), lambda acc, b: acc + tf.shape(b, out_type=tf.int64)[0]
+        )
+        .numpy()
+    )
+    try:
+        with tf.io.gfile.GFile(cache, "w") as fh:
+            json.dump({"count": n}, fh)
+    except Exception:
+        pass
+    return n
+
+
+def _mix(seed: int, epoch: int) -> int:
+    """Cheap int mix for per-epoch tf.data seeds (kept in int32 range)."""
+    return (seed * 1_000_003 + epoch * 7919 + 1) % (2**31 - 1)
+
+
 def tfrecord_iter(
     data_dir: str,
     split: str,
@@ -152,8 +209,28 @@ def tfrecord_iter(
     image_size: int = 224,
     seed: int = 0,
     num_parallel: int = 16,
+    start_step: int = 0,
+    exact: bool = False,
 ) -> Iterator[dict]:
-    """Host tf.data pipeline → numpy batches (masked final eval batch)."""
+    """Host tf.data pipeline → numpy batches (masked final eval batch).
+
+    ``exact=True`` (train only) makes the stream a pure function of
+    ``seed`` and checkpoint-resumable (SURVEY.md §4, §5b): each epoch is
+    an independent deterministic dataset — files permuted by
+    numpy ``(seed, epoch)``, seeded record shuffle, order-preserving
+    interleave, stateless crop/flip keyed on (seed·epoch mix, in-epoch
+    record index) — chained by a Python epoch loop. Resume cost is
+    BOUNDED BY ONE EPOCH: a one-time cached record count (IO-only pass,
+    no decode) converts ``start_step`` into (epoch, in-epoch offset), so
+    restoring at step 450k skips at most one epoch's records of IO and
+    none of the decode/augment — and yields batches bit-identical to the
+    uninterrupted run's steps N, N+1, … Cost of exactness: the
+    order-preserving interleave gives up some read parallelism slack —
+    measured small next to decode+augment; flip ``exact=False`` for
+    maximum-throughput non-resumable input.
+    ``exact=False`` ignores ``start_step`` (a fresh nondeterministic
+    shuffle makes skipping meaningless).
+    """
     import jax
 
     tf = _tf()
@@ -161,11 +238,21 @@ def tfrecord_iter(
     files = sorted(tf.io.gfile.glob(pattern))
     if not files:
         raise FileNotFoundError(f"no TFRecord shards matching {pattern}")
-    ds = tf.data.Dataset.from_tensor_slices(files)
     # Per-host input sharding (multi-host DP, SURVEY.md §3(5)).
-    ds = ds.shard(jax.process_count(), jax.process_index())
+    nproc, pidx = jax.process_count(), jax.process_index()
+    host_files = files[pidx::nproc]
+
+    if exact and train:
+        yield from _exact_train_stream(
+            tf, host_files, data_dir, split, batch_size,
+            image_size=image_size, seed=seed, num_parallel=num_parallel,
+            start_step=start_step,
+        )
+        return
+
+    ds = tf.data.Dataset.from_tensor_slices(host_files)
     if train:
-        ds = ds.shuffle(len(files), seed=seed)
+        ds = ds.shuffle(len(host_files), seed=seed)
     ds = ds.interleave(
         tf.data.TFRecordDataset,
         cycle_length=num_parallel,
@@ -182,15 +269,8 @@ def tfrecord_iter(
     ds = ds.batch(batch_size, drop_remainder=train)
     ds = ds.prefetch(tf.data.AUTOTUNE)
 
-    from tensorflow_examples_tpu import native
-
     for batch in ds.as_numpy_iterator():
-        img = native.normalize(batch["image"], MEAN_RGB, STDDEV_RGB)
-        if img is None:  # no toolchain → vectorized numpy fallback
-            img = (
-                batch["image"].astype(np.float32) / 255.0 - MEAN_RGB
-            ) / STDDEV_RGB
-        out = {"image": img, "label": batch["label"]}
+        out = {"image": _normalize_uint8(batch["image"]), "label": batch["label"]}
         n = len(out["label"])
         if not train and n < batch_size:
             pad = batch_size - n
@@ -204,6 +284,88 @@ def tfrecord_iter(
         elif not train:
             out["mask"] = np.ones(n, np.float32)
         yield out
+
+
+def _normalize_uint8(images: np.ndarray) -> np.ndarray:
+    """uint8 HWC batch → normalized f32 via the threaded C++ host library
+    (native/fastdata.cpp), numpy fallback when the toolchain is absent.
+    Single definition so the exact and non-exact streams cannot drift."""
+    from tensorflow_examples_tpu import native
+
+    img = native.normalize(images, MEAN_RGB, STDDEV_RGB)
+    if img is None:
+        img = (images.astype(np.float32) / 255.0 - MEAN_RGB) / STDDEV_RGB
+    return img
+
+
+def _exact_train_stream(
+    tf,
+    host_files: list,
+    data_dir: str,
+    split: str,
+    batch_size: int,
+    *,
+    image_size: int,
+    seed: int,
+    num_parallel: int,
+    start_step: int,
+):
+    """Epoch-chained deterministic train stream (see tfrecord_iter).
+
+    Each epoch is built fresh from (seed, epoch): numpy file
+    permutation → deterministic interleave → seeded record shuffle
+    (reshuffle OFF — the epoch seed varies instead) → skip (first
+    resumed epoch only) → stateless-augment map → batch. ``start_step``
+    maps to (epoch, in-epoch batches) via the cached per-host record
+    count, so the skip never exceeds one epoch."""
+    n_records = _count_records(
+        tf, host_files, data_dir, f"{split}-h{len(host_files)}"
+    )
+    bpe = n_records // batch_size  # drop_remainder batches per epoch
+    if bpe == 0:
+        raise ValueError(
+            f"{n_records} records in this host's {split} shards is less "
+            f"than one batch of {batch_size}"
+        )
+    epoch, within = divmod(start_step, bpe)
+    skip_records = within * batch_size
+
+    while True:
+        rng = np.random.default_rng((seed, epoch))
+        order = [host_files[i] for i in rng.permutation(len(host_files))]
+        eseed = _mix(seed, epoch)
+        ds = tf.data.Dataset.from_tensor_slices(order)
+        ds = ds.interleave(
+            tf.data.TFRecordDataset,
+            cycle_length=num_parallel,
+            num_parallel_calls=tf.data.AUTOTUNE,
+            deterministic=True,
+        )
+        ds = ds.shuffle(
+            16 * batch_size, seed=eseed, reshuffle_each_iteration=False
+        )
+        # In-epoch index BEFORE the skip: position k of a resumed epoch
+        # carries the same index — hence the same stateless crop/flip —
+        # as in the uninterrupted run.
+        ds = ds.enumerate()
+        if skip_records:
+            ds = ds.skip(skip_records)
+        ds = ds.map(
+            lambda i, r: _parse_and_decode(
+                tf, r, train=True, image_size=image_size,
+                aug_seed=tf.stack([tf.constant(eseed, tf.int64), i]),
+            ),
+            num_parallel_calls=tf.data.AUTOTUNE,
+        )
+        ds = ds.batch(batch_size, drop_remainder=True)
+        ds = ds.prefetch(tf.data.AUTOTUNE)
+        for batch in ds.as_numpy_iterator():
+            yield {
+                "image": _normalize_uint8(batch["image"]),
+                "label": batch["label"],
+            }
+        epoch += 1
+        skip_records = 0
 
 
 def has_tfrecords(data_dir: str, split: str) -> bool:

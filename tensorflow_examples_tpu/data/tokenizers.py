@@ -28,7 +28,9 @@ import unicodedata
 # The canonical pattern needs `regex` for \p{L}/\p{N}; without it, fall
 # back to stdlib `re` with [^\W\d_]/\d classes — equivalent for all
 # text whose "letters" re considers word characters (everything
-# common; exotic scripts may split differently, changing BPE merges).
+# common; exotic scripts may split differently, changing BPE merges,
+# and non-decimal numerics like '²' or 'Ⅻ' — \w but not \d — land in
+# the letter class where canonical \p{N} calls them numbers).
 try:
     import regex
 

@@ -40,6 +40,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tensorflow_examples_tpu.core.mesh import AxisNames
 from tensorflow_examples_tpu.core.sharding import ShardingRules
 from tensorflow_examples_tpu.ops.attention import NEG_INF
+from tensorflow_examples_tpu.ops.decode import (
+    decode_attention_reference,
+    flash_decode_attention,
+)
 from tensorflow_examples_tpu.parallel.attention import mesh_attention
 
 
@@ -154,19 +158,27 @@ class Attention(nn.Module):
     def _decode_attend(self, q, k, v):
         """Append q_len new tokens to the cache and attend over it.
 
-        Static shapes: the cache is [B, max_len, H, D]; prefill calls pass
-        the whole prompt (q_len = prompt length), generation steps pass
-        q_len = 1 — each distinct q_len compiles once.
+        Static shapes: the cache is [B, H, max_len, D] (heads-major so the
+        flash-decode kernel folds batch·head without moving the cache);
+        prefill calls pass the whole prompt (q_len = prompt length),
+        generation steps pass q_len = 1 — each distinct q_len compiles
+        once.
+
+        Attention runs through ``ops.decode.flash_decode_attention``,
+        which reads only the populated cache blocks (O(context), not
+        O(max_len), HBM traffic per step); ``attention="xla"`` selects
+        the plain masked reference instead.
         """
         cfg = self.cfg
-        b, q_len = q.shape[:2]
+        b, q_len, h, hd = q.shape
+        swap = lambda t: t.transpose(0, 2, 1, 3)  # [B,S,H,D] → [B,H,S,D]
         ck = self.variable(
             "cache", "key",
-            lambda: jnp.zeros((b, cfg.max_len) + k.shape[2:], k.dtype),
+            lambda: jnp.zeros((b, h, cfg.max_len, hd), k.dtype),
         )
         cv = self.variable(
             "cache", "value",
-            lambda: jnp.zeros((b, cfg.max_len) + v.shape[2:], v.dtype),
+            lambda: jnp.zeros((b, h, cfg.max_len, hd), v.dtype),
         )
         idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
         i0 = idx.value
@@ -174,23 +186,25 @@ class Attention(nn.Module):
         # (init_cache builds it via eval_shape with f32 init; sampling
         # often runs bf16 params) — store in the cache's dtype.
         ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(ck.value.dtype), (0, i0, 0, 0)
+            ck.value, swap(k).astype(ck.value.dtype), (0, 0, i0, 0)
         )
         cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cv.value.dtype), (0, i0, 0, 0)
+            cv.value, swap(v).astype(cv.value.dtype), (0, 0, i0, 0)
         )
-        idx.value = i0 + q_len
+        length = i0 + q_len
+        idx.value = length
 
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, ck.value,
-            preferred_element_type=jnp.float32,
-        ) * (cfg.head_dim ** -0.5)
-        # Row r (global position i0 + r) sees cache slots ≤ its position.
-        pos = i0 + jax.lax.broadcasted_iota(jnp.int32, (q_len, cfg.max_len), 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, (q_len, cfg.max_len), 1)
-        s = jnp.where(col <= pos, s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
+        if cfg.attention == "xla":
+            out = decode_attention_reference(
+                swap(q), ck.value, cv.value, length,
+                sm_scale=cfg.head_dim**-0.5,
+            )
+        else:
+            out = flash_decode_attention(
+                swap(q), ck.value, cv.value, length,
+                sm_scale=cfg.head_dim**-0.5,
+            )
+        return swap(out)  # back to [B, S, H, D]
 
 
 class MoeMlp(nn.Module):

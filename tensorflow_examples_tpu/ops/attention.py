@@ -388,8 +388,14 @@ _DEFAULT_BLOCK = 256  # fastest measured end-to-end at GPT-2 shapes (v5e)
 def _fit_block(target: int, seq: int) -> int:
     """Auto block size: the largest divisor of ``seq`` ≤ ``target`` that
     is a multiple of 128 (TPU lane width), else of 8 (sublane), else —
-    no hardware-legal tiling exists — a clear error. The full sequence
-    as one block is always legal (Pallas pads internally)."""
+    no exact tiling exists — a clear error. When ``seq <= target`` the
+    full sequence rides as one block (Pallas pads it internally); longer
+    sequences with no multiple-of-8 divisor ≤ target (e.g. 4·odd
+    lengths) are rejected rather than tiled with a partial tail, because
+    these kernels' in-block masks index from block offsets and would
+    read garbage KV columns past ``seq``. (The decode kernel in
+    ops/decode.py masks by *global position* instead, so it accepts
+    arbitrary lengths.)"""
     b = min(target, seq)
     if seq % b == 0:
         return b

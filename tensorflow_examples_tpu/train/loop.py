@@ -253,6 +253,7 @@ class Trainer:
         eval_iter_fn: Callable[[], Iterable] | None = None,
         num_steps: int | None = None,
         local_batches: bool = False,
+        eval_per_host: bool | None = None,
     ) -> dict[str, float]:
         """Run the training loop; returns final logged metrics.
 
@@ -265,6 +266,16 @@ class Trainer:
         ``global_batch / process_count`` rows (per-host data sources
         like TFRecord shards) assembled via ``put_local_batch``; False
         (default) = global-view batches identical on every process.
+
+        ``eval_per_host``: semantics of ``eval_iter_fn``'s batches,
+        passed through to :meth:`evaluate`. ``None`` (default) keeps
+        evaluate's own default — per-host whenever process_count > 1,
+        which matches every in-repo pairing (the CLI's in-memory path
+        feeds a GLOBAL-view train iterator but a PER-HOST eval slice,
+        ``train/cli.py:_host_eval_batches``, so eval semantics are a
+        property of the eval iterator, NOT of ``local_batches``). Pass
+        False explicitly for a genuinely global-view eval iterator in a
+        multi-process run.
         """
         cfg = self.config
         num_steps = num_steps or cfg.train_steps
@@ -348,7 +359,9 @@ class Trainer:
                 ):
                     if watchdog is not None:
                         watchdog.pause()  # eval length ≠ step cadence
-                    eval_metrics = self.evaluate(eval_iter_fn())
+                    eval_metrics = self.evaluate(
+                        eval_iter_fn(), per_host=eval_per_host
+                    )
                     if watchdog is not None:
                         watchdog.resume()
                     _log_metrics(
@@ -375,7 +388,9 @@ class Trainer:
                 last.update(
                     {
                         f"eval_{k}": v
-                        for k, v in self.evaluate(eval_iter_fn()).items()
+                        for k, v in self.evaluate(
+                            eval_iter_fn(), per_host=eval_per_host
+                        ).items()
                     }
                 )
             if self._ckpt:
@@ -452,6 +467,15 @@ def _pad_per_host_batches(it: Iterator) -> Iterator:
     fabricate a padding template, so that condition raises the same
     error on every host at the first flag exchange — a clean collective
     failure instead of peers deadlocking in the next collective.
+
+    FIXED SHAPES REQUIRED: every batch a host yields must share one
+    shape (pad ragged finals to the batch size with zero ``mask`` rows,
+    as ``data/sources.eval_batches`` does). The padding template is the
+    most recent real batch, and ``make_array_from_process_local_data``
+    needs shape-identical per-host pieces — a ragged batch on ANY host
+    therefore fails ALL hosts: the per-batch flag exchange carries a
+    ragged-detected status, so every host raises the same error at the
+    same point instead of the peers hanging in the next collective.
     """
     from jax.experimental import multihost_utils
 
@@ -459,9 +483,24 @@ def _pad_per_host_batches(it: Iterator) -> Iterator:
     first = True
     while True:
         batch = next(it, None)
-        flags = multihost_utils.process_allgather(
-            np.asarray(0 if batch is None else 1)
+        ragged = (
+            batch is not None
+            and pad is not None
+            and any(
+                k in pad and np.shape(v) != pad[k].shape
+                for k, v in batch.items()
+            )
         )
+        # Status collective: 0 = exhausted, 1 = have batch, 2 = ragged.
+        flags = multihost_utils.process_allgather(
+            np.asarray(0 if batch is None else (2 if ragged else 1))
+        )
+        if (flags == 2).max():
+            raise ValueError(
+                "per-host eval batches must share one shape; a host "
+                "yielded a differently-shaped batch (pad ragged final "
+                f"batches with zero-mask rows); status flags: {flags}"
+            )
         if first and flags.min() != flags.max():
             raise ValueError(
                 "per-host eval requires at least one local batch on "

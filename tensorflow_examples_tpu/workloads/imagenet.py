@@ -38,6 +38,11 @@ class ImagenetConfig(TrainConfig):
     eval_every: int = 5000
     checkpoint_every: int = 5000
     eval_batches: int = 8  # synthetic-eval length (real eval: full split)
+    # Deterministic, checkpoint-resumable TFRecord input (exact-resume:
+    # a restored run replays the uninterrupted run's batch sequence
+    # bit-exactly — SURVEY.md §4/§5b). Costs the order-preserving
+    # interleave; set False for maximum-throughput non-resumable input.
+    deterministic_input: bool = True
 
 
 def make_task(cfg: ImagenetConfig, mesh=None) -> Task:
@@ -122,6 +127,8 @@ def make_train_iter(cfg: ImagenetConfig, start_step: int):
             train=True,
             image_size=cfg.image_size,
             seed=cfg.seed,
+            start_step=start_step,
+            exact=cfg.deterministic_input,
         )
     return imagenet_data.synthetic_train_iter(
         cfg.global_batch_size,

@@ -18,6 +18,10 @@ from tensorflow_examples_tpu.ops.cross_entropy import (
     cross_entropy_per_example,
     cross_entropy_reference,
 )
+from tensorflow_examples_tpu.ops.decode import (
+    decode_attention_reference,
+    flash_decode_attention,
+)
 
 
 def _qkv(rng, shape, dtype):
@@ -97,6 +101,75 @@ class TestFlashAttention:
         jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v))
         np.testing.assert_allclose(
             jitted(q, k, v), flash_attention(q, k, v), atol=1e-6, rtol=1e-6
+        )
+
+
+class TestFlashDecode:
+    """KV-cache flash-decode kernel vs the masked-XLA reference."""
+
+    @pytest.mark.parametrize(
+        "q_len,length",
+        [(1, 1), (1, 13), (1, 512), (7, 200), (128, 128), (96, 300)],
+    )
+    def test_matches_reference(self, q_len, length):
+        rng = jax.random.PRNGKey(0)
+        q = jax.random.normal(rng, (2, 3, q_len, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 512, 64))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 512, 64))
+        out = flash_decode_attention(q, k, v, jnp.asarray(length))
+        ref = decode_attention_reference(q, k, v, length)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_garbage_cache_tail_ignored(self):
+        """Slots ≥ length must not affect the output (they hold stale or
+        uninitialized data in real decode)."""
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 2, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(4), (1, 2, 256, 64))
+        v = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 256, 64))
+        out = flash_decode_attention(q, k, v, jnp.asarray(100))
+        k2 = k.at[:, :, 100:].set(1e4)
+        v2 = v.at[:, :, 100:].set(-1e4)
+        out2 = flash_decode_attention(q, k2, v2, jnp.asarray(100))
+        np.testing.assert_allclose(out, out2, atol=0, rtol=0)
+
+    def test_jit_traced_length(self):
+        """length as a traced scalar: one compile serves every context
+        size — the property the generate() scan relies on."""
+        q = jax.random.normal(jax.random.PRNGKey(6), (1, 2, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(7), (1, 2, 256, 64))
+        v = jax.random.normal(jax.random.PRNGKey(8), (1, 2, 256, 64))
+        f = jax.jit(flash_decode_attention)
+        for n in (1, 77, 256):
+            np.testing.assert_allclose(
+                f(q, k, v, jnp.asarray(n)),
+                decode_attention_reference(q, k, v, n),
+                atol=2e-5, rtol=2e-5,
+            )
+
+    def test_odd_lengths_partial_blocks(self):
+        """max_len/q_len without a block divisor (e.g. 4·odd): the cdiv
+        grid's padded tail must be fully masked."""
+        q = jax.random.normal(jax.random.PRNGKey(9), (1, 2, 36, 64))
+        k = jax.random.normal(jax.random.PRNGKey(10), (1, 2, 516, 64))
+        v = jax.random.normal(jax.random.PRNGKey(11), (1, 2, 516, 64))
+        out = flash_decode_attention(
+            q, k, v, jnp.asarray(400), block_q=32, block_kv=256
+        )
+        ref = decode_attention_reference(q, k, v, 400)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_bf16_cache(self):
+        q = jax.random.normal(jax.random.PRNGKey(12), (1, 2, 1, 64), jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(13), (1, 2, 128, 64), jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(14), (1, 2, 128, 64), jnp.bfloat16)
+        out = flash_decode_attention(q, k, v, jnp.asarray(64))
+        ref = decode_attention_reference(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), 64,
+        )
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref, atol=2e-2, rtol=2e-2
         )
 
 

@@ -102,6 +102,33 @@ def test_decode_cache_matches_full_forward(tiny):
         )
 
 
+def test_flash_decode_path_matches_full_forward(tiny):
+    """The default (flash-decode kernel) cache path must agree with the
+    non-decode forward, same contract as the xla decode path above."""
+    cfg, model, tokens, params = tiny
+    full = model.apply({"params": params}, tokens)
+    flash_model = transformer.Transformer(tiny_cfg(attention="flash"))
+
+    cache = transformer.init_cache(flash_model, batch_size=2)
+    out1, vars_out = flash_model.apply(
+        {"params": params, "cache": cache},
+        tokens[:, :20], decode=True, mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(full[:, :20]), np.asarray(out1), atol=2e-4
+    )
+    cache = vars_out["cache"]
+    for t in range(20, 24):  # a few single-token steps through the kernel
+        step_logits, vars_out = flash_model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, t : t + 1], decode=True, mutable=["cache"],
+        )
+        cache = vars_out["cache"]
+        np.testing.assert_allclose(
+            np.asarray(full[:, t]), np.asarray(step_logits[:, 0]), atol=2e-4
+        )
+
+
 def test_generate_greedy_deterministic(tiny):
     cfg, model, tokens, params = tiny
     prompt = tokens[:, :4]

@@ -86,6 +86,27 @@ def test_flash_lse_compiled_parity():
     assert _max_abs(lse, ref_lse) < 2e-2
 
 
+def test_flash_decode_compiled_parity():
+    from tensorflow_examples_tpu.ops.decode import (
+        decode_attention_reference,
+        flash_decode_attention,
+    )
+
+    # GPT-2 decode shape: 12 heads, 4k cache, single-token step + prefill.
+    k = jax.random.normal(jax.random.PRNGKey(0), (2, 12, 4096, 64), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(1), (2, 12, 4096, 64), jnp.bfloat16)
+    for q_len, length in ((1, 1000), (1, 4096), (512, 512), (256, 2048)):
+        q = jax.random.normal(
+            jax.random.PRNGKey(2), (2, 12, q_len, 64), jnp.bfloat16
+        )
+        out = flash_decode_attention(
+            q, k, v, jnp.asarray(length), interpret=False
+        )
+        ref = decode_attention_reference(q, k, v, length)
+        assert out.dtype == q.dtype
+        assert _max_abs(out, ref) < 2e-2, (q_len, length)
+
+
 def test_fused_ce_compiled_parity():
     # GPT-2 LM-head shape: one step's tokens against the full 50257 vocab.
     n, v = 2048, 50257

@@ -31,6 +31,7 @@ with no static_argnums bookkeeping.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import flax.linen as nn
 import jax
@@ -40,10 +41,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tensorflow_examples_tpu.core.mesh import AxisNames
 from tensorflow_examples_tpu.core.sharding import ShardingRules
 from tensorflow_examples_tpu.ops.attention import NEG_INF
-from tensorflow_examples_tpu.ops.decode import (
-    decode_attention_reference,
-    flash_decode_attention,
-)
+from tensorflow_examples_tpu.ops.decode import decode_attention_reference
 from tensorflow_examples_tpu.parallel.attention import mesh_attention
 
 
@@ -102,12 +100,22 @@ GPT2_RULES = ShardingRules(
 
 
 def _shard(x, mesh: Mesh | None, *spec):
-    """Pin an activation's sharding when a mesh is provided."""
+    """Pin an activation's sharding when a mesh is provided. A dim whose
+    size the spec'd mesh axes don't divide (decode-time batch=1, single-
+    token steps) replicates instead — the constraint is an optimization
+    hint, not a shape contract."""
     if mesh is None:
         return x
+    import math
+
     from tensorflow_examples_tpu.core.sharding import named_sharding
 
-    return jax.lax.with_sharding_constraint(x, named_sharding(mesh, *spec))
+    fitted = []
+    for dim, s in zip(x.shape, spec):
+        axes = (s,) if isinstance(s, str) else (s or ())
+        n = math.prod(mesh.shape[a] for a in axes)
+        fitted.append(s if n and dim % n == 0 else None)
+    return jax.lax.with_sharding_constraint(x, named_sharding(mesh, *fitted))
 
 
 _BATCH = AxisNames.BATCH_AXES
@@ -195,14 +203,20 @@ class Attention(nn.Module):
         idx.value = length
 
         if cfg.attention == "xla":
+            # Dense reference path: XLA's partitioner shards the einsums
+            # itself under a mesh, no shard_map needed.
             out = decode_attention_reference(
                 swap(q), ck.value, cv.value, length,
                 sm_scale=cfg.head_dim**-0.5,
             )
         else:
-            out = flash_decode_attention(
+            from tensorflow_examples_tpu.parallel.attention import (
+                mesh_decode_attention,
+            )
+
+            out = mesh_decode_attention(
                 swap(q), ck.value, cv.value, length,
-                sm_scale=cfg.head_dim**-0.5,
+                mesh=self.mesh, sm_scale=cfg.head_dim**-0.5,
             )
         return swap(out)  # back to [B, S, H, D]
 
@@ -210,14 +224,17 @@ class Attention(nn.Module):
 class MoeMlp(nn.Module):
     """Top-k Switch/GShard MoE FFN (parallel/moe.py); aux loss and
     dropped-token fraction sown into the ``intermediates`` collection as
-    ``moe_aux`` / ``moe_drop``."""
+    ``moe_aux`` / ``moe_drop``. On a mesh whose ``model`` axis divides
+    the expert count, dispatch runs the explicit all-to-all EP path
+    (``moe_ffn_ep``); otherwise the single-program scatter/gather."""
 
     cfg: TransformerConfig
     train: bool
+    mesh: Mesh | None = None
 
     @nn.compact
     def __call__(self, x):
-        from tensorflow_examples_tpu.parallel.moe import moe_ffn
+        from tensorflow_examples_tpu.parallel.moe import moe_ffn, moe_ffn_ep
 
         cfg = self.cfg
         e, d, ff = cfg.moe_experts, cfg.d_model, cfg.ff_dim
@@ -233,7 +250,15 @@ class MoeMlp(nn.Module):
             if self.train and self.has_rng("dropout")
             else None
         )
-        out, aux, drop = moe_ffn(
+        # moe_ffn_ep itself falls back to the single-program path when
+        # the mesh's model axis is trivial or doesn't divide E — one
+        # predicate, owned by the function that implements it.
+        fn = (
+            functools.partial(moe_ffn_ep, mesh=self.mesh)
+            if self.mesh is not None
+            else moe_ffn
+        )
+        out, aux, drop = fn(
             gate,
             w_in.astype(x.dtype), b_in.astype(x.dtype),
             w_out.astype(x.dtype), b_out.astype(x.dtype),
@@ -263,7 +288,7 @@ class Block(nn.Module):
         x = _shard(x + y, mesh, _BATCH, ctx, None)
         y = nn.LayerNorm(epsilon=1e-5, dtype=x.dtype, name="ln_2")(x)
         if self.use_moe:
-            y = MoeMlp(cfg, self.train, name="moe")(y)
+            y = MoeMlp(cfg, self.train, mesh, name="moe")(y)
         else:
             y = nn.Dense(
                 cfg.ff_dim,

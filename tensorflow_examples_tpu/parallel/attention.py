@@ -12,6 +12,7 @@ rides ``context`` — each device runs the kernel on exactly its shard.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -27,6 +28,51 @@ def attention_spec(mesh: Mesh) -> P:
     model = AxisNames.MODEL if mesh.shape[AxisNames.MODEL] > 1 else None
     ctx = AxisNames.CONTEXT if mesh.shape[AxisNames.CONTEXT] > 1 else None
     return P(batch if batch else None, model, ctx, None)
+
+
+def decode_spec(mesh: Mesh, batch: int, heads: int) -> P:
+    """PartitionSpec for decode-time [batch, heads, seq, head_dim]
+    operands: batch over the batch axes, heads over ``model`` — the TP
+    layout the projections already produce — with each dimension
+    replicated instead when its size doesn't divide the mesh axes.
+    No ``context`` entry: the KV cache is positionally complete on every
+    device; context parallelism is a training-time concept."""
+    batch_axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
+    nb = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if batch % nb:
+        batch_axes = ()
+    m = mesh.shape[AxisNames.MODEL]
+    model = AxisNames.MODEL if m > 1 and heads % m == 0 else None
+    return P(batch_axes if batch_axes else None, model, None, None)
+
+
+def mesh_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    length: jax.Array,
+    *,
+    mesh: Mesh | None,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """KV-cache flash-decode on a mesh: the Pallas kernel is opaque to
+    the SPMD partitioner (calling it with sharded operands would force
+    an all-gather of the cache — the exact O(max_len) read the kernel
+    exists to avoid), so it runs under ``shard_map`` with batch/heads
+    sharding. Single-device meshes fall through to the plain kernel."""
+    from tensorflow_examples_tpu.ops.decode import flash_decode_attention
+
+    if mesh is None or all(mesh.shape[a] == 1 for a in AxisNames.ALL):
+        return flash_decode_attention(q, k_cache, v_cache, length, sm_scale=sm_scale)
+    spec = decode_spec(mesh, q.shape[0], q.shape[1])
+    local = functools.partial(flash_decode_attention, sm_scale=sm_scale)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P()),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k_cache, v_cache, length)
 
 
 def mesh_attention(

@@ -31,8 +31,100 @@ modules (models/transformer.py MoeMlp) and composes with remat/scan.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _route(
+    tokens: jax.Array,  # [n, d] f32-castable
+    gate_w: jax.Array,  # [d, E]
+    *,
+    top_k: int,
+    capacity: int,
+    rng: jax.Array | None,
+    jitter: float,
+):
+    """Router + static-capacity slotting shared by the single-program
+    and explicit-EP paths. Returns (gates, flat_slots, keeps,
+    mean_onehot0 [E], mean_probs [E], kept_count scalar)."""
+    n = tokens.shape[0]
+    e = gate_w.shape[-1]
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    if rng is not None and jitter > 0:
+        logits += jax.random.uniform(
+            rng, logits.shape, jnp.float32, -jitter, jitter
+        )
+    probs = jax.nn.softmax(logits, axis=-1)  # [n, E]
+
+    # Sequential top-k: argmax, mask, repeat (k is tiny and static).
+    masked = probs
+    experts, gates = [], []
+    for _ in range(top_k):
+        ej = jnp.argmax(masked, axis=-1)  # [n]
+        pj = jnp.take_along_axis(masked, ej[:, None], axis=-1)[:, 0]
+        experts.append(ej)
+        gates.append(pj)
+        masked = masked * (1.0 - jax.nn.one_hot(ej, e, dtype=jnp.float32))
+    # top-1: keep the raw router probability as the gate (Switch) — it
+    # is how the router gets task-loss gradient. Renormalizing would
+    # make the gate identically 1.0 and silently detach the router.
+    # top-k>1: renormalize over the chosen experts (GShard) — relative
+    # weights still carry gradient there.
+    if top_k > 1:
+        denom = jnp.maximum(sum(gates), 1e-9)
+        gates = [g / denom for g in gates]
+
+    mean_onehot0 = jnp.mean(
+        jax.nn.one_hot(experts[0], e, dtype=jnp.float32), axis=0
+    )
+    mean_probs = jnp.mean(probs, axis=0)
+
+    # Static-capacity slotting: rank-0 assignments queue first, then
+    # rank-1, … — each (token, rank) gets a 1-based position in its
+    # expert's queue; positions past capacity are dropped.
+    counts = jnp.zeros((e,), jnp.int32)
+    flat_slots, keeps = [], []
+    for ej in experts:
+        oh = jax.nn.one_hot(ej, e, dtype=jnp.int32)  # [n, E]
+        pos = (jnp.cumsum(oh, axis=0) + counts[None, :]) * oh  # [n, E]
+        posj = jnp.sum(pos, axis=-1)  # [n], 1-based
+        keeps.append(posj <= capacity)
+        flat_slots.append(ej * capacity + jnp.clip(posj - 1, 0, capacity - 1))
+        counts = counts + jnp.sum(oh, axis=0)
+    kept = sum(jnp.sum(k_.astype(jnp.int32)) for k_ in keeps)
+    return gates, flat_slots, keeps, mean_onehot0, mean_probs, kept
+
+
+def _dispatch(tokens, flat_slots, keeps, e, capacity):
+    """Scatter-add kept token rows into the [E·C, d] expert buffers.
+    Slots are unique per kept (token, rank) pair, so adds never collide."""
+    xin = jnp.zeros((e * capacity, tokens.shape[-1]), tokens.dtype)
+    for flat, keep in zip(flat_slots, keeps):
+        xin = xin.at[flat].add(
+            tokens * keep[:, None].astype(tokens.dtype), mode="drop"
+        )
+    return xin
+
+
+def _expert_ffn(xin, w_in, b_in, w_out, b_out):
+    """Batched expert FFN over [E, C, d] buffers (one MXU matmul pair)."""
+    h = jnp.einsum("ecd,edf->ecf", xin, w_in) + b_in[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
+
+
+def _combine(yout, flat_slots, keeps, gates, n):
+    """Gather each (token, rank)'s output row, gate, and sum — f32."""
+    d = yout.shape[-1]
+    yflat = yout.reshape(-1, d).astype(jnp.float32)
+    out = jnp.zeros((n, d), jnp.float32)
+    for flat, keep, gate in zip(flat_slots, keeps, gates):
+        out = out + yflat[flat] * (gate * keep)[:, None]
+    return out
 
 
 def moe_ffn(
@@ -59,71 +151,142 @@ def moe_ffn(
     n = b * s
     top_k = min(top_k, e)
     tokens = x.reshape(n, d)
+    capacity = max(1, int(capacity_factor * top_k * n / e))
 
-    logits = (tokens.astype(jnp.float32)) @ gate_w.astype(jnp.float32)
-    if rng is not None and jitter > 0:
-        logits += jax.random.uniform(
-            rng, logits.shape, jnp.float32, -jitter, jitter
-        )
-    probs = jax.nn.softmax(logits, axis=-1)  # [n, E]
-
-    # Sequential top-k: argmax, mask, repeat (k is tiny and static).
-    masked = probs
-    experts, gates = [], []
-    for _ in range(top_k):
-        ej = jnp.argmax(masked, axis=-1)  # [n]
-        pj = jnp.take_along_axis(masked, ej[:, None], axis=-1)[:, 0]
-        experts.append(ej)
-        gates.append(pj)
-        masked = masked * (1.0 - jax.nn.one_hot(ej, e, dtype=jnp.float32))
-    # top-1: keep the raw router probability as the gate (Switch) — it
-    # is how the router gets task-loss gradient. Renormalizing would
-    # make the gate identically 1.0 and silently detach the router.
-    # top-k>1: renormalize over the chosen experts (GShard) — relative
-    # weights still carry gradient there.
-    if top_k > 1:
-        denom = jnp.maximum(sum(gates), 1e-9)
-        gates = [g / denom for g in gates]
-
+    gates, flat_slots, keeps, moh0, mpr, kept = _route(
+        tokens, gate_w, top_k=top_k, capacity=capacity, rng=rng, jitter=jitter
+    )
     # Switch aux loss over rank-0 assignments:
     # E · Σ_e (fraction of tokens → e) · (mean prob of e).
-    onehot0 = jax.nn.one_hot(experts[0], e, dtype=jnp.float32)
-    aux = e * jnp.sum(jnp.mean(onehot0, axis=0) * jnp.mean(probs, axis=0))
-
-    # Static-capacity slotting: rank-0 assignments queue first, then
-    # rank-1, … — each (token, rank) gets a 1-based position in its
-    # expert's queue; positions past capacity are dropped.
-    capacity = max(1, int(capacity_factor * top_k * n / e))
-    counts = jnp.zeros((e,), jnp.int32)  # queue length so far, per expert
-    flat_slots, keeps = [], []
-    for ej in experts:
-        oh = jax.nn.one_hot(ej, e, dtype=jnp.int32)  # [n, E]
-        pos = (jnp.cumsum(oh, axis=0) + counts[None, :]) * oh  # [n, E]
-        posj = jnp.sum(pos, axis=-1)  # [n], 1-based
-        keeps.append(posj <= capacity)
-        flat_slots.append(ej * capacity + jnp.clip(posj - 1, 0, capacity - 1))
-        counts = counts + jnp.sum(oh, axis=0)
-    kept = sum(jnp.sum(k_) for k_ in keeps)
+    aux = e * jnp.sum(moh0 * mpr)
     drop_frac = 1.0 - kept.astype(jnp.float32) / (n * top_k)
 
-    # Dispatch: scatter-add token rows into the expert buffers. Slots are
-    # unique per kept (token, rank) pair, so adds never collide.
-    xin = jnp.zeros((e * capacity, d), x.dtype)
-    for flat, keep in zip(flat_slots, keeps):
-        xin = xin.at[flat].add(
-            tokens * keep[:, None].astype(x.dtype),
-            mode="drop",
-        )
-    xin = xin.reshape(e, capacity, d)
-
-    # Expert FFN: one batched matmul pair over the expert axis (MXU).
-    h = jnp.einsum("ecd,edf->ecf", xin, w_in) + b_in[:, None, :]
-    h = jax.nn.gelu(h, approximate=True)
-    yout = jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
-
-    # Combine: gather each (token, rank)'s output row, gate, and sum.
-    yflat = yout.reshape(e * capacity, d).astype(jnp.float32)
-    out = jnp.zeros((n, d), jnp.float32)
-    for flat, keep, gate in zip(flat_slots, keeps, gates):
-        out = out + yflat[flat] * (gate * keep)[:, None]
+    xin = _dispatch(tokens, flat_slots, keeps, e, capacity)
+    yout = _expert_ffn(xin.reshape(e, capacity, d), w_in, b_in, w_out, b_out)
+    out = _combine(yout, flat_slots, keeps, gates, n)
     return out.reshape(b, s, d).astype(x.dtype), aux, drop_frac
+
+
+def moe_ffn_ep(
+    gate_w: jax.Array,  # [d, E] (replicated)
+    w_in: jax.Array,    # [E, d, ff] (sharded over `model`)
+    b_in: jax.Array,    # [E, ff]
+    w_out: jax.Array,   # [E, ff, d]
+    b_out: jax.Array,   # [E, d]
+    x: jax.Array,       # [B, S, d] (sharded over batch/context axes)
+    *,
+    mesh,
+    capacity_factor: float = 1.25,
+    top_k: int = 1,
+    rng: jax.Array | None = None,
+    jitter: float = 1e-2,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Explicit expert-parallel MoE FFN: all-to-all token exchange.
+
+    Same routing math as :func:`moe_ffn`, but dispatch is a
+    ``shard_map`` program with POINT-TO-POINT token exchange
+    (DESIGN.md §7 EP note): under pure SPMD the partitioner turns the
+    scatter/gather dispatch into all-gathers of the full ``[E, C, d]``
+    buffer across the ``model`` axis (measured: 0 all-to-all on a
+    dp2×model4 mesh — bench.py --bench=moe), moving E·C rows per device
+    where an all-to-all moves only C. Here each device routes ITS
+    tokens, ships per-expert-group slices to the owning device with one
+    ``lax.all_to_all``, runs the local experts' FFN, and ships results
+    back with the inverse all-to-all — the GShard/Switch dispatch
+    pattern on ICI.
+
+    Capacity semantics differ from the single-program path by design:
+    capacity is per (source device, expert) — each device may keep up to
+    ``capacity_factor·k·n_local/E`` tokens per expert, so the drop
+    pattern is per-source quota rather than a global queue (the standard
+    multi-device MoE behavior; identical when nothing overflows). The
+    aux loss is exact: per-expert fractions/probs are pmean'd over the
+    token axes BEFORE the product, which equals the global-batch Switch
+    aux when shards hold equal token counts (they do: static shapes).
+
+    Requires E % mesh.model == 0; gradients flow through the
+    all-to-alls (they transpose to themselves reversed).
+    """
+    import math
+
+    from tensorflow_examples_tpu.core.mesh import AxisNames
+
+    e = gate_w.shape[-1]
+    m = mesh.shape[AxisNames.MODEL] if mesh is not None else 1
+    if m <= 1 or e % m:
+        return moe_ffn(
+            gate_w, w_in, b_in, w_out, b_out, x,
+            capacity_factor=capacity_factor, top_k=top_k,
+            rng=rng, jitter=jitter,
+        )
+    top_k = min(top_k, e)
+    # Token sharding mirrors decode_spec's fallback: an axis whose size
+    # doesn't divide the corresponding dim (decode-time batch=1, or a
+    # single-token step under context parallelism) is dropped — tokens
+    # replicate over it, routing stays correct, only the all-to-all over
+    # `model` is essential.
+    batch_axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
+    nb = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if x.shape[0] % nb:
+        batch_axes = ()
+    c = mesh.shape[AxisNames.CONTEXT]
+    ctx = AxisNames.CONTEXT if c > 1 and x.shape[1] % c == 0 else None
+    token_axes = batch_axes + ((ctx,) if ctx else ())
+    x_spec = P(batch_axes if batch_axes else None, ctx, None)
+    ew_spec = P(AxisNames.MODEL)  # leading [E] dim of every expert leaf
+
+    def local(gw, wi, bi, wo, bo, xl, key):
+        b_loc, s_loc, d = xl.shape
+        tokens = xl.reshape(-1, d)
+        n_loc = tokens.shape[0]
+        capacity = max(1, int(capacity_factor * top_k * n_loc / e))
+        if key is not None:
+            # Decorrelate router jitter across token shards.
+            for a in token_axes:
+                key = jax.random.fold_in(key, lax.axis_index(a))
+        gates, flat_slots, keeps, moh0, mpr, kept = _route(
+            tokens, gw, top_k=top_k, capacity=capacity, rng=key,
+            jitter=jitter,
+        )
+        if token_axes:
+            moh0 = lax.pmean(moh0, token_axes)
+            mpr = lax.pmean(mpr, token_axes)
+        aux = e * jnp.sum(moh0 * mpr)
+        drop = 1.0 - kept.astype(jnp.float32) / (n_loc * top_k)
+        if token_axes:
+            drop = lax.pmean(drop, token_axes)
+
+        # [E·C, d] → [m, E/m, C, d]: group g's slice belongs to device g.
+        xin = _dispatch(tokens, flat_slots, keeps, e, capacity)
+        xin = xin.reshape(m, e // m, capacity, d)
+        # One hop: device g receives [m(src), E/m, C, d] for ITS experts.
+        recv = lax.all_to_all(
+            xin, AxisNames.MODEL, split_axis=0, concat_axis=0
+        )
+        # Local experts over all sources' slots: [E/m, m·C, d].
+        buf = recv.transpose(1, 0, 2, 3).reshape(e // m, m * capacity, d)
+        yloc = _expert_ffn(buf, wi, bi, wo, bo)
+        # Inverse hop: slot layout returns to expert-major [E, C, d].
+        yloc = yloc.reshape(e // m, m, capacity, d).transpose(1, 0, 2, 3)
+        yout = lax.all_to_all(
+            yloc, AxisNames.MODEL, split_axis=0, concat_axis=0
+        )
+        out = _combine(yout.reshape(e, capacity, d), flat_slots, keeps,
+                       gates, n_loc)
+        return out.reshape(b_loc, s_loc, d).astype(xl.dtype), aux, drop
+
+    # Pin the expert params' layout so shard_map's in_specs agree with
+    # the rules-placed params (no silent resharding inside the step).
+    experts_pinned = jax.lax.with_sharding_constraint(
+        (w_in, b_in, w_out, b_out), NamedSharding(mesh, ew_spec)
+    )
+    args = (gate_w, *experts_pinned, x)
+    in_specs = (P(), ew_spec, ew_spec, ew_spec, ew_spec, x_spec)
+    fn = functools.partial(local, key=None) if rng is None else local
+    if rng is not None:
+        args += (rng,)
+        in_specs += (P(),)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=(x_spec, P(), P()),
+        check_vma=False,
+    )(*args)

@@ -91,6 +91,200 @@ def test_mesh_attention_dispatch(mesh_cfg, impl):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "mesh_cfg",
+    [MeshConfig(data=8), MeshConfig(data=2, model=4), MeshConfig(model=8)],
+)
+def test_mesh_decode_attention_matches_reference(mesh_cfg):
+    """Flash-decode under shard_map (batch over data, heads over model)
+    must match the masked-cache XLA reference — the TP decode path."""
+    from tensorflow_examples_tpu.ops.decode import decode_attention_reference
+    from tensorflow_examples_tpu.parallel.attention import (
+        decode_spec,
+        mesh_decode_attention,
+    )
+
+    mesh = create_mesh(mesh_cfg)
+    b, h, max_len, d = 8, 8, 64, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, max_len, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, max_len, d))
+    length = jnp.asarray(37)
+    ref = decode_attention_reference(q, k, v, length)
+    sharding = NamedSharding(mesh, decode_spec(mesh, b, h))
+    qs, ks, vs = jax.device_put((q, k, v), sharding)
+    out = jax.jit(functools.partial(mesh_decode_attention, mesh=mesh))(
+        qs, ks, vs, length
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_generate_under_tp_mesh_matches_single_device():
+    """End-to-end sampling with a dp×tp mesh: greedy generate through the
+    sharded flash-decode path must reproduce the meshless output."""
+    from tensorflow_examples_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=97, max_len=32, num_layers=2, num_heads=4,
+        d_model=16, dropout=0.0, attention="flash",
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 97, (2, 4)), jnp.int32
+    )
+    plain = transformer.Transformer(cfg)
+    params = plain.init({"params": jax.random.PRNGKey(0)}, tokens)["params"]
+    want = transformer.generate(
+        plain, params, tokens, num_tokens=6,
+        rng=jax.random.PRNGKey(1), temperature=0.0,
+    )
+    mesh = create_mesh(MeshConfig(data=2, model=4))
+    meshed = transformer.Transformer(cfg, mesh=mesh)
+    got = transformer.generate(
+        meshed, params, tokens, num_tokens=6,
+        rng=jax.random.PRNGKey(1), temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+class TestExplicitEP:
+    """moe_ffn_ep: all-to-all expert dispatch vs the single-program path."""
+
+    def _args(self, e=8, d=16, ff=32, b=8, s=16, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+        return (
+            jax.random.normal(ks[0], (d, e)) * 0.5,
+            jax.random.normal(ks[1], (e, d, ff)) * 0.1,
+            jax.random.normal(ks[2], (e, ff)) * 0.01,
+            jax.random.normal(ks[3], (e, ff, d)) * 0.1,
+            jax.random.normal(ks[4], (e, d)) * 0.01,
+            jax.random.normal(ks[5], (b, s, d)),
+        )
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_matches_single_program(self, top_k):
+        """With capacity ample enough that nothing drops, the explicit
+        all-to-all dispatch must reproduce moe_ffn exactly (same math,
+        different transport)."""
+        from tensorflow_examples_tpu.parallel.moe import moe_ffn, moe_ffn_ep
+
+        mesh = create_mesh(MeshConfig(data=2, model=4))
+        args = self._args()
+        kw = dict(capacity_factor=8.0, top_k=top_k, rng=None)
+        want, aux_w, drop_w = moe_ffn(*args, **kw)
+        got, aux_g, drop_g = jax.jit(
+            functools.partial(moe_ffn_ep, mesh=mesh, **kw)
+        )(*args)
+        np.testing.assert_allclose(
+            np.asarray(want), np.asarray(got), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(float(aux_w), float(aux_g), rtol=1e-5)
+        assert float(drop_w) == 0.0 and float(drop_g) == 0.0
+
+    def test_grads_match_single_program(self):
+        from tensorflow_examples_tpu.parallel.moe import moe_ffn, moe_ffn_ep
+
+        mesh = create_mesh(MeshConfig(data=2, model=4))
+        args = self._args(b=4, s=8)
+        kw = dict(capacity_factor=8.0, top_k=2, rng=None)
+
+        def loss(fn, *a):
+            out, aux, _ = fn(*a, **kw)
+            return jnp.sum(out**2) + 0.01 * aux
+
+        g_ref = jax.grad(functools.partial(loss, moe_ffn), argnums=(0, 1, 3, 5))(
+            *args
+        )
+        g_ep = jax.jit(
+            jax.grad(
+                functools.partial(
+                    loss, functools.partial(moe_ffn_ep, mesh=mesh)
+                ),
+                argnums=(0, 1, 3, 5),
+            )
+        )(*args)
+        for r, o, name in zip(g_ref, g_ep, ("gate", "w_in", "w_out", "x")):
+            np.testing.assert_allclose(
+                np.asarray(r), np.asarray(o), atol=5e-4, rtol=5e-4,
+                err_msg=f"d{name}",
+            )
+
+    def test_dispatch_is_all_to_all(self):
+        """The point of the explicit path: the compiled HLO must exchange
+        tokens with all-to-all, not all-gather the dispatch buffers."""
+        from tensorflow_examples_tpu.parallel.moe import moe_ffn_ep
+
+        mesh = create_mesh(MeshConfig(data=2, model=4))
+        args = self._args()
+        hlo = (
+            jax.jit(
+                functools.partial(
+                    moe_ffn_ep, mesh=mesh, capacity_factor=2.0, top_k=2
+                )
+            )
+            .lower(*args)
+            .compile()
+            .as_text()
+        )
+        assert "all-to-all" in hlo
+
+    def test_ep_indivisible_token_dims_replicate(self):
+        """Decode-time shapes — batch 1, single-token step — must not
+        trace-fail on a mesh with batch/context axes: the token spec
+        drops non-dividing axes and replicates (only the `model`
+        all-to-all is essential)."""
+        from tensorflow_examples_tpu.parallel.moe import moe_ffn, moe_ffn_ep
+
+        mesh = create_mesh(MeshConfig(data=2, model=4))
+        args = self._args(b=1, s=1)
+        kw = dict(capacity_factor=8.0, top_k=2, rng=None)
+        want, _, _ = moe_ffn(*args, **kw)
+        got, _, _ = jax.jit(functools.partial(moe_ffn_ep, mesh=mesh, **kw))(
+            *args
+        )
+        np.testing.assert_allclose(
+            np.asarray(want), np.asarray(got), atol=2e-5, rtol=2e-5
+        )
+
+    def test_moe_generate_under_mesh(self):
+        """End-to-end: greedy sampling from an MoE model on a dp×tp mesh
+        (the MoeMlp auto-EP path at decode shapes) matches meshless."""
+        from tensorflow_examples_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig(
+            vocab_size=97, max_len=16, num_layers=2, num_heads=4,
+            d_model=16, dropout=0.0, attention="flash",
+            moe_experts=8, moe_every=2, moe_top_k=2,
+            moe_capacity_factor=4.0,
+        )
+        prompt = jnp.asarray([[5, 17, 3]], jnp.int32)  # batch 1
+        plain = transformer.Transformer(cfg)
+        params = plain.init({"params": jax.random.PRNGKey(0)}, prompt)["params"]
+        want = transformer.generate(
+            plain, params, prompt, num_tokens=4,
+            rng=jax.random.PRNGKey(1), temperature=0.0,
+        )
+        mesh = create_mesh(MeshConfig(data=2, model=4))
+        got = transformer.generate(
+            transformer.Transformer(cfg, mesh=mesh), params, prompt,
+            num_tokens=4, rng=jax.random.PRNGKey(1), temperature=0.0,
+        )
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    def test_ep_fallback_without_model_axis(self):
+        """E % model != 0 (or model == 1) must fall through to the
+        single-program path and still be correct."""
+        from tensorflow_examples_tpu.parallel.moe import moe_ffn, moe_ffn_ep
+
+        mesh = create_mesh(MeshConfig(data=8))
+        args = self._args(e=6)
+        kw = dict(capacity_factor=8.0, top_k=1, rng=None)
+        want, _, _ = moe_ffn(*args, **kw)
+        got, _, _ = moe_ffn_ep(*args, mesh=mesh, **kw)
+        np.testing.assert_allclose(
+            np.asarray(want), np.asarray(got), atol=2e-5, rtol=2e-5
+        )
+
+
 @pytest.mark.parametrize("zigzag", [True, False])
 def test_ring_zigzag_and_contiguous_match_reference(ctx_mesh, zigzag):
     """Both causal ring schedules — zigzag (default) and contiguous with

@@ -7,9 +7,16 @@ dp/fsdp/tp/sp). TPU-first shape:
 - Stage parameters are the *same pytree* with a leading [stages] axis
   sharded over ``pipe`` — placement is a sharding rule, not a code path,
   exactly like tensor parallelism.
-- Schedules run inside ``shard_map``: each device applies its own
-  stage; activations hop stage→stage with ``jax.lax.ppermute``
-  (nearest-neighbor ICI). No host round-trips, one compiled program.
+- Schedules run inside a PARTIAL-MANUAL ``shard_map``
+  (``axis_names={'pipe'}``): only the pipe axis is manual — each rank
+  applies its own stage and activations hop stage→stage with
+  ``jax.lax.ppermute`` (nearest-neighbor ICI) — while the batch and
+  ``model`` axes stay under the automatic partitioner. That is what
+  lets PP COMPOSE with DP/FSDP/TP: inside a stage the math is ordinary
+  global-view JAX, so TP falls out of the stacked params' sharding
+  rules (workloads/gpt2.py pipe×model rules) exactly as in the
+  non-pipelined model, and DP gradient reductions are inserted by XLA
+  — no hand-written pmeans.
 
 Two schedules:
 
@@ -48,6 +55,43 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensorflow_examples_tpu.core import collectives as coll
 from tensorflow_examples_tpu.core.mesh import AxisNames
+
+
+def _psum_pipe(tree, axis_name):
+    """psum over the pipe axis with sub-f32 leaves routed through f32.
+
+    Works around a jaxlib CPU compiler abort (`Invalid binary
+    instruction opcode copy` in AllReducePromotion/CloneAllReduce) when
+    a bf16/f16 all-reduce appears inside a PARTIAL-manual shard_map
+    region — the full-manual formulation compiles the same reduce fine.
+    CPU promotes sub-f32 all-reduces to f32 anyway, so this costs
+    nothing there; on TPU it spends 2× bytes on the once-per-step
+    loss/grad pipe reduces, noise next to the per-tick activation hops.
+    """
+
+    def up(x):
+        if x.dtype in (jnp.bfloat16, jnp.float16):
+            return x.astype(jnp.float32)
+        return x
+
+    out = coll.psum(jax.tree.map(up, tree), axis_name)
+    return jax.tree.map(lambda o, t: o.astype(t.dtype), out, tree)
+
+
+def _pin_pipe_dim(stage_params, mesh):
+    """Constrain dim0 of every stage-param leaf to ``pipe`` while
+    leaving every other dim UNCONSTRAINED — a plain ``None`` would mean
+    *replicated* and silently all-gather away the Megatron TP layout the
+    pipe×model rules placed on the stacked weights (PP×TP would still
+    be numerically right, but each device would hold full un-sharded
+    stage weights)."""
+    U = P.UNCONSTRAINED
+
+    def pin(p):
+        spec = P(*((AxisNames.PIPE,) + (U,) * (p.ndim - 1)))
+        return jax.lax.with_sharding_constraint(p, NamedSharding(mesh, spec))
+
+    return jax.tree.map(pin, stage_params)
 
 
 def _gpipe_local(stage_fn, params, x_mb, axis_name, rng=None):
@@ -102,7 +146,7 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name, rng=None):
     )
     # Only the last stage holds real outputs; broadcast to all pipe ranks
     # so the (replicated) head/loss runs everywhere.
-    return coll.psum(
+    return _psum_pipe(
         jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)), axis_name
     )
 
@@ -114,7 +158,6 @@ def pipeline_apply(
     *,
     mesh: Mesh,
     num_microbatches: int,
-    batch_spec: P = P((AxisNames.DATA, AxisNames.FSDP)),
     rng=None,
 ) -> jax.Array:
     """Apply a [stages]-stacked stage over ``x`` with GPipe scheduling.
@@ -140,17 +183,17 @@ def pipeline_apply(
     param_specs = jax.tree.map(
         lambda p: P(*((AxisNames.PIPE,) + (None,) * (p.ndim - 1))), stage_params
     )
-    # Microbatched activations: batch dim is now axis 1.
-    act_spec = P(None, *batch_spec)
-    constrained = jax.lax.with_sharding_constraint(
-        stage_params, jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs)
-    )
+    constrained = _pin_pipe_dim(stage_params, mesh)
+    # Partial-manual: only `pipe` is manual (module docstring). Specs
+    # may therefore only reference `pipe`; activations are pipe-
+    # replicated (P()), their batch sharding rides the auto axes.
     if rng is None:
         out = jax.shard_map(
             lambda p, xm: _gpipe_local(stage_fn, p, xm, AxisNames.PIPE),
             mesh=mesh,
-            in_specs=(param_specs, act_spec),
-            out_specs=act_spec,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            axis_names={AxisNames.PIPE},
             check_vma=False,
         )(constrained, x_mb)
     else:
@@ -161,8 +204,9 @@ def pipeline_apply(
                 stage_fn, p, xm, AxisNames.PIPE, rng=r
             ),
             mesh=mesh,
-            in_specs=(param_specs, act_spec, P()),
-            out_specs=act_spec,
+            in_specs=(param_specs, P(), P()),
+            out_specs=P(),
+            axis_names={AxisNames.PIPE},
             check_vma=False,
         )(constrained, x_mb, rng)
     return out.reshape((b,) + x.shape[1:])
@@ -389,7 +433,6 @@ def make_pipeline_1f1b(
     *,
     mesh: Mesh,
     num_microbatches: int,
-    batch_spec: P = P((AxisNames.DATA, AxisNames.FSDP)),
 ):
     """Build the 1F1B pipelined loss:
     ``run(stage_params, head_params, x, labels, rng) -> scalar loss``.
@@ -429,17 +472,8 @@ def make_pipeline_1f1b(
             lambda p: P(*((pipe_axis,) + (None,) * (p.ndim - 1))),
             stage_params,
         )
-        act_spec = P(None, *batch_spec)
         head_specs = jax.tree.map(lambda _: P(), head_params)
-        constrained = jax.lax.with_sharding_constraint(
-            stage_params,
-            jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs),
-        )
-
-        batch_axes = batch_spec[0]
-        if isinstance(batch_axes, str):
-            batch_axes = (batch_axes,)
-        n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))
+        constrained = _pin_pipe_dim(stage_params, mesh)
 
         def local(sp, hp, xm, lm, r=None):
             loss, d_sp, d_hp, dx = _1f1b_local(
@@ -448,26 +482,22 @@ def make_pipeline_1f1b(
             )
             stage = lax.axis_index(pipe_axis)
             is_last = stage == n_stages - 1
-            # Loss and head grads exist on the last stage, dx on stage
-            # 0; one psum each replicates them over the pipe (zeros
-            # elsewhere). Each batch shard computed the loss over ITS
-            # rows only, so the global mean needs a pmean over the
-            # batch axes — for the param grads this IS the DP gradient
-            # all-reduce, landed inside the one compiled program.
-            loss = coll.psum(jnp.where(is_last, loss, 0.0), pipe_axis)
-            loss = lax.pmean(loss, batch_axes)
-            d_hp = coll.psum(
+            # Only `pipe` is manual here (axis_names below): inside this
+            # region the arrays are GLOBAL over the batch/model axes and
+            # XLA inserts the DP/TP collectives from their shardings —
+            # the hand-written pmeans of the all-manual formulation are
+            # gone. Loss and head grads exist on the last stage, dx on
+            # stage 0; one psum each replicates them over the pipe
+            # (zeros elsewhere).
+            loss = _psum_pipe(jnp.where(is_last, loss, 0.0), pipe_axis)
+            d_hp = _psum_pipe(
                 jax.tree.map(
                     lambda g: jnp.where(is_last, g, jnp.zeros_like(g)),
                     d_hp,
                 ),
                 pipe_axis,
             )
-            d_hp = jax.tree.map(lambda g: lax.pmean(g, batch_axes), d_hp)
-            d_sp = jax.tree.map(lambda g: lax.pmean(g, batch_axes), d_sp)
-            # dx stays batch-sharded: the global-mean loss weights each
-            # shard's rows by 1/n_batch_shards.
-            dx = coll.psum(dx, pipe_axis) / n_batch_shards  # zeros off st. 0
+            dx = _psum_pipe(dx, pipe_axis)  # zeros off stage 0
             # Re-add the leading stage dim the in_spec split off.
             d_sp = jax.tree.map(lambda g: g[None], d_sp)
             return loss / m, d_sp, d_hp, dx
@@ -477,15 +507,17 @@ def make_pipeline_1f1b(
             return jax.shard_map(
                 lambda sp, hp, xm, lm: local(sp, hp, xm, lm),
                 mesh=mesh,
-                in_specs=(param_specs, head_specs, act_spec, act_spec),
-                out_specs=(P(), param_specs, head_specs, act_spec),
+                in_specs=(param_specs, head_specs, P(), P()),
+                out_specs=(P(), param_specs, head_specs, P()),
+                axis_names={pipe_axis},
                 check_vma=False,
             )(constrained, head_params, x_mb, labels_mb)
         return jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(param_specs, head_specs, act_spec, act_spec, P()),
-            out_specs=(P(), param_specs, head_specs, act_spec),
+            in_specs=(param_specs, head_specs, P(), P(), P()),
+            out_specs=(P(), param_specs, head_specs, P()),
+            axis_names={pipe_axis},
             check_vma=False,
         )(constrained, head_params, x_mb, labels_mb, rng)
 

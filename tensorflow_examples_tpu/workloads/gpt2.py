@@ -201,14 +201,21 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
 
 
 def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
-    """GPipe pipeline-parallel GPT-2 (mesh_pipe > 1).
+    """Pipeline-parallel GPT-2 (mesh_pipe > 1; 1F1B default, GPipe opt).
 
     The block stack lives as a [num_layers]-stacked param tree sharded
-    over ``pipe`` (rule below); embeddings/head stay replicated. The
-    GPipe schedule (parallel/pipeline.py) runs inside the same jitted
-    train step. Composes with dp/fsdp batch sharding; tp/sp belong to
-    the non-pipelined path (attention inside a stage is the plain Pallas
-    kernel). Decode/generate use the non-pipelined model.
+    over ``pipe`` (rules below); embeddings/head stay replicated. The
+    schedule (parallel/pipeline.py) runs inside the same jitted train
+    step under a partial-manual shard_map — only ``pipe`` is manual —
+    so it COMPOSES with dp/fsdp batch sharding AND tensor parallelism:
+    with mesh_model > 1 the rules below put the Megatron layout on each
+    stage's stacked weights (heads/ff over ``model``) and the automatic
+    partitioner inserts the TP collectives inside every stage tick,
+    exactly as in the non-pipelined model. Caveat: attention inside a
+    stage is the plain (meshless) kernel — with TP the partitioner
+    gathers heads around the opaque Pallas call, so ``attention="xla"``
+    partitions best inside PP×TP stages. sp/context stays outside PP.
+    Decode/generate use the non-pipelined model.
     """
     import jax
     from jax.sharding import PartitionSpec as P
@@ -365,7 +372,25 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
             else jnp.float32(per_example.shape[0]),
         }
 
-    rules = ShardingRules([(r"^blocks/", P(AxisNames.PIPE))])
+    # Stage dim over `pipe` on every blocks leaf; with mesh_model > 1
+    # the transformed base rules additionally lay the Megatron TP layout
+    # on the stacked weights. Derived from GPT2_RULES — prepend the
+    # stage dim, drop the fsdp entry (param-sharding over fsdp is the
+    # non-PP path's ZeRO-3 trade; untested under PP) — so the two
+    # layouts cannot drift (a size-1 model axis is filtered out at
+    # sharding time, keeping these safe on pure-PP meshes).
+    _Pp, _Ff = AxisNames.PIPE, AxisNames.FSDP
+
+    def _stage_spec(spec: P) -> P:
+        return P(_Pp, *(None if a == _Ff else a for a in spec))
+
+    rules = ShardingRules(
+        [
+            (r"^blocks/" + pat.pattern, _stage_spec(spec))
+            for pat, spec in transformer.GPT2_RULES.rules
+        ]
+        + [(r"^blocks/", P(_Pp))]
+    )
     return Task(
         name="gpt2_124m_pp",
         init_fn=init_fn,

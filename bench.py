@@ -908,6 +908,20 @@ def run_selftest(timeout_s: float = 900.0) -> dict:
         if not lines:
             lines = [l for l in r.stderr.strip().splitlines() if l.strip()]
         tail = lines[-1] if lines else ""
+        if r.returncode == 5:
+            # "No tests collected": tests_tpu/conftest.py's backend
+            # probe found no live TPU and ignored the modules — surface
+            # its reason (printed on stderr) rather than pytest's tail.
+            reason = next(
+                (l for l in r.stderr.splitlines() if "tests_tpu:" in l),
+                tail,
+            )
+            return {
+                "ok": False,
+                "summary": ("no live TPU for compiled-kernel selftest — "
+                            + reason)[-300:],
+                "seconds": round(time.perf_counter() - t0, 1),
+            }
         return {
             "ok": r.returncode == 0,
             "summary": tail[-200:],

@@ -6,9 +6,11 @@ pooler + per-task head), built TPU-first on the shared framework:
 
 - Bidirectional attention with the padding mask folded in as an additive
   bias. At GLUE sequence lengths (≤128) attention is a small fraction of
-  the FLOPs, so the XLA softmax path is the right kernel choice here;
-  the Pallas flash path stays the long-sequence/causal specialty
-  (models/transformer.py).
+  the FLOPs, so the XLA softmax path is the right default; for long
+  sequences ``attention="flash"`` runs the Pallas kernel with the
+  padding mask as its non-causal key bias (ops/attention.py
+  ``key_bias``) — same numerics, O(block²) VMEM instead of the [S, S]
+  score matrix.
 - Same head-major DenseGeneral layout as the GPT-2 model, so the
   GPT2-style TP sharding rules apply (BERT_RULES below).
 - Weight layout maps 1:1 from HF ``BertModel`` (models/hf_import.py →
@@ -30,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tensorflow_examples_tpu.core.mesh import AxisNames
 from tensorflow_examples_tpu.core.sharding import ShardingRules
+from tensorflow_examples_tpu.ops.attention import flash_attention
 
 NEG_INF = -1e30
 
@@ -45,6 +48,13 @@ class BertConfig:
     d_ff: int = 3072
     dropout: float = 0.1
     layer_norm_eps: float = 1e-12
+    attention: str = "xla"  # xla | flash (Pallas kernel + key_bias mask)
+
+    def __post_init__(self):
+        if self.attention not in ("xla", "flash"):
+            raise ValueError(
+                f"attention={self.attention!r}; expected 'xla' or 'flash'"
+            )
 
 
 def bert_base(**overrides) -> BertConfig:
@@ -76,11 +86,20 @@ class BertLayer(nn.Module):
 
         qkv = nn.DenseGeneral(features=(3, h, hd), dtype=x.dtype, name="attn_qkv")(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-        ) * (hd ** -0.5)
-        p = jax.nn.softmax(s + bias, axis=-1).astype(x.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        if cfg.attention == "flash":
+            # bias arrives as the raw [B, S] key mask bias on this path.
+            swap = lambda t: t.transpose(0, 2, 1, 3)
+            ctx = swap(
+                flash_attention(
+                    swap(q), swap(k), swap(v), causal=False, key_bias=bias
+                )
+            )
+        else:
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            ) * (hd ** -0.5)
+            p = jax.nn.softmax(s + bias, axis=-1).astype(x.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
         attn_out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), dtype=x.dtype, name="attn_proj"
         )(ctx)
@@ -131,9 +150,11 @@ class BertEncoder(nn.Module):
         )(emb)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
-        # Padding mask → additive attention bias [B, 1, 1, S].
-        bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
-        bias = bias.astype(jnp.float32)
+        # Padding mask → additive attention bias: [B, 1, 1, S] for the
+        # XLA softmax path, raw [B, S] for the flash kernel's key_bias.
+        bias = jnp.where(attention_mask > 0, 0.0, NEG_INF).astype(jnp.float32)
+        if cfg.attention != "flash":
+            bias = bias[:, None, None, :]
 
         for i in range(cfg.num_layers):
             x = BertLayer(cfg, train, name=f"layer_{i}")(x, bias)

@@ -50,16 +50,21 @@ def attention_reference(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
+    key_bias: jax.Array | None = None,
 ) -> jax.Array:
     """Plain-XLA attention; the numerics reference for the Pallas kernel.
 
     q, k, v: [batch, heads, seq, head_dim]. Softmax in f32.
+    ``key_bias``: optional [batch, seq_kv] additive score bias (f32),
+    broadcast over heads and query rows — the padding-mask shape.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
+    if key_bias is not None:
+        s = s + key_bias[:, None, None, :].astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
@@ -75,8 +80,13 @@ def attention_reference(
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, sm_scale, causal
+    q_ref, k_ref, v_ref, *rest, sm_scale, causal, has_bias=False
 ):
+    if has_bias:
+        kb_ref, o_ref, lse_ref, m_s, l_s, acc_s = rest
+    else:
+        kb_ref = None
+        o_ref, lse_ref, m_s, l_s, acc_s = rest
     block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
     block_kv = k_ref.shape[1]
     qi, kj = pl.program_id(1), pl.program_id(2)
@@ -102,6 +112,8 @@ def _fwd_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_kv]
+        if has_bias:
+            s = s + kb_ref[...]  # [1, block_kv] broadcasts over rows
         if causal:
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
@@ -132,19 +144,32 @@ def _fwd_kernel(
         lse_ref[0] = (m_s[...] + jnp.log(l)).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret):
+def _flash_fwd(
+    q, k, v, sm_scale, causal, block_q, block_kv, interpret, kb=None, heads=1
+):
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
     grid = (bh, seq_q // block_q, seq_kv // block_kv)
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, has_bias=kb is not None
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+    ]
+    args = (q, k, v)
+    if kb is not None:
+        # [batch, seq_kv] tile; grid dim 0 is batch·heads, so the batch
+        # row is program_id(0) // heads (static closure).
+        in_specs.append(
+            pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j))
+        )
+        args = args + (kb,)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -159,7 +184,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret):
             pltpu.VMEM((block_q, head_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
@@ -167,9 +192,14 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv, interpret):
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
-    dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref, *rest,
+    sm_scale, causal, has_bias=False,
 ):
+    if has_bias:
+        kb_ref, dk_ref, dv_ref, dk_s, dv_s = rest
+    else:
+        kb_ref = None
+        dk_ref, dv_ref, dk_s, dv_s = rest
     block_kv, head_dim = k_ref.shape[1], k_ref.shape[2]
     block_q = q_ref.shape[1]
     ki, qj = pl.program_id(1), pl.program_id(2)
@@ -195,6 +225,8 @@ def _bwd_dkv_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_kv]
+        if has_bias:
+            s = s + kb_ref[...]
         if causal:
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
@@ -230,9 +262,14 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
-    dq_ref, dq_s, *, sm_scale, causal,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref, *rest,
+    sm_scale, causal, has_bias=False,
 ):
+    if has_bias:
+        kb_ref, dq_ref, dq_s = rest
+    else:
+        kb_ref = None
+        dq_ref, dq_s = rest
     block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
     block_kv = k_ref.shape[1]
     qi, kj = pl.program_id(1), pl.program_id(2)
@@ -256,6 +293,8 @@ def _bwd_dq_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
+        if has_bias:
+            s = s + kb_ref[...]
         if causal:
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
@@ -284,11 +323,13 @@ def _bwd_dq_kernel(
 
 
 def _flash_bwd(
-    sm_scale, causal, block_q, block_kv, interpret, residuals, do, dlse
+    sm_scale, causal, block_q, block_kv, interpret, residuals, do, dlse,
+    kb=None, heads=1,
 ):
     q, k, v, o, lse = residuals
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
+    has_bias = kb is not None
     # delta_i = rowsum(do_i * o_i) — cheap, let XLA fuse it.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
@@ -300,11 +341,21 @@ def _flash_bwd(
     q_blk = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, j, 0))
     kv_blk = pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, i, 0))
     vec_blk = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    # In the dkv grid the KV block index is grid dim 1 (i).
+    kb_blk = pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, i))
+    in_specs = [q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk]
+    args = (q, k, v, do, lse, delta, dlse)
+    if has_bias:
+        in_specs.append(kb_blk)
+        args = args + (kb,)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal),
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            has_bias=has_bias,
+        ),
         grid=(bh, seq_kv // block_kv, seq_q // block_q),
-        in_specs=[q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk],
+        in_specs=in_specs,
         out_specs=[kv_blk, kv_blk],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -315,21 +366,30 @@ def _flash_bwd(
             pltpu.VMEM((block_kv, head_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, dlse)
+    )(*args)
 
     q_blk = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
     kv_blk = pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0))
     vec_blk = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    kb_blk = pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j))
+    in_specs = [q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk]
+    args = (q, k, v, do, lse, delta, dlse)
+    if has_bias:
+        in_specs.append(kb_blk)
+        args = args + (kb,)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal),
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            has_bias=has_bias,
+        ),
         grid=(bh, seq_q // block_q, seq_kv // block_kv),
-        in_specs=[q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk],
+        in_specs=in_specs,
         out_specs=q_blk,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, dlse)
+    )(*args)
     return dq, dk, dv
 
 
@@ -377,6 +437,43 @@ def _make_flash_lse(causal, block_q, block_kv, interpret):
         return _flash_bwd(
             sm_scale, causal, block_q, block_kv, interpret, residuals, do, dlse
         )
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash_bias(causal, block_q, block_kv, interpret, heads):
+    """Variant with a [batch, seq_kv] additive key bias (padding masks).
+
+    The bias is treated as NON-differentiable data — it comes from an
+    attention mask, and a ±NEG_INF bias has no meaningful gradient — so
+    its cotangent is zeros; the bwd kernels still ADD it when
+    recomputing the scores (p must match the forward's softmax).
+    """
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+    def flash(q, k, v, kb, sm_scale):
+        o, _ = _flash_fwd(
+            q, k, v, sm_scale, causal, block_q, block_kv, interpret,
+            kb=kb, heads=heads,
+        )
+        return o
+
+    def fwd(q, k, v, kb, sm_scale):
+        o, lse = _flash_fwd(
+            q, k, v, sm_scale, causal, block_q, block_kv, interpret,
+            kb=kb, heads=heads,
+        )
+        return o, (q, k, v, o, lse, kb)
+
+    def bwd(sm_scale, residuals, g):
+        *res, kb = residuals
+        dq, dk, dv = _flash_bwd(
+            sm_scale, causal, block_q, block_kv, interpret, tuple(res), g,
+            None, kb=kb, heads=heads,
+        )
+        return dq, dk, dv, jnp.zeros_like(kb)
 
     flash.defvjp(fwd, bwd)
     return flash
@@ -454,6 +551,7 @@ def flash_attention(
     block_q: int | None = None,
     block_kv: int | None = None,
     interpret: bool | None = None,
+    key_bias: jax.Array | None = None,
 ) -> jax.Array:
     """Blockwise attention, differentiable; q/k/v: [batch, heads, seq, dim].
 
@@ -463,13 +561,29 @@ def flash_attention(
     end-to-end than 128 on GPT-2 124M, b8 s1024, single v5e chip,
     within-run comparison), fitted down to a hardware-legal divisor of
     the sequence; explicit sizes are enforced exactly.
+
+    ``key_bias``: optional [batch, seq_kv] additive score bias (f32),
+    broadcast over heads and query rows — the padding-mask shape BERT
+    needs. Non-differentiable (zero cotangent; it is mask data).
     """
     sm_scale, block_q, block_kv, interpret = _prepare(
         q, k, v, causal, sm_scale, block_q, block_kv, interpret
     )
     b, h, seq_q, head_dim = q.shape
-    flash = _make_flash(bool(causal), block_q, block_kv, interpret)
     fold = lambda x: x.reshape(b * h, x.shape[2], head_dim)
+    if key_bias is not None:
+        if key_bias.shape != (b, k.shape[2]):
+            raise ValueError(
+                f"key_bias shape {key_bias.shape} != (batch, seq_kv) "
+                f"({b}, {k.shape[2]})"
+            )
+        flash = _make_flash_bias(bool(causal), block_q, block_kv, interpret, h)
+        out = flash(
+            fold(q), fold(k), fold(v),
+            key_bias.astype(jnp.float32), sm_scale,
+        )
+        return out.reshape(b, h, seq_q, head_dim)
+    flash = _make_flash(bool(causal), block_q, block_kv, interpret)
     out = flash(fold(q), fold(k), fold(v), sm_scale)
     return out.reshape(b, h, seq_q, head_dim)
 

@@ -36,6 +36,7 @@ class BertGlueConfig(TrainConfig):
     d_model: int = 768
     d_ff: int = 3072
     dropout: float = 0.1
+    attention: str = "xla"  # xla | flash (Pallas + key-bias padding mask)
     pretrained: str = ""  # local HF BERT path; "" = random init
 
     global_batch_size: int = 32
@@ -57,6 +58,7 @@ def model_config(cfg: BertGlueConfig) -> bert.BertConfig:
         d_model=cfg.d_model,
         d_ff=cfg.d_ff,
         dropout=cfg.dropout,
+        attention=cfg.attention,
     )
 
 

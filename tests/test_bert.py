@@ -66,6 +66,42 @@ def test_padding_mask_invariance():
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
 
 
+def test_flash_attention_matches_xla():
+    """attention="flash" (Pallas kernel + key-bias padding mask) must
+    reproduce the XLA softmax path on ragged per-row masks — logits AND
+    parameter gradients."""
+    base = dict(
+        vocab_size=50, max_len=32, num_layers=2, num_heads=2,
+        d_model=16, d_ff=32, dropout=0.0,
+    )
+    model_x = bert.BertClassifier(bert.BertConfig(**base), num_labels=2)
+    model_f = bert.BertClassifier(
+        bert.BertConfig(**base, attention="flash"), num_labels=2
+    )
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(1, 50, (3, 32)), jnp.int32)
+    lengths = np.asarray([32, 20, 7])
+    mask = jnp.asarray(
+        (np.arange(32)[None] < lengths[:, None]).astype(np.int32)
+    )
+    params = model_x.init({"params": jax.random.PRNGKey(0)}, tokens)["params"]
+    out_x = model_x.apply({"params": params}, tokens, mask)
+    out_f = model_f.apply({"params": params}, tokens, mask)
+    np.testing.assert_allclose(
+        np.asarray(out_x), np.asarray(out_f), atol=2e-4, rtol=2e-4
+    )
+
+    def loss(m):
+        return lambda p: jnp.sum(m.apply({"params": p}, tokens, mask) ** 2)
+
+    g_x = jax.grad(loss(model_x))(params)
+    g_f = jax.grad(loss(model_f))(params)
+    for a, b in zip(jax.tree.leaves(g_x), jax.tree.leaves(g_f)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+        )
+
+
 def test_hf_parity():
     """Imported HF BertForSequenceClassification weights → identical logits."""
     torch = pytest.importorskip("torch")

@@ -86,6 +86,19 @@ def test_flash_lse_compiled_parity():
     assert _max_abs(lse, ref_lse) < 2e-2
 
 
+def test_flash_key_bias_compiled_parity():
+    # BERT padding-mask shape: non-causal, [batch, seq] key bias.
+    q, k, v = _qkv(2, 12, 512, 64, seed=5)
+    kb = jnp.where(
+        jnp.arange(512)[None] < jnp.asarray([512, 300])[:, None], 0.0, -1e30
+    ).astype(jnp.float32)
+    out = flash_attention(
+        q, k, v, causal=False, key_bias=kb, interpret=False
+    )
+    ref = attention_reference(q, k, v, causal=False, key_bias=kb)
+    assert _max_abs(out, ref) < 2e-2
+
+
 def test_flash_decode_compiled_parity():
     from tensorflow_examples_tpu.ops.decode import (
         decode_attention_reference,

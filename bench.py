@@ -51,6 +51,20 @@ backend init — not just raise — so the ambient backend is probed in a
 subprocess with a hard timeout; on failure the bench falls back to an
 in-process CPU pin and tags the output ``"backend": "cpu"``. Any
 failure still prints one parseable JSON line and exits 0.
+
+Budget (VERDICT r3 item 2 — BENCH_r03.json was rc=124/parsed:null
+because a driver-side ``timeout`` killed the sweep): the whole run now
+operates under a wall-clock budget (``--budget=S`` /
+``$BENCH_BUDGET_S``, default 540 s so an outer ``timeout 600`` can
+never win). Benches that don't fit the remaining budget are skipped and
+listed under ``"truncated"``; a watchdog thread is the backstop — if
+the main thread is wedged inside a compile when the budget expires, the
+watchdog emits everything completed so far as the one JSON line and
+exits 0. Subprocess helpers (backend probe, MoE census, TPU selftest)
+are capped by the remaining budget, the probe verdict is cached in
+/tmp for 300 s so a process tree pays the dead-tunnel hang at most
+once, and a persistent XLA compilation cache (/tmp/jax_bench_cache)
+makes warm re-runs cheap.
 """
 
 import json
@@ -58,6 +72,7 @@ import os
 import statistics
 import subprocess
 import sys
+import threading
 import time
 
 # Regression floors: (value, rig_fingerprint_tflops) pairs per
@@ -123,14 +138,108 @@ REL_MFU_FLOORS: dict[str, dict[str, float]] = {
 BACKEND = "cpu"  # resolved in main()
 WINDOWS = 3  # timing windows per bench; median reported
 
+# ------------------------------------------------------- budget machinery
+#
+# One deadline for the whole process (None = unbounded). Everything that
+# can block — benches, subprocess helpers, the backend probe — consults
+# _remaining(); the watchdog thread is the last line of defense for
+# hangs inside native code where Python-level checks never run.
 
-def _probe_backend(timeout_s: float = 120.0):
-    """Probe the ambient jax backend in a subprocess (it can hang)."""
+_DEADLINE: "float | None" = None
+_RESULTS: list = []  # completed per-bench dicts, in completion order
+_META: dict = {}  # backend / fingerprints / selftest, merged at emit
+_TRUNCATED: list = []  # bench names skipped or killed by the budget
+_IN_FLIGHT: "str | None" = None
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _remaining() -> float:
+    return float("inf") if _DEADLINE is None else _DEADLINE - time.monotonic()
+
+
+def _assemble() -> dict:
+    """Fold completed benches into the single driver JSON object:
+    headline = highest-priority error-free bench per ALL_ORDER (benches
+    EXECUTE cheapest-first to maximize coverage under the budget, but
+    the record is always presented headline-first), everything else
+    under "extras", budget victims under "truncated"."""
+    rank = {n: i for i, n in enumerate(ALL_ORDER)}
+    results = sorted(_RESULTS, key=lambda r: rank.get(r.get("bench"), 99))
+    head = next((r for r in results if "error" not in r), None)
+    if head is None and results:
+        # Everything errored: surface the first real error (with its
+        # bench identity) at top level rather than a generic message.
+        head = results[0]
+    out = dict(head) if head is not None else {"error": "no bench completed"}
+    extras = [r for r in results if r is not head]
+    if extras:
+        out["extras"] = extras
+    trunc = list(_TRUNCATED)
+    done = {r.get("bench") for r in results}
+    if _IN_FLIGHT is not None and _IN_FLIGHT not in done:
+        trunc.append(_IN_FLIGHT)
+    if trunc:
+        out["truncated"] = trunc
+    out.update(_META)
+    return out
+
+
+def _emit(out: "dict | None" = None) -> None:
+    """Print the ONE JSON line, exactly once per process. Never raises:
+    a failure here would break the always-one-parseable-line contract
+    for both the main thread and the watchdog."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        try:
+            line = json.dumps(out if out is not None else _assemble())
+        except Exception as e:  # non-serializable value in a bench dict
+            line = json.dumps({"error": f"emit failed: {type(e).__name__}: {e}"})
+        try:
+            print(line)
+            sys.stdout.flush()
+        except Exception:
+            pass  # stdout gone (driver killed the pipe); nothing to do
+        _EMITTED = True
+
+
+def _watchdog_fire() -> None:
+    _META.setdefault("budget_expired", True)
+    _emit()
+    os._exit(0)  # main thread may be wedged in native code; don't wait
+
+
+_PROBE_CACHE = "/tmp/bench_backend_probe.json"
+_PROBE_CACHE_TTL = 300.0  # tunnel state changes on minutes timescales
+
+
+def _probe_backend(timeout_s: float = 90.0):
+    """Probe the ambient jax backend in a subprocess (it can hang).
+
+    The verdict is cached in /tmp with a short TTL so a process tree
+    (driver retries, selftest, my own repeated runs) pays the
+    dead-tunnel hang at most once per 5 minutes."""
+    try:
+        with open(_PROBE_CACHE) as f:
+            c = json.load(f)
+        if time.time() - c["time"] < _PROBE_CACHE_TTL:
+            return c["platform"], c["n"], c["err"]
+    except Exception:
+        pass
+    full_timeout = timeout_s
+    timeout_s = max(10.0, min(timeout_s, _remaining() - 30.0))
+    # ANY budget-derived reduction disqualifies a negative verdict from
+    # being cached: a live-but-slow tunnel must not be miscalled dead
+    # for the next TTL window (see below).
+    clamped = timeout_s < full_timeout
     code = (
         "import jax, sys\n"
         "d = jax.devices()\n"
         "sys.stdout.write('PROBE %s %d\\n' % (d[0].platform, len(d)))\n"
     )
+    plat, n, err = None, 0, "probe failed"
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
@@ -138,13 +247,27 @@ def _probe_backend(timeout_s: float = 120.0):
             text=True,
             timeout=timeout_s,
         )
+        for line in r.stdout.splitlines():
+            if line.startswith("PROBE "):
+                _, p, num = line.split()
+                plat, n, err = p, int(num), None
+                break
+        else:
+            err = (r.stderr or r.stdout).strip()[-400:] or "probe failed"
     except subprocess.TimeoutExpired:
-        return None, 0, f"backend init hung >{timeout_s:.0f}s"
-    for line in r.stdout.splitlines():
-        if line.startswith("PROBE "):
-            _, plat, n = line.split()
-            return plat, int(n), None
-    return None, 0, (r.stderr or r.stdout).strip()[-400:] or "probe failed"
+        err = f"backend init hung >{timeout_s:.0f}s"
+    if plat is None and clamped:
+        # Negative verdict under a budget-clamped timeout: a live-but-
+        # slow tunnel could be miscalled dead. Don't poison the cache.
+        return plat, n, err
+    try:
+        with open(_PROBE_CACHE, "w") as f:
+            json.dump(
+                {"platform": plat, "n": n, "err": err, "time": time.time()}, f
+            )
+    except Exception:
+        pass
+    return plat, n, err
 
 
 def _resolve_backend() -> str:
@@ -171,8 +294,24 @@ def _resolve_backend() -> str:
                 f"bench: default backend unusable ({err}); CPU fallback",
                 file=sys.stderr,
             )
+        _enable_compile_cache()
         return "cpu"
+    _enable_compile_cache()
     return "tpu"  # axon / tpu / anything accelerator-shaped
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: compile time is the dominant
+    wall-clock cost of a sweep on this 1-core host (and the first TPU
+    compile is 20-40 s/program), and it counts against the budget even
+    though it never enters a timing window. Warm re-runs skip it."""
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # cache is an optimization, never a failure
+        print(f"bench: compile cache unavailable ({e})", file=sys.stderr)
 
 
 # ------------------------------------------------------------- rig probe
@@ -847,7 +986,12 @@ def _moe_mesh_collectives(timeout_s: float = 600.0) -> dict:
     subprocess and count the collectives XLA inserted for expert
     dispatch (VERDICT r2 item 8: EP's comm pattern must be measured,
     not assumed). Subprocess because the mesh needs its own CPU-pinned
-    8-device runtime."""
+    8-device runtime. Capped by the remaining wall budget — the census
+    is a code property, not a perf number, so losing it to the budget
+    costs nothing the test suite doesn't already cover."""
+    timeout_s = min(timeout_s, _remaining() - 45.0)
+    if timeout_s < 30.0:
+        return {"skipped": "insufficient budget for mesh census"}
     try:
         r = subprocess.run(
             [sys.executable, "-c", _MOE_MESH_PROBE],
@@ -920,7 +1064,12 @@ def run_selftest(timeout_s: float = 900.0) -> dict:
     """Compiled-kernel parity on the live chip: run tests_tpu/ in a
     subprocess (hard timeout — the plugin can hang) and summarize.
     VERDICT r2 item 6: parity must be asserted on the real chip, not
-    only in interpret mode on CPU."""
+    only in interpret mode on CPU. Capped by the remaining wall budget
+    (it runs after the sweep, so truncation loses the selftest, never
+    the perf record)."""
+    timeout_s = min(timeout_s, _remaining() - 30.0)
+    if timeout_s < 45.0:
+        return {"ok": False, "summary": "skipped: insufficient budget"}
     t0 = time.perf_counter()
     here = os.path.dirname(os.path.abspath(__file__))
     try:
@@ -997,15 +1146,40 @@ ALL_ORDER = [
 ]
 
 
+# Conservative per-bench wall estimates (compile + windows, COLD compile
+# cache) used only to ORDER execution cheapest-first (the skip decision
+# is a fixed remaining-time threshold in run_all); a completed bench
+# records its true cost in "bench_seconds".
+_EST_SECONDS = {
+    "cpu": {
+        "resnet50": 120, "resnet50_input": 200, "gpt2": 75, "gpt2_long": 90,
+        "gpt2_long16k": 120, "gpt2_decode": 60, "gpt2_decode_long": 60,
+        "bert": 50, "cifar10": 70, "mnist": 45, "collectives": 60,
+        "moe": 180,
+    },
+    "tpu": {
+        "resnet50": 90, "resnet50_input": 150, "gpt2": 75, "gpt2_long": 75,
+        "gpt2_long16k": 90, "gpt2_decode": 75, "gpt2_decode_long": 75,
+        "bert": 60, "cifar10": 60, "mnist": 60, "collectives": 45,
+        "moe": 180,
+    },
+}
+
+
 def run_bench(name: str) -> dict:
     """Probe the rig immediately before the bench, run it, attach the
     drift-cancelled rel_mfu (see module docstring)."""
+    global _IN_FLIGHT
+    _IN_FLIGHT = name
+    t0 = time.perf_counter()
     try:
         probe = _probe_quick()
         r = BENCHES[name]()
     except Exception as e:  # one bench failing must not kill output
-        return {"metric": name, "error": f"{type(e).__name__}: {e}"}
+        return {"metric": name, "bench": name, "error": f"{type(e).__name__}: {e}"}
+    r["bench"] = name
     r["probe_tflops_at_bench"] = round(probe, 2)
+    r["bench_seconds"] = round(time.perf_counter() - t0, 1)
     mt = r.get("model_tflops_per_sec")
     if mt:
         r["rel_mfu"] = round(mt / probe, 5)
@@ -1015,18 +1189,43 @@ def run_bench(name: str) -> dict:
     return r
 
 
-def run_all() -> dict:
-    results = [run_bench(name) for name in ALL_ORDER]
-    head = next((r for r in results if "error" not in r), None)
-    if head is None:
-        return {"error": "all benches failed", "extras": results}
-    return {**head, "extras": [r for r in results if r is not head]}
+def run_all() -> None:
+    """Run the sweep cheapest-first (estimated cold-compile cost), so
+    the budget buys the maximum number of completed benches; _assemble
+    re-sorts the record headline-first. A bench is attempted whenever
+    >60 s remain — over-running is safe (the watchdog emits everything
+    completed so far) and execution is cost-ascending, so attempting
+    strictly dominates skipping. Appends to module result state so the
+    watchdog can emit a partial record at any instant."""
+    global _IN_FLIGHT
+    est = _EST_SECONDS.get(BACKEND, {})
+    for name in sorted(ALL_ORDER, key=lambda n: est.get(n, 60)):
+        if _remaining() < 60:
+            _TRUNCATED.append(name)
+            print(
+                f"bench: skipping {name} ({_remaining():.0f}s left)",
+                file=sys.stderr,
+            )
+            continue
+        _RESULTS.append(run_bench(name))
+        # Cleared only after the result is recorded: a watchdog firing
+        # mid-bench must see it as in-flight OR completed, never neither.
+        _IN_FLIGHT = None
 
 
 def main() -> int:
-    global BACKEND
+    global BACKEND, _DEADLINE, _IN_FLIGHT
     which = "all"
     selftest = None  # None = auto (on for TPU full sweeps)
+
+    def _parse_budget(s: str, fallback: float = 540.0) -> float:
+        try:
+            return float(s)
+        except ValueError:
+            print(f"bench: bad budget {s!r}; using {fallback}", file=sys.stderr)
+            return fallback
+
+    budget = _parse_budget(os.environ.get("BENCH_BUDGET_S", "540"))
     for a in sys.argv[1:]:
         if a.startswith("--bench="):
             which = a.split("=", 1)[1]
@@ -1034,39 +1233,57 @@ def main() -> int:
             selftest = True
         elif a == "--no-selftest":
             selftest = False
+        elif a.startswith("--budget="):
+            budget = _parse_budget(a.split("=", 1)[1], budget)
     known = set(BENCHES) | {"all", "selftest"}
     if which not in known:
-        print(
-            json.dumps({"error": f"unknown --bench={which}", "known": sorted(known)})
-        )
+        _emit({"error": f"unknown --bench={which}", "known": sorted(known)})
         return 0
+    watchdog = None
+    if budget > 0:
+        _DEADLINE = time.monotonic() + budget
+        _META["budget_s"] = budget
+        # Backstop fires shortly before the budget so the emit beats an
+        # outer `timeout <budget+60>`; daemon thread survives a main
+        # thread wedged inside a native compile.
+        watchdog = threading.Timer(max(budget - 15.0, 5.0), _watchdog_fire)
+        watchdog.daemon = True
+        watchdog.start()
     try:
         BACKEND = _resolve_backend()
+        _META["backend"] = BACKEND
         if which == "selftest":
-            out = {"metric": "selftest", "selftest": run_selftest()}
-            out["backend"] = BACKEND
-            print(json.dumps(out))
+            _emit(
+                {
+                    "metric": "selftest",
+                    "selftest": run_selftest(),
+                    "backend": BACKEND,
+                }
+            )
             return 0
-        st = None
-        if selftest or (selftest is None and which == "all" and BACKEND == "tpu"):
-            st = run_selftest()
         fp_pre = round(fingerprint_tflops(), 2)
-        out = run_all() if which == "all" else run_bench(which)
-        fp_post = round(fingerprint_tflops(), 2)
-        out["backend"] = BACKEND
-        out["fingerprint_tflops_pre"] = fp_pre
-        out["fingerprint_tflops_post"] = fp_post
         # Back-compat scalar stamp: the pre-sweep median.
-        out["fingerprint_tflops"] = fp_pre
-        if st is not None:
-            out["selftest"] = st
+        _META["fingerprint_tflops_pre"] = _META["fingerprint_tflops"] = fp_pre
+        if which == "all":
+            run_all()
+        else:
+            _RESULTS.append(run_bench(which))
+            _IN_FLIGHT = None
+        _META["fingerprint_tflops_post"] = round(fingerprint_tflops(), 2)
+        # Selftest runs AFTER the sweep: on a live TPU with a cold cache
+        # the budget should be spent on perf evidence first, and the
+        # selftest cap consumes whatever is left.
+        if selftest or (selftest is None and which == "all" and BACKEND == "tpu"):
+            _META["selftest"] = run_selftest()
     except Exception as e:
-        out = {
-            "error": f"{type(e).__name__}: {e}",
-            "backend": BACKEND,
-            "metric": which,
-        }
-    print(json.dumps(out))
+        # Keyed so it can never clobber a completed headline's "metric"
+        # (out.update(_META) in _assemble); _assemble already supplies
+        # {"error": "no bench completed"} when nothing finished.
+        _META["sweep_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if watchdog is not None:
+            watchdog.cancel()
+        _emit()
     return 0
 
 

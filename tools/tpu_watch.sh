@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll the axon TPU tunnel until it answers; exit 0 on first live probe.
+# Each probe is a subprocess with a hard timeout (axon init can hang
+# indefinitely — see docs/DESIGN.md rig notes). Writes /tmp/tpu_live on
+# success so concurrent tooling can check cheaply.
+rm -f /tmp/tpu_live
+while true; do
+  out=$(timeout 120 nice -n 19 python - <<'EOF' 2>&1
+import jax
+ds = jax.devices()
+print("LIVE", ds[0].platform, len(ds))
+EOF
+)
+  if echo "$out" | grep -q "^LIVE tpu"; then
+    echo "$out" > /tmp/tpu_live
+    echo "TPU TUNNEL LIVE: $out"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) probe: down"
+  sleep 240
+done

@@ -973,9 +973,17 @@ trainer = Trainer(gpt2.make_task(cfg, mesh), cfg, mesh=mesh)
 ds, _ = gpt2.datasets(cfg)
 batch = trainer._put_batch(next(train_iterator(ds, 8, seed=0)))
 hlo = trainer._train_step.lower(trainer.state, batch).compile().as_text()
+# Definition sites only: a plain substring count also matches operand
+# REFERENCES (%all-reduce.12 as an argument) and overcounted ~2-3x in
+# rounds 2-3 (BASELINE.md round-4 correction). Non-greedy shape so
+# tuple-shaped collectives (lax.all_to_all lowers to one) match.
 ops = collections.Counter(
-    re.findall(r"\b(all-to-all|all-reduce|all-gather|reduce-scatter|"
-               r"collective-permute)", hlo)
+    m.group(1)
+    for m in re.finditer(
+        r"^\s*(?:ROOT\s+)?%?[\w.\-]+ = (?:.+?) (all-to-all|all-reduce|"
+        r"all-gather|reduce-scatter|collective-permute)(?:-start)?\(",
+        hlo, re.M,
+    )
 )
 print("MOE_COLLECTIVES " + json.dumps(dict(ops)))
 """

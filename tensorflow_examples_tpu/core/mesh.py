@@ -51,6 +51,46 @@ class AxisNames:
     BATCH_AXES = (DATA, FSDP)
 
 
+def token_partition_axes(
+    mesh,
+    batch_dim: int,
+    seq_dim: int | None = None,
+    *,
+    include_model: bool = False,
+) -> tuple[tuple, tuple]:
+    """Shared axis-dropping policy for token-parallel shard_maps.
+
+    Returns ``(batch_axes, seq_axes)`` for partitioning a ``[B, S, ...]``
+    activation over the mesh: every nontrivial batch-like axis shards
+    the batch dim (ALL dropped if their product doesn't divide it —
+    jit in_specs must divide exactly, and decode-time batch=1 is the
+    common non-dividing case), ``context`` shards the seq dim when it
+    divides, and — when ``include_model`` — ``model`` joins the seq
+    sharding if it also divides (token-independent ops like CE are
+    replicated work under TP otherwise). Consumers: ``parallel/moe.py``
+    (batch policy), ``ops/cross_entropy.py`` (batch + seq + model).
+    Axes dropped here mean the tokens REPLICATE over that axis, which
+    is always correct, just less parallel.
+    """
+    import math
+
+    batch_axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
+    nb = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if batch_dim % nb:
+        batch_axes = ()
+    seq_axes: tuple = ()
+    if seq_dim is not None:
+        c = mesh.shape[AxisNames.CONTEXT]
+        if c > 1 and seq_dim % c == 0:
+            seq_axes += (AxisNames.CONTEXT,)
+        if include_model:
+            m = mesh.shape[AxisNames.MODEL]
+            denom = (c if seq_axes else 1) * m
+            if m > 1 and seq_dim % denom == 0:
+                seq_axes += (AxisNames.MODEL,)
+    return batch_axes, seq_axes
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical mesh shape. -1 for ``data`` means "all remaining devices"."""

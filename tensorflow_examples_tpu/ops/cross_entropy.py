@@ -31,11 +31,15 @@ NEG_INF = -1e30
 
 
 def cross_entropy_reference(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Per-example NLL in f32 via plain XLA. logits [N, V], labels [N]."""
+    """Per-example NLL in f32 via plain XLA. logits [N, V], labels [N].
+
+    Label selection uses the gather-free mask+reduce (ops.losses
+    .select_label) so this path partitions cleanly under SPMD too."""
+    from tensorflow_examples_tpu.ops.losses import select_label
+
     logits = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(logits, axis=-1)
-    label_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-    return lse - label_logit
+    return lse - select_label(logits, labels)
 
 
 # --------------------------------------------------------------- kernels
@@ -173,6 +177,66 @@ def cross_entropy_per_example(
     block_v = min(block_v, vocab)
     fn = _make_fused(block_n, block_v, interpret)
     return fn(logits, labels.astype(jnp.int32)[:, None])[:, 0]
+
+
+def mesh_cross_entropy_per_example(
+    logits: jax.Array,  # [B, S, V]
+    labels: jax.Array,  # [B, S] int
+    *,
+    mesh,
+    fused: bool | None = None,
+) -> jax.Array:
+    """Token-sharded per-example NLL [B, S] for meshed training steps.
+
+    The fused Pallas kernel is OPAQUE to the SPMD partitioner: called on
+    data-sharded logits it triggers the partitioner's while-loop gather
+    fallback, which all-gathers the full ``[tokens, vocab]`` logits
+    across the data axes every step (measured: five data-axis
+    ``[1024, 512]`` all-gathers in the dp2×model4 census,
+    ``tools/ep_census.py``, round 4). CE is per-token independent, so a
+    ``shard_map`` over the token axes makes the kernel local per shard
+    with zero collectives. The ``model`` axis joins the seq-dim
+    sharding when it divides: CE is replicated work under TP otherwise,
+    and feeding logits in model-replicated would cost a [tokens, vocab]
+    dlogits psum over ``model`` in the backward (measured before this
+    split landed); with the split, sharding propagation pushes the seq
+    partition up into the LM-head matmul itself. Axes that don't divide
+    the corresponding dim are dropped (tokens replicate there — same policy as
+    ``parallel/moe.py``); on a 1-device mesh this degenerates to the
+    plain call.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflow_examples_tpu.core.mesh import token_partition_axes
+
+    def _plain(lg, lb):
+        v = lg.shape[-1]
+        return cross_entropy_per_example(
+            lg.reshape(-1, v), lb.reshape(-1), fused=fused
+        ).reshape(lb.shape)
+
+    if mesh is None:
+        return _plain(logits, labels)
+    batch_axes, seq_axes = token_partition_axes(
+        mesh, labels.shape[0], labels.shape[1], include_model=True
+    )
+    if not batch_axes and not seq_axes:
+        return _plain(logits, labels)
+    lg_spec = P(
+        batch_axes if batch_axes else None,
+        seq_axes if seq_axes else None,
+        None,
+    )
+    lb_spec = P(
+        batch_axes if batch_axes else None, seq_axes if seq_axes else None
+    )
+    return jax.shard_map(
+        _plain,
+        mesh=mesh,
+        in_specs=(lg_spec, lb_spec),
+        out_specs=lb_spec,
+        check_vma=False,
+    )(logits, labels)
 
 
 def cross_entropy_loss(

@@ -9,6 +9,24 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def select_label(values: jax.Array, labels: jax.Array) -> jax.Array:
+    """``values[..., C]`` at ``labels[...]`` WITHOUT a gather.
+
+    ``jnp.take_along_axis`` lowers to an HLO gather, and XLA's SPMD
+    partitioner handles that gather via its while-loop fallback that
+    ALL-GATHERS the operand across the sharded token axis — measured as
+    five ``[tokens, vocab]`` data-axis all-gathers in the dp2×model4
+    train-step census (tools/ep_census.py, round 4). The one-hot mask +
+    reduce below fuses into a single partition-friendly reduction on
+    every backend, sharded or not; the extra O(n·C) elementwise work is
+    noise next to the log_softmax that precedes it."""
+    iota = lax.broadcasted_iota(jnp.int32, values.shape, values.ndim - 1)
+    return jnp.sum(
+        jnp.where(iota == labels[..., None], values, 0), axis=-1
+    )
 
 
 def weighted_mean(values: jax.Array, weights: jax.Array | None) -> jax.Array:
@@ -35,7 +53,7 @@ def softmax_cross_entropy(
     logits = logits.astype(jnp.float32)
     num_classes = logits.shape[-1]
     log_probs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    nll = -select_label(log_probs, labels)
     if label_smoothing > 0.0:
         smooth = -jnp.mean(log_probs, axis=-1)
         nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
@@ -54,7 +72,7 @@ def accuracy_metrics(
     out = {"accuracy": weighted_mean(correct, weights)}
     if top5:
         # In-top-5 without a sort: count logits strictly above the label's.
-        label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)
+        label_logit = select_label(logits, labels)[..., None]
         rank = jnp.sum((logits > label_logit).astype(jnp.int32), axis=-1)
         out["top5_accuracy"] = weighted_mean((rank < 5).astype(jnp.float32), weights)
     if weights is not None:

@@ -195,21 +195,37 @@ def moe_ffn_ep(
     back with the inverse all-to-all — the GShard/Switch dispatch
     pattern on ICI.
 
+    Tokens are additionally SPLIT over the ``model`` axis inside the
+    shard_map (ADVICE r3: the incoming activations are replicated over
+    ``model`` under TP, and routing identical copies on every model-rank
+    would multiply expert FLOPs and all-to-all payload by m): each
+    model-rank takes a contiguous 1/m block of the local token set,
+    routes it with capacity/m, and one tiled all-gather over ``model``
+    reassembles the combined outputs at the end — per-device expert
+    compute is E·C/m slots, the true EP share. Requires
+    ``n_local % m == 0`` (any power-of-two batch·seq); otherwise the
+    rank-replicated behavior is kept (correct, m× redundant — decode-
+    time single-token steps, where FLOPs are negligible anyway).
+
     Capacity semantics differ from the single-program path by design:
-    capacity is per (source device, expert) — each device may keep up to
-    ``capacity_factor·k·n_local/E`` tokens per expert, so the drop
-    pattern is per-source quota rather than a global queue (the standard
-    multi-device MoE behavior; identical when nothing overflows). The
-    aux loss is exact: per-expert fractions/probs are pmean'd over the
-    token axes BEFORE the product, which equals the global-batch Switch
-    aux when shards hold equal token counts (they do: static shapes).
+    capacity is per (source rank, expert) — each (device, model-rank)
+    may keep up to ``capacity_factor·k·n_local/(m·E)`` tokens per
+    expert, so the drop pattern is per-source quota rather than a
+    global queue (the standard multi-device MoE behavior; identical
+    when nothing overflows). The aux loss is exact: per-expert
+    fractions/probs are pmean'd over the token axes (including the
+    ``model`` split) BEFORE the product, which equals the global-batch
+    Switch aux when shards hold equal token counts (they do: static
+    shapes).
 
     Requires E % mesh.model == 0; gradients flow through the
-    all-to-alls (they transpose to themselves reversed).
+    all-to-alls (they transpose to themselves reversed) and the
+    all-gather (transposes to a psum-scatter).
     """
-    import math
-
-    from tensorflow_examples_tpu.core.mesh import AxisNames
+    from tensorflow_examples_tpu.core.mesh import (
+        AxisNames,
+        token_partition_axes,
+    )
 
     e = gate_w.shape[-1]
     m = mesh.shape[AxisNames.MODEL] if mesh is not None else 1
@@ -220,41 +236,49 @@ def moe_ffn_ep(
             rng=rng, jitter=jitter,
         )
     top_k = min(top_k, e)
-    # Token sharding mirrors decode_spec's fallback: an axis whose size
-    # doesn't divide the corresponding dim (decode-time batch=1, or a
-    # single-token step under context parallelism) is dropped — tokens
-    # replicate over it, routing stays correct, only the all-to-all over
-    # `model` is essential.
-    batch_axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
-    nb = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
-    if x.shape[0] % nb:
-        batch_axes = ()
-    c = mesh.shape[AxisNames.CONTEXT]
-    ctx = AxisNames.CONTEXT if c > 1 and x.shape[1] % c == 0 else None
-    token_axes = batch_axes + ((ctx,) if ctx else ())
-    x_spec = P(batch_axes if batch_axes else None, ctx, None)
+    # Token sharding via the shared axis-dropping policy
+    # (core/mesh.py token_partition_axes): a non-dividing axis is
+    # dropped — tokens replicate over it, routing stays correct, only
+    # the all-to-all over `model` is essential.
+    batch_axes, seq_axes = token_partition_axes(mesh, x.shape[0], x.shape[1])
+    token_axes = batch_axes + seq_axes
+    x_spec = P(
+        batch_axes if batch_axes else None,
+        seq_axes if seq_axes else None,
+        None,
+    )
     ew_spec = P(AxisNames.MODEL)  # leading [E] dim of every expert leaf
 
     def local(gw, wi, bi, wo, bo, xl, key):
         b_loc, s_loc, d = xl.shape
-        tokens = xl.reshape(-1, d)
-        n_loc = tokens.shape[0]
+        all_tokens = xl.reshape(-1, d)
+        n_all = all_tokens.shape[0]
+        # Static decision: split the (model-replicated) local tokens
+        # over the model axis so each rank routes a UNIQUE 1/m block.
+        split = n_all % m == 0
+        if split:
+            n_loc = n_all // m
+            rank = lax.axis_index(AxisNames.MODEL)
+            tokens = lax.dynamic_slice_in_dim(all_tokens, rank * n_loc, n_loc)
+        else:
+            n_loc, tokens = n_all, all_tokens
+        route_axes = token_axes + ((AxisNames.MODEL,) if split else ())
         capacity = max(1, int(capacity_factor * top_k * n_loc / e))
         if key is not None:
             # Decorrelate router jitter across token shards.
-            for a in token_axes:
+            for a in route_axes:
                 key = jax.random.fold_in(key, lax.axis_index(a))
         gates, flat_slots, keeps, moh0, mpr, kept = _route(
             tokens, gw, top_k=top_k, capacity=capacity, rng=key,
             jitter=jitter,
         )
-        if token_axes:
-            moh0 = lax.pmean(moh0, token_axes)
-            mpr = lax.pmean(mpr, token_axes)
+        if route_axes:
+            moh0 = lax.pmean(moh0, route_axes)
+            mpr = lax.pmean(mpr, route_axes)
         aux = e * jnp.sum(moh0 * mpr)
         drop = 1.0 - kept.astype(jnp.float32) / (n_loc * top_k)
-        if token_axes:
-            drop = lax.pmean(drop, token_axes)
+        if route_axes:
+            drop = lax.pmean(drop, route_axes)
 
         # [E·C, d] → [m, E/m, C, d]: group g's slice belongs to device g.
         xin = _dispatch(tokens, flat_slots, keeps, e, capacity)
@@ -272,8 +296,12 @@ def moe_ffn_ep(
             yloc, AxisNames.MODEL, split_axis=0, concat_axis=0
         )
         out = _combine(yout.reshape(e, capacity, d), flat_slots, keeps,
-                       gates, n_loc)
-        return out.reshape(b_loc, s_loc, d).astype(xl.dtype), aux, drop
+                       gates, n_loc).astype(xl.dtype)
+        if split:
+            # Reassemble the model-split blocks (gather order == the
+            # axis_index order used for the dynamic_slice above).
+            out = lax.all_gather(out, AxisNames.MODEL, tiled=True)
+        return out.reshape(b_loc, s_loc, d), aux, drop
 
     # Pin the expert params' layout so shard_map's in_specs agree with
     # the rules-placed params (no silent resharding inside the step).

@@ -143,10 +143,14 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
                 mesh=mesh,
             )
         else:
-            nll = cross_entropy_per_example(
-                hidden_or_logits.reshape(-1, cfg.vocab_size),
-                labels.reshape(-1),
-                fused=cfg.fused_ce,
+            # Token-sharded on meshes: the Pallas CE call is opaque to
+            # the partitioner (ops/cross_entropy.py docstring).
+            from tensorflow_examples_tpu.ops.cross_entropy import (
+                mesh_cross_entropy_per_example,
+            )
+
+            nll = mesh_cross_entropy_per_example(
+                hidden_or_logits, labels, mesh=mesh, fused=cfg.fused_ce
             )
         moe_aux, moe_drop = jnp.float32(0.0), jnp.float32(0.0)
         if cfg.moe_experts:

@@ -4,6 +4,8 @@ Runs the real kernel code in Pallas interpret mode on CPU; on TPU the
 same code path compiles via Mosaic (exercised by bench.py / examples).
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -266,6 +268,41 @@ class TestFusedCrossEntropy:
         nll = cross_entropy_per_example(logits, labels, fused=True)
         ref = cross_entropy_reference(logits.astype(jnp.float32), labels)
         np.testing.assert_allclose(nll, ref, atol=2e-2, rtol=2e-2)
+
+    @pytest.mark.parametrize(
+        "b,s",
+        [
+            (8, 16),  # everything divides: full batch+seq+model sharding
+            (1, 16),  # batch 1 on a dp mesh: batch axes dropped
+            (8, 7),   # seq indivisible by model/context: seq axes dropped
+            (3, 5),   # nothing divides: degenerates to the plain call
+        ],
+    )
+    def test_mesh_ce_matches_plain_across_divisibility(self, b, s):
+        """mesh_cross_entropy_per_example must reproduce the unsharded
+        NLL for every branch of the shared axis-dropping policy
+        (core/mesh.py token_partition_axes) — including the replicated
+        fallbacks for decode-time batch=1 and odd seq lengths."""
+        from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+        from tensorflow_examples_tpu.ops.cross_entropy import (
+            mesh_cross_entropy_per_example,
+        )
+
+        vocab = 97
+        mesh = create_mesh(MeshConfig(data=2, model=2, context=2))
+        logits = jax.random.normal(jax.random.PRNGKey(8), (b, s, vocab))
+        labels = jax.random.randint(
+            jax.random.PRNGKey(9), (b, s), 0, vocab
+        )
+        want = cross_entropy_reference(
+            logits.reshape(-1, vocab), labels.reshape(-1)
+        ).reshape(b, s)
+        got = jax.jit(
+            functools.partial(mesh_cross_entropy_per_example, mesh=mesh)
+        )(logits, labels)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
 
 
 def test_block_autofit_odd_lengths():

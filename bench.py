@@ -1065,6 +1065,57 @@ def bench_moe() -> dict:
     )
 
 
+# ----------------------------------------------------------- decode grid
+
+
+def bench_decode_grid() -> dict:
+    """Single-token flash-decode step time vs cache max_len at a fixed
+    short context (VERDICT r3 item 4): with the power-of-two KV-grid
+    bucket ladder (ops/decode.py) the step must be ~flat in max_len —
+    the headline value is t(32k)/t(4k), ~1.0 when sequencing is
+    O(context) and ~8 if it were O(max_len). TPU-only: interpret mode
+    would time the Python grid loop, not the chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.ops.decode import flash_decode_attention
+
+    if BACKEND != "tpu":
+        raise RuntimeError(
+            "tpu-only microbench (interpret mode times Python, not the chip)"
+        )
+    b, h, d, ctx = 8, 12, 64, 256
+    iters = 50
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d), jnp.bfloat16)
+    f = jax.jit(flash_decode_attention)
+    per_len = {}
+    for max_len in (4096, 16384, 32768):
+        k = jax.random.normal(
+            jax.random.PRNGKey(1), (b, h, max_len, d), jnp.bfloat16
+        )
+        v = jax.random.normal(
+            jax.random.PRNGKey(2), (b, h, max_len, d), jnp.bfloat16
+        )
+        ln = jnp.asarray(ctx)
+        f(q, k, v, ln).block_until_ready()
+        ts = []
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = f(q, k, v, ln)
+            out.block_until_ready()
+            ts.append((time.perf_counter() - t0) / iters * 1e6)
+        per_len[max_len] = statistics.median(ts)
+    ratios = [per_len[32768] / per_len[4096]]
+    return _result(
+        "decode_grid_step_time_ratio",
+        ratios,
+        "x (32k cache / 4k cache, ctx 256)",
+        context_len=ctx,
+        us_per_step={str(k_): round(v_, 1) for k_, v_ in per_len.items()},
+    )
+
+
 # -------------------------------------------------------------- selftest
 
 
@@ -1135,6 +1186,7 @@ BENCHES = {
     "mnist": bench_mnist,
     "collectives": bench_collectives,
     "moe": bench_moe,
+    "decode_grid": bench_decode_grid,
 }
 
 # Headline-first order for --bench=all.
@@ -1151,6 +1203,7 @@ ALL_ORDER = [
     "mnist",
     "collectives",
     "moe",
+    "decode_grid",
 ]
 
 
@@ -1163,13 +1216,13 @@ _EST_SECONDS = {
         "resnet50": 120, "resnet50_input": 200, "gpt2": 75, "gpt2_long": 90,
         "gpt2_long16k": 120, "gpt2_decode": 60, "gpt2_decode_long": 60,
         "bert": 50, "cifar10": 70, "mnist": 45, "collectives": 60,
-        "moe": 180,
+        "moe": 180, "decode_grid": 1,
     },
     "tpu": {
         "resnet50": 90, "resnet50_input": 150, "gpt2": 75, "gpt2_long": 75,
         "gpt2_long16k": 90, "gpt2_decode": 75, "gpt2_decode_long": 75,
         "bert": 60, "cifar10": 60, "mnist": 60, "collectives": 45,
-        "moe": 180,
+        "moe": 180, "decode_grid": 90,
     },
 }
 

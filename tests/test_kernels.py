@@ -209,6 +209,32 @@ class TestFlashDecode:
         ref = decode_attention_reference(q, k, v, 400)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_bucket_ladder_boundaries(self):
+        """The power-of-two KV-grid ladder (O(context) sequencing): the
+        traced length must pick a sufficient bucket and stay exact at
+        and around every bucket boundary, jit'd once for all lengths."""
+        q = jax.random.normal(jax.random.PRNGKey(15), (1, 2, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(16), (1, 2, 1024, 64))
+        v = jax.random.normal(jax.random.PRNGKey(17), (1, 2, 1024, 64))
+        f = jax.jit(
+            functools.partial(flash_decode_attention, block_kv=64)
+        )
+        for n in (1, 64, 65, 128, 129, 512, 513, 1000, 1024):
+            np.testing.assert_allclose(
+                f(q, k, v, jnp.asarray(n)),
+                decode_attention_reference(q, k, v, n),
+                atol=2e-5, rtol=2e-5, err_msg=f"length={n}",
+            )
+
+    def test_static_length_single_bucket(self):
+        """A Python-int length compiles exactly one bucket, no switch."""
+        q = jax.random.normal(jax.random.PRNGKey(18), (1, 2, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(19), (1, 2, 1024, 64))
+        v = jax.random.normal(jax.random.PRNGKey(20), (1, 2, 1024, 64))
+        out = flash_decode_attention(q, k, v, 100, block_kv=64)
+        ref = decode_attention_reference(q, k, v, 100)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
     def test_bf16_cache(self):
         q = jax.random.normal(jax.random.PRNGKey(12), (1, 2, 1, 64), jnp.bfloat16)
         k = jax.random.normal(jax.random.PRNGKey(13), (1, 2, 128, 64), jnp.bfloat16)

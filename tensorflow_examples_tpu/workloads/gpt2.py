@@ -44,6 +44,11 @@ class Gpt2Config(TrainConfig):
     # idle) or "gpipe" (transpose-scheduled backward).
     num_microbatches: int = 4
     pipeline_schedule: str = "1f1b"
+    # Virtual stages (chunks) per pipe device for INTERLEAVED 1F1B:
+    # v > 1 cuts the pipeline ramp ~v-fold in full-stage units at the
+    # cost of v x the ticks/hops (parallel/pipeline.py). Blocks are
+    # then STORED slot-major (interleave_perm); 1f1b only.
+    pipe_interleave: int = 1
     # Mixture-of-Experts: swap every `moe_every`-th block's MLP for a
     # top-1 Switch MoE with this many experts (expert-parallel over the
     # `model` mesh axis). 0 = dense GPT-2.
@@ -232,19 +237,61 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
     )
 
     n_stages = mesh.shape[AxisNames.PIPE]
-    if cfg.num_layers % n_stages:
+    v = max(1, cfg.pipe_interleave)
+    s_total = n_stages * v
+    if cfg.num_layers % s_total:
         raise ValueError(
-            f"num_layers {cfg.num_layers} not divisible by pipe={n_stages}"
+            f"num_layers {cfg.num_layers} not divisible by "
+            f"pipe={n_stages} x interleave={v}"
         )
     if cfg.pipeline_schedule not in ("1f1b", "gpipe"):
         raise ValueError(f"unknown pipeline_schedule={cfg.pipeline_schedule}")
+    if v > 1 and cfg.pipeline_schedule != "1f1b":
+        raise ValueError("pipe_interleave > 1 requires the 1f1b schedule")
     mcfg = model_config(cfg)
     embed_head = transformer.EmbedHead(mcfg)
-    per_stage = cfg.num_layers // n_stages
+    per_stage = cfg.num_layers // s_total
+
+    # With interleaving, blocks are STORED slot-major: slot i = d·v + j
+    # holds the layers of virtual stage j·P + d (interleave_perm), so
+    # the dim-0 `pipe` sharding rule places each device's v chunks
+    # contiguously with zero train-time movement. Layer-row permutation
+    # maps storage <-> logical order (eval/GPipe needs logical).
+    from tensorflow_examples_tpu.parallel.pipeline import interleave_perm
+
+    if v > 1:
+        import numpy as np
+
+        _slot_of_stage = interleave_perm(n_stages, v)
+        _row_perm = np.concatenate(
+            [
+                np.arange(s * per_stage, (s + 1) * per_stage)
+                for s in _slot_of_stage
+            ]
+        )
+        _row_unperm = np.argsort(_row_perm)
+
+    # The blocks collection's KEY encodes the storage layout when it is
+    # slot-major: a checkpoint written under one (pipe, interleave) and
+    # restored into a task with another would otherwise silently load
+    # permuted layers (shapes all match) — the key mismatch turns that
+    # into a loud orbax tree-structure error instead.
+    blocks_key = "blocks" if v == 1 else f"blocks_slotmajor_p{n_stages}v{v}"
+
+    def to_slot_order(blocks):
+        if v == 1:
+            return blocks
+        return jax.tree.map(lambda p: p[_row_perm], blocks)
+
+    def to_logical_order(blocks):
+        if v == 1:
+            return blocks
+        return jax.tree.map(lambda p: p[_row_unperm], blocks)
 
     def split_stages(blocks):
+        """Storage [L, ...] (slot-major when v>1) → [P·v, L/(P·v), ...]."""
         return jax.tree.map(
-            lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]), blocks
+            lambda p: p.reshape((s_total, per_stage) + p.shape[1:]), blocks
         )
 
     def head_loss_fn(hp, y, lbl):
@@ -265,12 +312,14 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
         head_loss_fn,
         mesh=mesh,
         num_microbatches=cfg.num_microbatches,
+        num_virtual_stages=v,
     )
     run_1f1b_plain = make_pipeline_1f1b(
         lambda sp, h: transformer.apply_stacked_blocks(mcfg, sp, h),
         head_loss_fn,
         mesh=mesh,
         num_microbatches=cfg.num_microbatches,
+        num_virtual_stages=v,
     )
 
     def init_fn(rng):
@@ -279,16 +328,16 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
 
             _, full = import_gpt2(cfg.pretrained, mcfg)
             full = jax.tree.map(jnp.asarray, full)
-            return {
-                "params": transformer.stack_params_for_pipeline(
-                    full, cfg.num_layers
-                )
-            }
+            stacked = transformer.stack_params_for_pipeline(
+                full, cfg.num_layers
+            )
+            blocks = to_slot_order(stacked.pop("blocks"))
+            return {"params": {**stacked, blocks_key: blocks}}
         r1, r2 = jax.random.split(rng)
         dummy = jnp.zeros((1, cfg.seq_len), jnp.int32)
         embed = embed_head.init({"params": r1}, dummy)["params"]
-        blocks = transformer.init_stacked_blocks(mcfg, r2)
-        return {"params": {"embed": embed, "blocks": blocks}}
+        blocks = to_slot_order(transformer.init_stacked_blocks(mcfg, r2))
+        return {"params": {"embed": embed, blocks_key: blocks}}
 
     def logits_fn(params, tokens, *, rng=None, train=False):
         dropout = train and cfg.dropout > 0 and rng is not None
@@ -302,7 +351,15 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
             method="encode",
             rngs={"dropout": r_embed} if dropout else None,
         )
-        stage_params = split_stages(params["blocks"])
+        # Eval/GPipe runs the classic [P, L/P] logical stacking; with
+        # interleaved storage this un-permutes layer rows (a gather
+        # across pipe — eval-only cost, the train path never moves).
+        stage_params = jax.tree.map(
+            lambda p: p.reshape(
+                (n_stages, cfg.num_layers // n_stages) + p.shape[1:]
+            ),
+            to_logical_order(params[blocks_key]),
+        )
         stage_fn = (
             (
                 lambda sp, h, key: transformer.apply_stacked_blocks(
@@ -356,7 +413,7 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
             )
             run = run_1f1b_drop if dropout else run_1f1b_plain
             loss = run(
-                split_stages(params["blocks"]),
+                split_stages(params[blocks_key]),
                 params["embed"],
                 x,
                 labels,
@@ -390,10 +447,10 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
 
     rules = ShardingRules(
         [
-            (r"^blocks/" + pat.pattern, _stage_spec(spec))
+            ("^" + blocks_key + "/" + pat.pattern, _stage_spec(spec))
             for pat, spec in transformer.GPT2_RULES.rules
         ]
-        + [(r"^blocks/", P(_Pp))]
+        + [("^" + blocks_key + "/", P(_Pp))]
     )
     return Task(
         name="gpt2_124m_pp",

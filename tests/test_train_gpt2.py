@@ -266,6 +266,110 @@ def test_pp_1f1b_matches_gpipe_loss_and_grads():
         )
 
 
+def test_1f1b_schedule_tables():
+    """Schedule simulator invariants (asserted inside) plus the shape
+    of the result: v=1 reproduces the round-3 tick count exactly, and
+    interleaving shrinks the bubble in full-stage units (each v-chunk
+    tick costs 1/v of a full-stage tick)."""
+    from tensorflow_examples_tpu.parallel.pipeline import _schedule_1f1b
+
+    op, mb, ch, t1, depth1, qf, qb = _schedule_1f1b(8, 4, 1)
+    assert t1 == 22 and depth1 == 4 and qf == 2 and qb == 2  # 2m+2(P-1)
+    assert (ch == 0).all()
+    bubbles = {}
+    for v in (1, 2, 4):
+        *_, t, depth, _, _ = _schedule_1f1b(8, 4, v)
+        bubbles[v] = (t - 2 * 8 * v) / v  # full-stage units
+        assert depth <= min(8, 2 * 4)
+    assert bubbles[2] < bubbles[1] and bubbles[4] < bubbles[2], bubbles
+
+
+def test_pp_interleaved_matches_plain_1f1b():
+    """Interleaved 1F1B (v=2, slot-major storage) must produce the same
+    loss and gradients as plain 1F1B on the same logical params — the
+    chunked schedule changes the execution order and placement, not the
+    math. Blocks gradients are compared through the layer-row
+    permutation that maps slot-major storage back to logical order."""
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.parallel.pipeline import interleave_perm
+
+    p_dev, v = 2, 2
+    mesh = create_mesh(MeshConfig(data=4, pipe=p_dev))
+    cfg1 = tiny_config(num_layers=4, num_microbatches=4)
+    cfg2 = tiny_config(num_layers=4, num_microbatches=4, pipe_interleave=v)
+    t1 = gpt2.make_task(cfg1, mesh=mesh)
+    t2 = gpt2.make_task(cfg2, mesh=mesh)
+    params1 = t1.init_fn(jax.random.PRNGKey(0))["params"]
+    per = cfg1.num_layers // (p_dev * v)
+    row_perm = np.concatenate(
+        [
+            np.arange(s * per, (s + 1) * per)
+            for s in interleave_perm(p_dev, v)
+        ]
+    )
+    # Slot-major storage lives under a layout-stamped key (checkpoint
+    # cross-(P, v) restore guard).
+    slot_key = f"blocks_slotmajor_p{p_dev}v{v}"
+    params2 = {
+        "embed": params1["embed"],
+        slot_key: jax.tree.map(lambda x: x[row_perm], params1["blocks"]),
+    }
+    rng = jax.random.PRNGKey(7)
+    tokens = np.random.default_rng(3).integers(
+        0, cfg1.vocab_size, (cfg1.global_batch_size, cfg1.seq_len + 1)
+    )
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32)}
+
+    def value_grad(task, params):
+        def f(p):
+            loss, _, _ = task.loss_fn(p, {}, batch, rng=rng, train=True)
+            return loss
+
+        return jax.jit(jax.value_and_grad(f))(params)
+
+    with mesh:
+        loss1, g1 = value_grad(t1, params1)
+        loss2, g2 = value_grad(t2, params2)
+        # Eval path (GPipe over un-permuted storage) must agree too.
+        # (jit'd: partial-manual shard_map is a jit-context construct,
+        # same as the Trainer's eval step.)
+        ev1 = jax.jit(lambda p: t1.eval_fn(p, {}, batch))(params1)
+        ev2 = jax.jit(lambda p: t2.eval_fn(p, {}, batch))(params2)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(ev1["nll"]), float(ev2["nll"]), rtol=1e-5
+    )
+    g2_logical = jax.tree.map(
+        lambda x: x[np.argsort(row_perm)], g2[slot_key]
+    )
+    for a, b in zip(
+        jax.tree.leaves(g1["blocks"]), jax.tree.leaves(g2_logical)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-4
+        )
+    for a, b in zip(
+        jax.tree.leaves(g1["embed"]), jax.tree.leaves(g2["embed"])
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-4
+        )
+
+
+def test_pp_interleaved_trains():
+    """End-to-end interleaved-1F1B training (with dropout rng folding
+    per virtual stage) through the shared loop still learns."""
+    mesh = create_mesh(MeshConfig(data=4, pipe=2))
+    cfg = tiny_config(
+        num_layers=4, dropout=0.1, train_steps=25, num_microbatches=4,
+        pipe_interleave=2,
+    )
+    first, last, _ = run_tiny(cfg, mesh)
+    assert np.isfinite(first) and np.isfinite(last)
+    assert last < first - 0.05, f"no learning: {first} -> {last}"
+
+
 def test_pp_composes_with_tp():
     """PP×TP (the partial-manual shard_map composition): the identical
     pipeline param tree must produce the same loss and gradients on a

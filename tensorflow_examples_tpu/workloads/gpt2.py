@@ -232,12 +232,15 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
     from tensorflow_examples_tpu.core.mesh import AxisNames
     from tensorflow_examples_tpu.core.sharding import ShardingRules
     from tensorflow_examples_tpu.parallel.pipeline import (
+        interleave_perm,
         make_pipeline_1f1b,
         pipeline_apply,
     )
 
     n_stages = mesh.shape[AxisNames.PIPE]
-    v = max(1, cfg.pipe_interleave)
+    v = cfg.pipe_interleave
+    if v < 1:
+        raise ValueError(f"pipe_interleave must be >= 1, got {v}")
     s_total = n_stages * v
     if cfg.num_layers % s_total:
         raise ValueError(
@@ -257,8 +260,6 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
     # the dim-0 `pipe` sharding rule places each device's v chunks
     # contiguously with zero train-time movement. Layer-row permutation
     # maps storage <-> logical order (eval/GPipe needs logical).
-    from tensorflow_examples_tpu.parallel.pipeline import interleave_perm
-
     if v > 1:
         import numpy as np
 

@@ -161,19 +161,36 @@ def _parse_and_decode(tf, record, *, train: bool, image_size: int, aug_seed=None
 
 def _count_records(tf, files: list, data_dir: str, tag: str) -> int:
     """Total record count across ``files`` — one IO-only pass (no JPEG
-    decode), cached in a sidecar next to the shards keyed by the shard
-    list + sizes, so it runs once per dataset, not once per resume.
-    Read-only data dirs just skip the cache write."""
+    decode), cached keyed by the shard list + sizes, so it runs once
+    per dataset, not once per resume.
+
+    The cache lives in a HOST-LOCAL dir (``$TFE_TPU_CACHE_DIR``,
+    default ``~/.cache/tensorflow_examples_tpu``), never next to the
+    shards: data dirs are often shared read-mostly buckets, and a cold
+    multi-host start would have every host racing writes into them
+    (ADVICE r3). Each host counts only its own shard subset, so the
+    cold-start counting pass itself is per-host by construction; the
+    cache just keeps it off the resume path."""
     import hashlib
     import json
 
+    # data_dir participates in the key: the cache is global per host,
+    # and two datasets with the standard shard naming and equal sizes
+    # but different contents must not share a count.
     sig = hashlib.sha1(
         "|".join(
-            f"{os.path.basename(f)}:{tf.io.gfile.stat(f).length}"
-            for f in files
+            [os.path.abspath(data_dir)]
+            + [
+                f"{os.path.basename(f)}:{tf.io.gfile.stat(f).length}"
+                for f in files
+            ]
         ).encode()
     ).hexdigest()[:16]
-    cache = os.path.join(data_dir, f".record_count-{tag}-{sig}.json")
+    cache_dir = os.environ.get(
+        "TFE_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tensorflow_examples_tpu"),
+    )
+    cache = os.path.join(cache_dir, f"record_count-{tag}-{sig}.json")
     try:
         with tf.io.gfile.GFile(cache, "r") as fh:
             return int(json.load(fh)["count"])
@@ -188,6 +205,7 @@ def _count_records(tf, files: list, data_dir: str, tag: str) -> int:
         .numpy()
     )
     try:
+        os.makedirs(cache_dir, exist_ok=True)
         with tf.io.gfile.GFile(cache, "w") as fh:
             json.dump({"count": n}, fh)
     except Exception:

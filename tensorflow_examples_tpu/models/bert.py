@@ -77,6 +77,7 @@ BERT_RULES = ShardingRules(
 class BertLayer(nn.Module):
     cfg: BertConfig
     train: bool
+    mesh: Mesh | None = None
 
     @nn.compact
     def __call__(self, x, bias):
@@ -88,10 +89,18 @@ class BertLayer(nn.Module):
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
         if cfg.attention == "flash":
             # bias arrives as the raw [B, S] key mask bias on this path.
+            # Routed through the mesh-aware wrapper: on a TP mesh the
+            # heads stay sharded over `model` around the (otherwise
+            # partitioner-opaque) Pallas call (ADVICE r3).
+            from tensorflow_examples_tpu.parallel.attention import (
+                mesh_attention,
+            )
+
             swap = lambda t: t.transpose(0, 2, 1, 3)
             ctx = swap(
-                flash_attention(
-                    swap(q), swap(k), swap(v), causal=False, key_bias=bias
+                mesh_attention(
+                    swap(q), swap(k), swap(v), mesh=self.mesh,
+                    causal=False, key_bias=bias,
                 )
             )
         else:
@@ -157,7 +166,7 @@ class BertEncoder(nn.Module):
             bias = bias[:, None, None, :]
 
         for i in range(cfg.num_layers):
-            x = BertLayer(cfg, train, name=f"layer_{i}")(x, bias)
+            x = BertLayer(cfg, train, self.mesh, name=f"layer_{i}")(x, bias)
 
         pooled = nn.tanh(
             nn.Dense(cfg.d_model, dtype=x.dtype, name="pooler")(x[:, 0])

@@ -25,9 +25,13 @@ designed TPU-first:
   minimum, ~half the FLOPs AND wall time of the naive ring. The
   permutation happens *inside* the shard_map with half-shard ppermutes
   (`_to_zigzag`/`_from_zigzag`), so callers still see contiguous
-  sharding in and out. Causal calls default to it; the contiguous path
-  remains for odd shard sizes and skips fully-masked hops with
-  ``lax.cond`` (no FLOPs burned, though lockstep means no wall gain).
+  sharding in and out. Causal calls default to it, and the jit-level
+  wrapper (parallel/attention.py mesh_attention) pads the global
+  sequence so causal shards are ALWAYS even — the balanced path is the
+  only causal path in practice. The contiguous variant remains for
+  explicit ``zigzag=False`` and non-causal calls; its causal form
+  skips fully-masked hops with ``lax.cond`` (no FLOPs burned, though
+  lockstep means no wall gain).
 - ``ulysses_attention``: the all-to-all alternative — reshard from
   sequence-sharded to head-sharded with ``all_to_all``, run the local
   flash kernel on full sequences for H/c heads, reshard back. Two

@@ -376,3 +376,33 @@ def test_mesh_attention_no_mesh():
     ref = attention_reference(q, k, v, causal=True)
     out = mesh_attention(q, k, v, mesh=None, causal=True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+@pytest.mark.parametrize("s", [20, 18])
+def test_mesh_attention_pads_causal_to_zigzag(ctx_mesh, s):
+    """VERDICT r3 item 7 (odd-shard corner closed at the wrapper):
+    causal context-parallel shapes that previously took the unbalanced
+    contiguous ring (s=20 over c=4 → odd shard 5) or could not shard at
+    all (s=18, 18 % 4 != 0) are padded globally to the next multiple of
+    2c. Tail pads are causally invisible to every real query, so
+    outputs AND gradients must match the unpadded reference exactly."""
+    q, k, v = qkv(s=s, seed=11)
+    ref = attention_reference(q, k, v, causal=True)
+    f = jax.jit(
+        functools.partial(mesh_attention, mesh=ctx_mesh, causal=True)
+    )
+    out = f(q, k, v)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(
+        functools.partial(loss, attention_reference), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_out = jax.jit(
+        jax.grad(functools.partial(loss, f), argnums=(0, 1, 2))
+    )(q, k, v)
+    for r, o in zip(g_ref, g_out):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(o), atol=5e-4)

@@ -120,6 +120,29 @@ def test_flash_decode_compiled_parity():
         assert _max_abs(out, ref) < 2e-2, (q_len, length)
 
 
+def test_flash_decode_ladder_compiled_parity():
+    """The power-of-two KV-grid bucket ladder (round 4) compiled on
+    chip: one jit serves every context length through a 32k-slot cache,
+    exact at and around bucket boundaries. Short contexts must also be
+    FAST — the grid flatness itself is measured by bench.py
+    --bench=decode_grid; this asserts the numerics."""
+    from tensorflow_examples_tpu.ops.decode import (
+        decode_attention_reference,
+        flash_decode_attention,
+    )
+
+    k = jax.random.normal(jax.random.PRNGKey(4), (1, 8, 32768, 64), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(5), (1, 8, 32768, 64), jnp.bfloat16)
+    q = jax.random.normal(jax.random.PRNGKey(6), (1, 8, 1, 64), jnp.bfloat16)
+    f = jax.jit(lambda q_, k_, v_, n: flash_decode_attention(
+        q_, k_, v_, n, interpret=False
+    ))
+    for length in (200, 256, 257, 4096, 4097, 32768):
+        out = f(q, k, v, jnp.asarray(length))
+        ref = decode_attention_reference(q, k, v, length)
+        assert _max_abs(out, ref) < 2e-2, length
+
+
 def test_fused_ce_compiled_parity():
     # GPT-2 LM-head shape: one step's tokens against the full 50257 vocab.
     n, v = 2048, 50257

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Turn a bench.py sweep JSON into ready-to-paste floor stamps.
+
+Usage: python tools/stamp_floors.py /path/to/sweep.json
+
+Prints, for the record's backend:
+- the ``FLOORS[backend]`` entries as Python source — (median, the
+  sweep's pre-fingerprint) pairs per metric;
+- the ``REL_MFU_FLOORS[backend]`` entries;
+- a BASELINE.md markdown table row per metric (median, window spread,
+  rel_mfu) so the stamp and its evidence land together.
+
+The floors POLICY (bench.py module docstring) requires floors to move
+only with their fingerprints, from a measurement under the protocol,
+recorded in BASELINE.md — this tool makes the mechanical part of that
+a copy-paste so the first live-TPU sweep can be stamped in minutes.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    with open(sys.argv[1]) as f:
+        d = json.load(f)
+    backend = d.get("backend", "?")
+    fp = d.get("fingerprint_tflops_pre", d.get("fingerprint_tflops", 0.0))
+    fp_post = d.get("fingerprint_tflops_post")
+    everything = [d] + d.get("extras", [])
+    results = [r for r in everything if "error" not in r and "metric" in r]
+    errored = [
+        r.get("bench", r.get("metric"))
+        for r in everything
+        if "error" in r and r.get("metric") != "selftest"
+    ]
+
+    print(f"# backend={backend}  fingerprint pre={fp} post={fp_post}")
+    if d.get("truncated"):
+        print(f"# TRUNCATED (not stamped): {d['truncated']}")
+    if errored:
+        # An unstamped metric keeps its OLD (value, fingerprint) floor
+        # while the compiled program may have changed — the exact
+        # violation the floors policy forbids. Make it loud.
+        print(
+            f"# ERRORED (NOT STAMPED — their old floors are now stale, "
+            f"fix or remove them): {errored}"
+        )
+    print(f'\n# --- FLOORS["{backend}"] entries ---')
+    for r in results:
+        print(f'        "{r["metric"]}": ({r["value"]}, {fp}),')
+    print(f'\n# --- REL_MFU_FLOORS["{backend}"] entries ---')
+    for r in results:
+        if "rel_mfu" in r:
+            print(f'        "{r["metric"]}": {r["rel_mfu"]},')
+    print("\n# --- BASELINE.md table ---")
+    print("| Metric | Median | Windows | rel_mfu |")
+    print("|---|---|---|---|")
+    for r in results:
+        win = " / ".join(str(w) for w in r.get("window_values", []))
+        print(
+            f"| {r['metric']} | {r['value']} {r.get('unit', '')} | {win} "
+            f"| {r.get('rel_mfu', '—')} |"
+        )
+    st = d.get("selftest")
+    if st is not None:
+        print(f"\n# selftest: ok={st.get('ok')} — {st.get('summary')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

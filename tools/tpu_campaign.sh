@@ -1,0 +1,41 @@
+#!/bin/bash
+# The one-command TPU campaign (VERDICT r3 item 1): run the moment a
+# live tunnel is confirmed. Produces, under /tmp/tpu_campaign_<ts>/:
+#   selftest.json  — tests_tpu compiled-kernel parity (incl. the decode
+#                    bucket ladder), via bench.py --bench=selftest
+#   sweep.json     — full protocol sweep, unbudgeted (every metric,
+#                    3 windows, pre/post fingerprints, rel_mfu)
+#   stamp.txt      — ready-to-paste FLOORS / REL_MFU_FLOORS /
+#                    BASELINE.md table from tools/stamp_floors.py
+# Then: paste the stamps into bench.py + BASELINE.md (floors policy:
+# value+fingerprint+rel_mfu move together), resolve any sub-1.0
+# vs_baseline against the round-2 floors by reading rel_mfu, commit.
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%d_%H%M%S)
+out="/tmp/tpu_campaign_$ts"
+mkdir -p "$out"
+echo "campaign -> $out"
+
+rm -f /tmp/bench_backend_probe.json  # force a fresh probe verdict
+
+echo "[1/3] compiled-kernel selftest (tests_tpu)"
+timeout 2400 python bench.py --bench=selftest --budget=2300 \
+  > "$out/selftest.json" 2> "$out/selftest.err"
+python -c "
+import json; d = json.load(open('$out/selftest.json'))
+st = d.get('selftest', {})
+print('  backend:', d.get('backend'), '| ok:', st.get('ok'), '|', st.get('summary', '')[:120])
+exit(0 if d.get('backend') == 'tpu' else 3)
+" || { echo 'NOT ON TPU — aborting campaign'; exit 3; }
+
+echo "[2/3] full protocol sweep"
+# Budget (not --budget=0): keeps bench.py's own watchdog armed so a
+# bench wedging in native code still yields a partial record with an
+# honest truncated list; the outer timeout's SIGTERM would not.
+timeout 5400 python bench.py --budget=5300 --no-selftest \
+  > "$out/sweep.json" 2> "$out/sweep.err"
+
+echo "[3/3] floor stamps"
+python tools/stamp_floors.py "$out/sweep.json" | tee "$out/stamp.txt" | head -40
+echo "done: $out (paste stamp.txt into bench.py + BASELINE.md, then rerun 'timeout 600 python bench.py' to confirm vs_baseline ~1.0)"

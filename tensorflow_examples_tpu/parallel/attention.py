@@ -75,6 +75,26 @@ def mesh_decode_attention(
     )(q, k_cache, v_cache, length)
 
 
+def _stage_tp_axis(heads: int):
+    """Detect the PP×TP stage situation: we are INSIDE a manual
+    (shard_map) region — a pipeline stage — whose ``model`` axis is
+    still AUTO and nontrivial, and the head count divides it. Returns
+    the axis name to nest a model-only shard_map over, else None.
+
+    Without this, a flash call inside a pipe-manual stage is opaque to
+    the partitioner, which all-gathers the model-sharded heads around
+    the Pallas kernel (the round-3 reason PP×TP stages had to use
+    ``attention="xla"``)."""
+    am = jax.sharding.get_abstract_mesh()
+    manual = getattr(am, "manual_axes", ()) if am is not None else ()
+    if not manual or AxisNames.MODEL in manual:
+        return None
+    m = dict(am.shape).get(AxisNames.MODEL, 1)
+    if m > 1 and heads % m == 0:
+        return AxisNames.MODEL
+    return None
+
+
 def mesh_attention(
     q: jax.Array,
     k: jax.Array,
@@ -111,6 +131,29 @@ def mesh_attention(
             q, k, v, causal=causal, sm_scale=sm_scale, use_flash=False
         )
     if mesh is None or all(mesh.shape[a] == 1 for a in AxisNames.ALL):
+        tp = _stage_tp_axis(q.shape[1])
+        if tp is not None:
+            # PP×TP stage: nest a model-only shard_map (the context
+            # mesh already has `pipe` manual) so heads stay sharded
+            # around the Pallas call. Proven exact fwd+bwd. No stage
+            # caller passes key_bias today (only BERT does, and BERT
+            # has no pipeline path) — keep that explicit rather than
+            # shipping an unexercised bias-cotangent path.
+            if key_bias is not None:
+                raise NotImplementedError(
+                    "key_bias inside a pipeline stage is unexercised; "
+                    "add a test with the bias grad psum before enabling"
+                )
+            spec = P(None, tp, None, None)
+            return jax.shard_map(
+                lambda ql, kl, vl: flash_attention(
+                    ql, kl, vl, causal=causal, sm_scale=sm_scale
+                ),
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                axis_names={tp},
+                check_vma=False,
+            )(q, k, v)
         if key_bias is not None:
             return flash_attention(
                 q, k, v, causal=causal, sm_scale=sm_scale, key_bias=key_bias

@@ -220,11 +220,12 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
     with mesh_model > 1 the rules below put the Megatron layout on each
     stage's stacked weights (heads/ff over ``model``) and the automatic
     partitioner inserts the TP collectives inside every stage tick,
-    exactly as in the non-pipelined model. Caveat: attention inside a
-    stage is the plain (meshless) kernel — with TP the partitioner
-    gathers heads around the opaque Pallas call, so ``attention="xla"``
-    partitions best inside PP×TP stages. sp/context stays outside PP.
-    Decode/generate use the non-pipelined model.
+    exactly as in the non-pipelined model. ``attention="flash"``
+    composes too (round 4): mesh_attention detects the pipe-manual
+    region with an auto ``model`` axis and nests a model-only shard_map
+    around the Pallas kernel, so heads stay sharded
+    (parallel/attention.py _stage_tp_axis). sp/context stays outside
+    PP. Decode/generate use the non-pipelined model.
     """
     import jax
     from jax.sharding import PartitionSpec as P

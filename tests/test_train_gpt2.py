@@ -370,15 +370,19 @@ def test_pp_interleaved_trains():
     assert last < first - 0.05, f"no learning: {first} -> {last}"
 
 
-def test_pp_composes_with_tp():
+@pytest.mark.parametrize("attention", ["xla", "flash"])
+def test_pp_composes_with_tp(attention):
     """PP×TP (the partial-manual shard_map composition): the identical
     pipeline param tree must produce the same loss and gradients on a
     dp×pipe mesh and a dp×model×pipe mesh — TP inside the stages changes
     the partitioning, not the math. Also asserts the stacked weights
-    actually shard over `model` (it must be real TP, not replication)."""
+    actually shard over `model` (it must be real TP, not replication).
+    attention="flash" exercises the round-4 nested model-axis shard_map
+    inside the pipe-manual stages (the Pallas call no longer forces
+    head gathers)."""
     import jax.numpy as jnp
 
-    cfg = tiny_config(num_layers=4, num_microbatches=4)
+    cfg = tiny_config(num_layers=4, num_microbatches=4, attention=attention)
     mesh_pp = create_mesh(MeshConfig(data=4, pipe=2))
     mesh_pptp = create_mesh(MeshConfig(data=2, model=2, pipe=2))
     t_pp = gpt2.make_task(cfg, mesh=mesh_pp)

@@ -19,7 +19,10 @@ import jax.numpy as jnp
 
 from tensorflow_examples_tpu.data.sources import load_lm_tokens
 from tensorflow_examples_tpu.models import transformer
-from tensorflow_examples_tpu.ops.cross_entropy import cross_entropy_per_example
+from tensorflow_examples_tpu.ops.cross_entropy import (
+    cross_entropy_per_example,
+    mesh_cross_entropy_per_example,
+)
 from tensorflow_examples_tpu.ops.losses import weighted_mean
 from tensorflow_examples_tpu.train import Task, TrainConfig
 from tensorflow_examples_tpu.train import optimizers
@@ -150,10 +153,6 @@ def make_task(cfg: Gpt2Config, mesh=None) -> Task:
         else:
             # Token-sharded on meshes: the Pallas CE call is opaque to
             # the partitioner (ops/cross_entropy.py docstring).
-            from tensorflow_examples_tpu.ops.cross_entropy import (
-                mesh_cross_entropy_per_example,
-            )
-
             nll = mesh_cross_entropy_per_example(
                 hidden_or_logits, labels, mesh=mesh, fused=cfg.fused_ce
             )
@@ -387,12 +386,14 @@ def _make_pipeline_task(cfg: Gpt2Config, mesh) -> Task:
         inputs = batch["tokens"][:, :-1]
         labels = batch["tokens"][:, 1:]
         logits = logits_fn(params, inputs, rng=rng, train=train)
-        nll = cross_entropy_per_example(
-            logits.reshape(-1, cfg.vocab_size),
-            labels.reshape(-1),
-            fused=cfg.fused_ce,
+        # Token-sharded (mesh wrapper): the Pallas CE called directly on
+        # data-sharded logits hits the partitioner's gather fallback —
+        # same fix as the non-PP task (ops/cross_entropy.py docstring).
+        # head_loss_fn (inside the pipe-manual 1F1B region) is measured
+        # clean and stays direct.
+        return mesh_cross_entropy_per_example(
+            logits, labels, mesh=mesh, fused=cfg.fused_ce
         )
-        return nll.reshape(labels.shape)
 
     def loss_fn(params, model_state, batch, *, rng, train):
         if train and cfg.pipeline_schedule == "1f1b":

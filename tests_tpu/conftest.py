@@ -66,6 +66,41 @@ def _probe_backend(timeout_s: float = 120.0) -> tuple[str, str]:
     return "error", (err or out).strip()[-300:]
 
 
+import pytest
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Default 420 s SIGALRM timeout per test (no pytest-timeout in the
+    image; wrapper hook, same mechanism as tests/conftest.py's). On
+    2026-07-30 the compiled-kernel selftest wedged >900 s inside its
+    FIRST tunnel compile and the whole live window was lost with no
+    record of which test hung — a per-test alarm converts that into a
+    named failure and lets the remaining tests try. Limitation shared
+    with pytest-timeout's signal method: the alarm interrupts Python
+    bytecode, not a C call that never re-enters the interpreter (the
+    axon plugin's poll loop does re-enter, so in practice it fires)."""
+    import signal
+
+    seconds = 420
+    if not hasattr(signal, "SIGALRM"):
+        return (yield)
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"tests_tpu: {item.name} exceeded {seconds}s (wedged tunnel "
+            f"compile? frame: {frame.f_code.co_filename}:{frame.f_lineno})"
+        )
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 _backend, _detail = _probe_backend()
 if _backend != "tpu":
     sys.stderr.write(

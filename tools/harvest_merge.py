@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Merge per-bench harvest JSONs into one sweep-shaped record.
+
+The incremental TPU harvest (tools/tpu_harvest.sh) runs each bench as
+its own ``python bench.py --bench=<name>`` subprocess so that a tunnel
+wedge mid-campaign loses only the bench in flight, never the window's
+completed results. Each subprocess emits a self-contained record
+(its own backend probe, pre/post fingerprints, probe_tflops_at_bench,
+rel_mfu). This tool folds a directory of those into ONE record shaped
+like a ``--bench=all`` sweep so ``tools/stamp_floors.py`` can print the
+floor stamps unchanged.
+
+Merge semantics:
+- headline = resnet50 record if present, else the first by ALL_ORDER;
+- ``extras`` = every other completed record;
+- fingerprint pre/post = min/max over per-run pre/post fingerprints
+  (the spread IS the rig drift across the harvest window — recorded as
+  ``fingerprint_spread`` so BASELINE.md can quote it);
+- records whose backend != the majority backend are dropped loudly
+  (a probe that fell back to CPU mid-harvest must not stamp TPU
+  floors);
+- a ``harvested`` list names the per-bench files folded in.
+
+Usage: python tools/harvest_merge.py /tmp/tpu_harvest/results > merged.json
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    # Single source of truth for the bench list — hand-duplicating it
+    # here would silently miss benches added to bench.py later.
+    from bench import ALL_ORDER as ORDER  # noqa: E402
+except Exception:  # bench.py imports jax; fall back if that breaks
+    ORDER = [
+        "resnet50", "resnet50_input", "gpt2", "gpt2_long", "gpt2_long16k",
+        "gpt2_decode", "gpt2_decode_long", "bert", "cifar10", "mnist",
+        "collectives", "moe", "decode_grid",
+    ]
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    d = sys.argv[1]
+    recs = {}
+    selftest = None
+    for fn in sorted(os.listdir(d)):
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(d, fn)
+        try:
+            with open(path) as f:
+                r = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"merge: skipping {fn}: {e}", file=sys.stderr)
+            continue
+        if r.get("metric") == "selftest" or "selftest" in r:
+            st = r.get("selftest")
+            if st is not None:
+                selftest = st
+            if r.get("metric") == "selftest":
+                continue
+        name = r.get("bench") or fn[:-5]
+        if "error" in r:
+            print(f"merge: {name} errored: {r['error']}", file=sys.stderr)
+        recs[name] = r
+
+    if not recs:
+        print("merge: no bench records found", file=sys.stderr)
+        return 1
+
+    # Prefer tpu whenever ANY tpu record exists: a cpu-fallback majority
+    # (tunnel died early) must never cause the chip-measured records to
+    # be the ones dropped.
+    backends = {r.get("backend", "?") for r in recs.values()}
+    backend = "tpu" if "tpu" in backends else sorted(backends)[0]
+    dropped = [n for n, r in recs.items() if r.get("backend", "?") != backend]
+    for n in dropped:
+        print(f"merge: DROPPING {n} (backend {recs[n].get('backend')!r} != "
+              f"majority {backend!r})", file=sys.stderr)
+        del recs[n]
+
+    pres = [r["fingerprint_tflops_pre"] for r in recs.values()
+            if isinstance(r.get("fingerprint_tflops_pre"), (int, float))]
+    posts = [r["fingerprint_tflops_post"] for r in recs.values()
+             if isinstance(r.get("fingerprint_tflops_post"), (int, float))]
+    fps = pres + posts
+
+    ordered = sorted(recs, key=lambda n: ORDER.index(n) if n in ORDER else 99)
+    head_name = "resnet50" if "resnet50" in recs else ordered[0]
+    out = dict(recs[head_name])
+    out["extras"] = [recs[n] for n in ordered if n != head_name]
+    out["backend"] = backend
+    if fps:
+        out["fingerprint_tflops_pre"] = min(fps)
+        out["fingerprint_tflops_post"] = max(fps)
+        out["fingerprint_spread"] = [min(fps), max(fps)]
+    out["harvested"] = ordered
+    missing = [n for n in ORDER if n not in recs]
+    if missing:
+        out["truncated"] = missing
+    if selftest is not None:
+        out["selftest"] = selftest
+    json.dump(out, sys.stdout)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -201,6 +201,26 @@ def _assemble() -> dict:
     if trunc:
         out["truncated"] = trunc
     out.update(_META)
+    # If an incremental harvest (tools/tpu_harvest.sh) has banked an
+    # on-chip record, carry it inside the driver artifact:
+    # BENCH_r03.json was lost to a dead tunnel and round 3 ended with
+    # ZERO TPU numbers on file — the official artifact must never again
+    # depend on the tunnel being alive at the one moment the driver
+    # runs. Attached unconditionally (a live-TPU driver run may itself
+    # be budget-truncated; the banked record is the fuller evidence).
+    if os.environ.get("BENCH_HARVEST_CHILD"):
+        return out  # harvest subprocess: never embed the banked record
+    try:
+        harvest_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "docs", "tpu_sweeps", "round4_merged.json",
+        )
+        with open(harvest_path) as f:
+            harvested = json.load(f)
+        if harvested.get("backend") == "tpu":
+            out["tpu_harvest"] = harvested
+    except Exception:
+        pass
     return out
 
 

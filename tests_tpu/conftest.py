@@ -83,6 +83,12 @@ def pytest_runtest_call(item):
     import signal
 
     seconds = 420
+    # Scope to THIS directory's tests: conftest hooks register
+    # session-wide, and in a combined `pytest tests/ tests_tpu/` run an
+    # unconditional wrapper would fight tests/conftest.py's
+    # marker-based alarm over the single process-wide SIGALRM.
+    if "tests_tpu" not in str(getattr(item, "fspath", "")):
+        return (yield)
     if not hasattr(signal, "SIGALRM"):
         return (yield)
 

@@ -90,6 +90,15 @@ def main() -> int:
              if isinstance(r.get("fingerprint_tflops_post"), (int, float))]
     fps = pres + posts
 
+    # Per-bench records may themselves carry sweep-level keys: a bench
+    # subprocess's _assemble attaches any previously-banked harvest as
+    # "tpu_harvest" (and lists its own skipped siblings as
+    # "truncated"). Left in place these would nest the merged artifact
+    # inside itself, one level per finalize cycle.
+    for r in recs.values():
+        for k in ("tpu_harvest", "extras", "truncated", "harvested"):
+            r.pop(k, None)
+
     ordered = sorted(recs, key=lambda n: ORDER.index(n) if n in ORDER else 99)
     head_name = "resnet50" if "resnet50" in recs else ordered[0]
     out = dict(recs[head_name])

@@ -72,8 +72,11 @@ probe() {  # -> 0 live / 1 down
   rm -f "$f"; return 1
 }
 
-pause_suite() { pkill -STOP -f "pytest tests/" 2>/dev/null && echo "  (paused CPU suite)"; true; }
-resume_suite() { pkill -CONT -f "pytest tests/" 2>/dev/null && echo "  (resumed CPU suite)"; true; }
+# ANCHORED pattern: an unanchored "pytest tests/" also matches the
+# session driver process (its prompt text contains that substring) —
+# SIGSTOPping that would freeze the whole build session.
+pause_suite() { pkill -STOP -f "^[^ ]*python -m pytest tests/" 2>/dev/null && echo "  (paused CPU suite)"; true; }
+resume_suite() { pkill -CONT -f "^[^ ]*python -m pytest tests/" 2>/dev/null && echo "  (resumed CPU suite)"; true; }
 
 budget_for() {
   case "$1" in
@@ -94,10 +97,16 @@ selftest_done() { [ -s "$OUT/selftest_pytest.log" ] && grep -qE "passed|failed|e
 
 finalize() {
   resume_suite
-  python tools/harvest_merge.py "$OUT/results" > "$OUT/merged.json" 2> "$OUT/merge.err"
-  python tools/stamp_floors.py "$OUT/merged.json" > "$OUT/stamp.txt" 2>&1
-  cp "$OUT/merged.json" docs/tpu_sweeps/round4_merged.json 2>/dev/null || true
-  echo "harvest finalized: $OUT/stamp.txt"
+  if python tools/harvest_merge.py "$OUT/results" > "$OUT/merged.json" 2> "$OUT/merge.err" \
+     && [ -s "$OUT/merged.json" ] \
+     && python -c "import json,sys; json.load(open(sys.argv[1]))" "$OUT/merged.json" 2>/dev/null; then
+    python tools/stamp_floors.py "$OUT/merged.json" > "$OUT/stamp.txt" 2>&1
+    cp "$OUT/merged.json" docs/tpu_sweeps/round4_merged.json
+    echo "harvest finalized: $OUT/stamp.txt"
+  else
+    # Never clobber previously-banked evidence with a failed merge.
+    echo "harvest finalize: merge failed (see $OUT/merge.err); banked artifact untouched"
+  fi
 }
 
 trap 'resume_suite; rm -f /tmp/tpu_live' EXIT
@@ -118,7 +127,7 @@ while true; do
     bud=$(budget_for "$b")
     echo "$(date -u +%H:%M:%S)   bench $b (budget ${bud}s)"
     : > "$OUT/results/$b.part"
-    run_bounded $((bud + 40)) "$OUT/results/$b.err2" \
+    BENCH_HARVEST_CHILD=1 run_bounded $((bud + 40)) "$OUT/results/$b.err2" \
       python bench.py --bench="$b" --budget="$bud" --no-selftest
     rc=$?
     # bench.py prints the ONE json line on stdout; stdout+stderr are

@@ -93,7 +93,105 @@ all_done() {
   return 0
 }
 
-selftest_done() { [ -s "$OUT/selftest_pytest.log" ] && grep -qE "passed|failed|error" "$OUT/selftest_pytest.log"; }
+# Compiled-kernel selftest, banked PER TEST NODE like the benches: one
+# bounded pytest subprocess per node id, status files accumulate across
+# live windows, wedges/timeouts retry next window but assertion
+# failures are kept as evidence. The persistent compile cache
+# (tests_tpu/conftest.py) makes retries cheap.
+node_status_file() {
+  echo "$OUT/selftest_status/$(echo "$1" | tr '/:[]' '____').status"
+}
+
+collect_nodes() {
+  [ -s "$OUT/selftest_nodes.txt" ] && return 0
+  # Cache the node list only on a FULLY clean collection (rc=0): a
+  # partial collection (rc=2, some modules errored) still prints node
+  # ids, and caching those would silently truncate the suite while the
+  # final record claims full coverage.
+  run_bounded 300 "$OUT/selftest_collect.log" \
+    python -m pytest tests_tpu/ --collect-only -q
+  [ $? -eq 0 ] || { echo "  selftest: collection rc!=0, not caching"; return 1; }
+  grep "::" "$OUT/selftest_collect.log" | sed 's/\r$//' > "$OUT/selftest_nodes.txt"
+  [ -s "$OUT/selftest_nodes.txt" ]
+}
+
+run_selftest_nodes() {
+  mkdir -p "$OUT/selftest_status"
+  collect_nodes || { echo "  selftest: collection failed/empty"; return 1; }
+  while IFS= read -r node; do
+    sf=$(node_status_file "$node")
+    [ -s "$sf" ] && continue
+    echo "$(date -u +%H:%M:%S)   selftest $node"
+    run_bounded 460 "$OUT/selftest_status/last_run.log" \
+      python -m pytest "$node" -q
+    rc=$?
+    if [ $rc -eq 0 ]; then
+      { echo "pass"; echo "$node"; } > "$sf"
+      continue
+    fi
+    if [ $rc -eq 124 ]; then
+      # Keep the wedge diagnostic (which compile hung) before the next
+      # node's run overwrites last_run.log; retry next window.
+      cp "$OUT/selftest_status/last_run.log" "$sf.wedge.log" 2>/dev/null
+      echo "$(date -u +%H:%M:%S)   selftest $node WEDGED (retry next window)"
+      if ! probe; then return 1; fi
+      continue
+    fi
+    # Non-timeout nonzero rc: only pytest rc=1 with a real failure
+    # summary is a GENUINE compiled-numerics failure worth banking.
+    # rc=5/"no tests ran" means the conftest probe saw a dead backend
+    # (fast tunnel death) and rc=2/3/4 are collection/usage/interrupt —
+    # all transient harness states, NOT test evidence: re-probe and
+    # retry next window.
+    if [ $rc -eq 1 ] && grep -qE "^(FAILED|ERROR)|= *[0-9]+ failed" \
+         "$OUT/selftest_status/last_run.log"; then
+      { echo "fail rc=$rc"; echo "$node";
+        tail -40 "$OUT/selftest_status/last_run.log"; } > "$sf"
+      echo "$(date -u +%H:%M:%S)   selftest $node FAILED rc=$rc"
+    else
+      cp "$OUT/selftest_status/last_run.log" "$sf.transient.log" 2>/dev/null
+      echo "$(date -u +%H:%M:%S)   selftest $node transient rc=$rc (retry next window)"
+      if ! probe; then return 1; fi
+    fi
+  done < "$OUT/selftest_nodes.txt"
+  return 0
+}
+
+selftest_done() {
+  [ -s "$OUT/selftest_nodes.txt" ] || return 1
+  while IFS= read -r node; do
+    [ -s "$(node_status_file "$node")" ] || return 1
+  done < "$OUT/selftest_nodes.txt"
+  return 0
+}
+
+write_selftest_record() {
+  selftest_done || return 0
+  # Status files are the single source of truth: line 1 = pass/fail,
+  # line 2 = the node id (so this reader never re-derives the shell's
+  # filename sanitization).
+  python - "$OUT" <<'EOF'
+import glob, json, os, sys
+out = sys.argv[1]
+n_nodes = sum(1 for l in open(os.path.join(out, "selftest_nodes.txt")) if l.strip())
+statuses = []
+for path in sorted(glob.glob(os.path.join(out, "selftest_status", "*.status"))):
+    with open(path) as f:
+        status = f.readline().strip()
+        node = f.readline().strip() or os.path.basename(path)
+    statuses.append((node, status))
+fails = sorted(n for n, s in statuses if not s.startswith("pass"))
+ok = not fails and len(statuses) == n_nodes
+summary = (f"{len(statuses) - len(fails)}/{n_nodes} compiled-kernel tests "
+           f"passed on tpu (per-node bounded subprocesses, banked across "
+           f"live windows)")
+if fails:
+    summary += "; failed: " + ", ".join(fails)
+rec = {"metric": "selftest", "backend": "tpu",
+       "selftest": {"ok": ok, "summary": summary}}
+json.dump(rec, open(os.path.join(out, "results", "selftest.json"), "w"))
+EOF
+}
 
 finalize() {
   resume_suite
@@ -171,9 +269,8 @@ EOF
   done
   if [ $window_ok -eq 1 ] && all_done && ! selftest_done; then
     echo "$(date -u +%H:%M:%S) benches complete — compiled-kernel selftest"
-    # Per-test 420 s SIGALRM timeout lives in tests_tpu/conftest.py.
-    run_bounded 2000 "$OUT/selftest_pytest.log" python -m pytest tests_tpu/ -v
-    echo "$(date -u +%H:%M:%S) selftest rc=$? (log: $OUT/selftest_pytest.log)"
+    run_selftest_nodes || window_ok=0
+    write_selftest_record
   fi
   if all_done && selftest_done; then
     finalize

@@ -72,7 +72,6 @@ def token_partition_axes(
     Axes dropped here mean the tokens REPLICATE over that axis, which
     is always correct, just less parallel.
     """
-    import math
 
     batch_axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
     nb = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1

@@ -176,10 +176,14 @@ def _count_records(tf, files: list, data_dir: str, tag: str) -> int:
 
     # data_dir participates in the key: the cache is global per host,
     # and two datasets with the standard shard naming and equal sizes
-    # but different contents must not share a count.
+    # but different contents must not share a count. Only genuinely
+    # local paths are normalized — abspath would both mangle remote
+    # URLs ('gs://b/x' -> '<cwd>/gs:/b/x') and make the key depend on
+    # the launch CWD, missing the cache on every scheduler restart.
+    is_url = "://" in data_dir
     sig = hashlib.sha1(
         "|".join(
-            [os.path.abspath(data_dir)]
+            [data_dir if is_url else os.path.abspath(data_dir)]
             + [
                 f"{os.path.basename(f)}:{tf.io.gfile.stat(f).length}"
                 for f in files

@@ -242,7 +242,10 @@ def flash_decode_attention(
 
     if isinstance(length, int):  # static length: exact bucket, no switch
         needed = -(-length // block_kv)
-        nkv = next(c for c in counts if c >= needed)
+        # Clamp to the full-cache bucket for length > max_len, matching
+        # the traced path (searchsorted clamps the same overrun); a
+        # bare next() would raise an opaque StopIteration here.
+        nkv = next((c for c in counts if c >= needed), total)
         call = _make_decode(q_len, block_q, block_kv, bool(interpret), nkv)
         out = call(qf, kf, vf, length, sm_scale)
         return out.reshape(b, h, q_len, head_dim)

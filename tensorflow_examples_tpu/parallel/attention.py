@@ -15,6 +15,7 @@ import functools
 import math
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tensorflow_examples_tpu.core.mesh import AxisNames
@@ -202,8 +203,6 @@ def mesh_attention(
     seq = q.shape[2]
     pad = 0
     if has_context and causal and impl != "ulysses":
-        import jax.numpy as jnp
-
         c = mesh.shape[AxisNames.CONTEXT]
         target = -(-seq // (2 * c)) * (2 * c)  # next multiple of 2c
         # Kernel tileability: the zigzag path attends both single

@@ -93,6 +93,27 @@ all_done() {
   return 0
 }
 
+# Benches (and selftest nodes) are retried least-attempted-first: if
+# one item reliably wedges the tunnel (e.g. a specific kernel
+# compile), naive in-order retries would burn EVERY window on it and
+# never reach the items behind it. Stable sort keeps the
+# most-valuable-first order within an attempt count.
+bump_attempts() {  # $1=counter file -> increments, prints new count
+  local f="$1" n=0
+  [ -f "$f" ] && n=$(cat "$f" 2>/dev/null || echo 0)
+  n=$((n + 1))
+  echo "$n" > "$f"
+}
+
+order_by_attempts() {  # stdin: one item per line; $1: counter dir
+  local dir="$1"
+  while IFS= read -r it; do
+    local a=0 cf="$dir/$(echo "$it" | tr '/:[] ' '_____').attempts"
+    [ -f "$cf" ] && a=$(cat "$cf" 2>/dev/null || echo 0)
+    printf '%05d %s\n' "$a" "$it"
+  done | sort -s -k1,1 | cut -d' ' -f2-
+}
+
 # Compiled-kernel selftest, banked PER TEST NODE like the benches: one
 # bounded pytest subprocess per node id, status files accumulate across
 # live windows, wedges/timeouts retry next window but assertion
@@ -118,9 +139,12 @@ collect_nodes() {
 run_selftest_nodes() {
   mkdir -p "$OUT/selftest_status"
   collect_nodes || { echo "  selftest: collection failed/empty"; return 1; }
+  order_by_attempts "$OUT/attempts" < "$OUT/selftest_nodes.txt" \
+    > "$OUT/selftest_nodes.run"
   while IFS= read -r node; do
     sf=$(node_status_file "$node")
     [ -s "$sf" ] && continue
+    bump_attempts "$OUT/attempts/$(echo "$node" | tr '/:[] ' '_____').attempts" > /dev/null
     echo "$(date -u +%H:%M:%S)   selftest $node"
     run_bounded 460 "$OUT/selftest_status/last_run.log" \
       python -m pytest "$node" -q
@@ -153,7 +177,7 @@ run_selftest_nodes() {
       echo "$(date -u +%H:%M:%S)   selftest $node transient rc=$rc (retry next window)"
       if ! probe; then return 1; fi
     fi
-  done < "$OUT/selftest_nodes.txt"
+  done < "$OUT/selftest_nodes.run"
   return 0
 }
 
@@ -220,8 +244,10 @@ while true; do
   touch /tmp/tpu_live
   pause_suite
   window_ok=1
-  for b in $BENCH_ORDER; do
+  mkdir -p "$OUT/attempts"
+  for b in $(printf '%s\n' $BENCH_ORDER | order_by_attempts "$OUT/attempts"); do
     [ -s "$OUT/results/$b.json" ] && continue
+    bump_attempts "$OUT/attempts/$b.attempts" > /dev/null
     bud=$(budget_for "$b")
     echo "$(date -u +%H:%M:%S)   bench $b (budget ${bud}s)"
     : > "$OUT/results/$b.part"

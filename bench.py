@@ -316,7 +316,8 @@ def _resolve_backend() -> str:
     sitecustomize pre-imports jax — so the fallback is the in-process
     config pin, same as tests/conftest.py.
     """
-    plat, _n, err = _probe_backend()
+    forced = os.environ.get("BENCH_FORCE_BACKEND")
+    plat, _n, err = (forced, None, None) if forced else _probe_backend()
     if plat is None or plat == "cpu":
         # 8 virtual devices so the collectives bench exercises a real
         # mesh; workload benches pin a 1-device mesh (per-chip metrics).

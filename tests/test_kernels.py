@@ -183,6 +183,23 @@ class TestFlashDecode:
         out2 = flash_decode_attention(q, k2, v2, jnp.asarray(100))
         np.testing.assert_allclose(out, out2, atol=0, rtol=0)
 
+    def test_overlong_length_clamps_like_traced(self):
+        """length > max_len: the static path must clamp to the full
+        cache exactly like the traced path's searchsorted clamp (it
+        used to raise a bare StopIteration); both must equal the
+        full-cache answer."""
+        q = jax.random.normal(jax.random.PRNGKey(9), (1, 2, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(10), (1, 2, 256, 64))
+        v = jax.random.normal(jax.random.PRNGKey(11), (1, 2, 256, 64))
+        full = decode_attention_reference(q, k, v, 256)
+        np.testing.assert_allclose(
+            flash_decode_attention(q, k, v, 300), full, atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            flash_decode_attention(q, k, v, jnp.asarray(300)),
+            full, atol=2e-5, rtol=2e-5,
+        )
+
     def test_jit_traced_length(self):
         """length as a traced scalar: one compile serves every context
         size — the property the generate() scan relies on."""

@@ -81,7 +81,9 @@ probe() {  # -> 0 live / 1 down
   else
     code='import jax; print("LIVE", jax.default_backend())'
   fi
-  run_bounded 120 "$f" python -c "$code"
+  # 90 s: a LIVE tunnel answers in ~10 s; only hung probes burn the
+  # timeout, and they burn all of it — shorter timeout = faster cycle.
+  run_bounded 90 "$f" python -c "$code"
   if grep -q "LIVE $WANT_BACKEND" "$f" 2>/dev/null; then rm -f "$f"; return 0; fi
   rm -f "$f"; return 1
 }
@@ -256,7 +258,7 @@ while true; do
   if ! probe; then
     rm -f /tmp/tpu_live
     echo "$(date -u +%H:%M:%S) tunnel down"
-    sleep 180
+    sleep 90
     continue
   fi
   echo "$(date -u +%H:%M:%S) TUNNEL LIVE — harvesting"

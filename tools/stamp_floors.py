@@ -4,8 +4,10 @@
 Usage: python tools/stamp_floors.py /path/to/sweep.json
 
 Prints, for the record's backend:
-- the ``FLOORS[backend]`` entries as Python source — (median, the
-  sweep's pre-fingerprint) pairs per metric;
+- the ``FLOORS[backend]`` entries as Python source — (median,
+  fingerprint) pairs per metric, each stamped with its OWN record's
+  pre-fingerprint when present (harvest merges) and the sweep-level
+  pre-fingerprint otherwise (plain ``--bench=all`` sweeps);
 - the ``REL_MFU_FLOORS[backend]`` entries;
 - a BASELINE.md markdown table row per metric (median, window spread,
   rel_mfu) so the stamp and its evidence land together.
@@ -37,7 +39,9 @@ def main() -> int:
         if "error" in r and r.get("metric") != "selftest"
     ]
 
-    print(f"# backend={backend}  fingerprint pre={fp} post={fp_post}")
+    spread = d.get("fingerprint_spread")
+    print(f"# backend={backend}  fingerprint pre={fp} post={fp_post}"
+          + (f"  spread={spread}" if spread else ""))
     if d.get("truncated"):
         print(f"# TRUNCATED (not stamped): {d['truncated']}")
     if errored:
@@ -48,9 +52,15 @@ def main() -> int:
             f"# ERRORED (NOT STAMPED — their old floors are now stale, "
             f"fix or remove them): {errored}"
         )
+    # Each harvest record is self-contained and carries its OWN probe
+    # fingerprint; stamping with the merged min-over-all-probes would
+    # let a single wedged probe (e.g. a post-fingerprint taken mid
+    # tunnel-death, observed at 78 vs the ~40-100k healthy range)
+    # poison every floor's fingerprint at once.
     print(f'\n# --- FLOORS["{backend}"] entries ---')
     for r in results:
-        print(f'        "{r["metric"]}": ({r["value"]}, {fp}),')
+        rfp = r.get("fingerprint_tflops_pre", r.get("fingerprint_tflops", fp))
+        print(f'        "{r["metric"]}": ({r["value"]}, {rfp}),')
     print(f'\n# --- REL_MFU_FLOORS["{backend}"] entries ---')
     for r in results:
         if "rel_mfu" in r:

@@ -397,6 +397,40 @@ def _probe_quick() -> float:
     return _probe_window(5 if BACKEND == "tpu" else 2)
 
 
+def _probe_launch_us(n: int = 200, windows: int = 3) -> float:
+    """Dispatch-chain fingerprint: wall µs per chained jitted no-op step.
+
+    The matmul probe saturates on device FLOPs and cannot see per-launch
+    host/tunnel dispatch cost — but the small-step benches (cifar10,
+    mnist, resnet50_input, decode) run exactly in the regime where that
+    cost dominates, and it varies between tunnel instances in a way the
+    TFLOP/s fingerprint never records (the round-4 harvest measured
+    cifar10 at 0.42x a floor whose rig probed SLOWER on matmuls).
+    Chained x = f(x) launches replicate _time_steps' async-dispatch
+    pattern: one block at the end, so the figure is launch pipeline
+    throughput, not round-trip latency."""
+    import jax
+    import jax.numpy as jnp
+
+    key = ("launch", BACKEND)
+    if key not in _PROBE_STATE:
+        f = jax.jit(lambda x: x + 1.0, donate_argnums=0)
+        x0 = f(jnp.zeros((8, 128), jnp.float32))
+        x0.block_until_ready()  # compile once
+        _PROBE_STATE[key] = (f, x0)
+    f, x = _PROBE_STATE[key]
+    best = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = f(x)
+        x.block_until_ready()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    _PROBE_STATE[key] = (f, x)
+    return best / n * 1e6
+
+
 # -------------------------------------------------------------- plumbing
 
 
@@ -1286,6 +1320,10 @@ def run_bench(name: str) -> dict:
     r["bench"] = name
     r["probe_tflops_at_bench"] = round(probe, 2)
     r["bench_seconds"] = round(time.perf_counter() - t0, 1)
+    try:
+        r["probe_launch_us_at_bench"] = round(_probe_launch_us(), 2)
+    except Exception:  # a dying backend mid-probe must not lose the bench
+        pass
     mt = r.get("model_tflops_per_sec")
     if mt:
         r["rel_mfu"] = round(mt / probe, 5)
@@ -1375,12 +1413,20 @@ def main() -> int:
         fp_pre = round(fingerprint_tflops(), 2)
         # Back-compat scalar stamp: the pre-sweep median.
         _META["fingerprint_tflops_pre"] = _META["fingerprint_tflops"] = fp_pre
+        try:
+            _META["fingerprint_launch_us_pre"] = round(_probe_launch_us(), 2)
+        except Exception:  # transient probe death must not abort the sweep
+            pass
         if which == "all":
             run_all()
         else:
             _RESULTS.append(run_bench(which))
             _IN_FLIGHT = None
         _META["fingerprint_tflops_post"] = round(fingerprint_tflops(), 2)
+        try:
+            _META["fingerprint_launch_us_post"] = round(_probe_launch_us(), 2)
+        except Exception:  # the selftest below must still get its budget
+            pass
         # Selftest runs AFTER the sweep: on a live TPU with a cold cache
         # the budget should be spent on perf evidence first, and the
         # selftest cap consumes whatever is left.

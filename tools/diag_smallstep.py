@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Small-step bench diagnosis: overhead-bound or kernel-bound?
+
+The round-4 harvest measured cifar10 at 0.42x and bert at 0.87x their
+round-3 floors on a rig whose MATMUL fingerprint probed faster than the
+floors' — so raw compute drift cannot explain the deficit. Both benches
+run at 1-2 ms/step, the regime where per-launch dispatch cost (which
+varies per tunnel instance and was never fingerprinted before
+bench.py's _probe_launch_us landed) can dominate the device kernels.
+
+This tool settles it per workload with a batch sweep: step time at
+batch B and 4B/16B. A step whose time barely moves with batch is
+per-step-overhead-bound — its examples/sec floor tracks the rig's
+launch cost, not the compiled kernels, and a sub-floor reading on a
+slower-dispatch rig is a rig artifact. A step whose time scales with
+batch is kernel-bound and a sub-floor reading is a real regression.
+
+Usage: python tools/diag_smallstep.py [--budget=SECS]
+Emits ONE JSON line; safe to run under `timeout` (partial results are
+emitted by the same always-emit pattern bench.py uses).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (repo-root bench.py: probes + timing helpers)
+
+OUT: dict = {"diag": "smallstep"}
+
+
+def _emit() -> None:
+    sys.stdout.write(json.dumps(OUT) + "\n")
+    sys.stdout.flush()
+
+
+def _cifar_step_time(batch: int, steps: int = 30) -> dict:
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.data.sources import synthetic_images
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import cifar10
+
+    cfg = cifar10.Cifar10Config(
+        global_batch_size=batch,
+        precision="bf16" if bench.BACKEND == "tpu" else "f32",
+        log_every=10**9, checkpoint_every=0, eval_every=0,
+        train_steps=10**6, watchdog_secs=0,
+    )
+    trainer = Trainer(cifar10.make_task(cfg), cfg, mesh=bench._chip_mesh())
+    ds = synthetic_images(n=4096, shape=(32, 32, 3), num_classes=10, seed=0)
+    it = train_iterator(ds, batch, seed=0)
+    batches = [trainer._put_batch(next(it)) for _ in range(4)]
+    dts = bench._time_steps(trainer, batches, steps, warmup=5)
+    med = statistics.median(dts)
+    return {
+        "batch": batch,
+        "ms_per_step": round(med / steps * 1e3, 4),
+        "examples_per_sec": round(batch * steps / med, 1),
+    }
+
+
+def _bert_step_time(batch: int, steps: int = 20) -> dict:
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import bert_glue
+
+    tpu = bench.BACKEND == "tpu"
+    cfg = bert_glue.BertGlueConfig(
+        global_batch_size=batch, precision="bf16" if tpu else "f32",
+        dropout=0.0, log_every=10**9, checkpoint_every=0, eval_every=0,
+        train_steps=10**6, watchdog_secs=0,
+        **({} if tpu else dict(  # bench_bert's CPU-rehearsal shapes
+            seq_len=32, vocab_size=512, num_layers=2, num_heads=2,
+            d_model=32, d_ff=64,
+        )),
+    )
+    trainer = Trainer(bert_glue.make_task(cfg), cfg, mesh=bench._chip_mesh())
+    ds, _ = bert_glue.datasets(cfg)
+    it = train_iterator(ds, batch, seed=0)
+    batches = [trainer._put_batch(next(it)) for _ in range(2)]
+    dts = bench._time_steps(trainer, batches, steps, warmup=3)
+    med = statistics.median(dts)
+    return {
+        "batch": batch,
+        "ms_per_step": round(med / steps * 1e3, 4),
+        "examples_per_sec": round(batch * steps / med, 1),
+    }
+
+
+def main() -> int:
+    budget = 600.0
+    for a in sys.argv[1:]:
+        if a.startswith("--budget="):
+            budget = float(a.split("=", 1)[1])
+    deadline = time.monotonic() + budget
+    try:
+        bench.BACKEND = bench._resolve_backend()
+        OUT["backend"] = bench.BACKEND
+        OUT["launch_us"] = round(bench._probe_launch_us(), 2)
+        OUT["probe_tflops"] = round(bench._probe_quick(), 2)
+        tpu = bench.BACKEND == "tpu"
+        cifar_batches = (128, 512, 2048) if tpu else (16, 64)
+        bert_batches = (32, 128) if tpu else (4,)
+        OUT["cifar10"] = []
+        for b in cifar_batches:
+            if time.monotonic() > deadline:
+                OUT["truncated"] = True
+                break
+            OUT["cifar10"].append(_cifar_step_time(b))
+        OUT["bert"] = []
+        for b in bert_batches:
+            if time.monotonic() > deadline:
+                OUT["truncated"] = True
+                break
+            OUT["bert"].append(_bert_step_time(b))
+        OUT["launch_us_post"] = round(bench._probe_launch_us(), 2)
+    except Exception as e:  # noqa: BLE001 — partials must still emit
+        OUT["error"] = f"{type(e).__name__}: {e}"
+    _emit()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

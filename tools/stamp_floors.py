@@ -66,13 +66,14 @@ def main() -> int:
         if "rel_mfu" in r:
             print(f'        "{r["metric"]}": {r["rel_mfu"]},')
     print("\n# --- BASELINE.md table ---")
-    print("| Metric | Median | Windows | rel_mfu |")
-    print("|---|---|---|---|")
+    print("| Metric | Median | Windows | rel_mfu | launch µs |")
+    print("|---|---|---|---|---|")
     for r in results:
         win = " / ".join(str(w) for w in r.get("window_values", []))
         print(
             f"| {r['metric']} | {r['value']} {r.get('unit', '')} | {win} "
-            f"| {r.get('rel_mfu', '—')} |"
+            f"| {r.get('rel_mfu', '—')} "
+            f"| {r.get('probe_launch_us_at_bench', '—')} |"
         )
     st = d.get("selftest")
     if st is not None:

@@ -76,21 +76,37 @@ import threading
 import time
 
 # Regression floors: (value, rig_fingerprint_tflops) pairs per
-# (backend, metric) — see FLOORS POLICY in the module docstring.
-# 2026-07-29 round-2 values; the tunnel drifted 31k-47k TFLOP/s between
-# the sweeps that stamped them, which is exactly why rel_mfu now exists.
+# (backend, metric) — see FLOORS POLICY in the module docstring. Both
+# backends are stamped from round-4 protocol sweeps (dates in the
+# per-backend comments); the rig's probe drifts across sessions — and
+# mid-harvest — which is exactly why rel_mfu and the per-record
+# fingerprints exist.
 FLOORS = {
     "tpu": {
-        "resnet50_examples_per_sec_per_chip": (62392.0, 31055.0),
-        "resnet50_input_examples_per_sec_per_chip": (88.2, 31055.0),  # 1-CPU host!
-        "gpt2_124m_tokens_per_sec": (2931492.0, 31055.0),
-        "gpt2_long4k_tokens_per_sec": (2861037.0, 31055.0),
-        "gpt2_long16k_tokens_per_sec": (4157890.0, 31055.0),
-        "gpt2_decode_tokens_per_sec": (1808924.0, 44536.0),
-        "bert_base_examples_per_sec_per_chip": (22286.0, 42508.0),
-        "cifar10_resnet20_examples_per_sec_per_chip": (242176.0, 46991.0),
-        "mnist_mlp_step_time": (0.18, 31055.0),  # ms/step
-        "allreduce_busbw": (3396.0, 31055.0),  # GB/s, n=1 loopback
+        # 2026-07-31 round-4 incremental harvest, the first full
+        # protocol sweep on a live chip (median-of-3 windows, per-bench
+        # pre-probes; BASELINE.md "Round-4 TPU harvest" table has the
+        # (value, fingerprint, rel_mfu, window-spread) evidence). Each
+        # floor carries ITS OWN record's pre-fingerprint — the rig
+        # drifted [78, 99912] probe-TFLOP/s across the window, the low
+        # end being a probe taken mid tunnel-wedge. bert/cifar10 moved
+        # DOWN vs their round-3 single-window stamps on a rig whose
+        # matmul probe ran faster; dispatch-rate differences are the
+        # suspect (those stamps predate the launch-µs fingerprint), see
+        # BASELINE.md for the diag.
+        "resnet50_examples_per_sec_per_chip": (185187.0, 65958.3),
+        "resnet50_input_examples_per_sec_per_chip": (80.3, 60547.46),  # 1-CPU host!
+        "gpt2_124m_tokens_per_sec": (3592223.0, 59962.35),
+        "gpt2_long4k_tokens_per_sec": (4231329.0, 47927.17),
+        "gpt2_long16k_tokens_per_sec": (9130385.0, 70377.3),
+        "gpt2_decode_tokens_per_sec": (3094517.0, 62363.12),
+        "gpt2_decode_long_tokens_per_sec": (1510532.0, 51264.06),
+        "bert_base_examples_per_sec_per_chip": (19348.0, 41795.56),
+        "cifar10_resnet20_examples_per_sec_per_chip": (102784.0, 61254.47),
+        "mnist_mlp_step_time": (0.1114, 76867.42),  # ms/step
+        "allreduce_busbw": (3401.0, 86610.5),  # GB/s, n=1 loopback
+        "moe_top2_tokens_per_sec": (62555.0, 45538.05),
+        "decode_grid_step_time_ratio": (0.78, 71210.05),  # 32k/4k cache
     },
     "cpu": {
         # 2026-07-30 round-4 protocol sweep (median-of-3 windows, probe
@@ -118,12 +134,23 @@ FLOORS = {
 }
 
 # Drift-cancelled floors: rel_mfu = model_tflops/probe_tflops measured
-# under the 3-window protocol. TPU side populated by the first round-3
-# sweep on a live chip (the tunnel was down for the whole build window —
-# BASELINE.md); CPU side stamped from the 2026-07-30 round-3 sweep.
-# Same move-with-evidence policy as FLOORS.
+# under the 3-window protocol. TPU side stamped from the 2026-07-31
+# round-4 harvest (first live-chip protocol sweep); CPU side from the
+# 2026-07-30 round-4 sweep. Same move-with-evidence policy as FLOORS.
 REL_MFU_FLOORS: dict[str, dict[str, float]] = {
-    "tpu": {},
+    "tpu": {
+        "resnet50_examples_per_sec_per_chip": 0.07961,
+        "resnet50_input_examples_per_sec_per_chip": 4e-05,
+        "gpt2_124m_tokens_per_sec": 0.06236,
+        "gpt2_long4k_tokens_per_sec": 0.0515,
+        "gpt2_long16k_tokens_per_sec": 0.10832,
+        "gpt2_decode_tokens_per_sec": 0.01937,
+        "gpt2_decode_long_tokens_per_sec": 0.13992,
+        "bert_base_examples_per_sec_per_chip": 0.03419,
+        "cifar10_resnet20_examples_per_sec_per_chip": 0.00044,
+        "mnist_mlp_step_time": 2e-05,
+        "moe_top2_tokens_per_sec": 0.00154,
+    },
     "cpu": {
         # Round-4 sweep (2026-07-30). gpt2 dropped 0.729 → 0.306 NOT
         # from a slowdown (raw tokens/s moved 40.9 → 37.3, within this

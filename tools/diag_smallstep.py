@@ -24,6 +24,7 @@ import json
 import os
 import statistics
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -33,9 +34,23 @@ import bench  # noqa: E402  (repo-root bench.py: probes + timing helpers)
 OUT: dict = {"diag": "smallstep"}
 
 
-def _emit() -> None:
-    sys.stdout.write(json.dumps(OUT) + "\n")
-    sys.stdout.flush()
+def _emit(truncated: bool = False) -> None:
+    # The watchdog emits a truncated snapshot at budget-15s (so the
+    # outer run_bounded's SIGKILL can never discard the COMPLETED
+    # sweeps), and main emits the full record on normal exit; consumers
+    # (tools/diag_watch.sh) take the LAST parseable line, so a main
+    # that finishes inside run_bounded's headroom wins over the
+    # snapshot. Snapshot a shallow copy: the timer thread dumps while
+    # main still assigns keys, and the C encoder raises on a dict that
+    # changes size mid-iteration.
+    try:
+        rec = dict(OUT)
+        if truncated:
+            rec["truncated"] = True
+        sys.stdout.write(json.dumps(rec) + "\n")
+        sys.stdout.flush()
+    except Exception:  # a racing snapshot must not kill the run
+        pass
 
 
 def _cifar_step_time(batch: int, steps: int = 30) -> dict:
@@ -97,6 +112,9 @@ def main() -> int:
         if a.startswith("--budget="):
             budget = float(a.split("=", 1)[1])
     deadline = time.monotonic() + budget
+    watchdog = threading.Timer(max(budget - 15.0, 5.0), _emit, (True,))
+    watchdog.daemon = True
+    watchdog.start()
     try:
         bench.BACKEND = bench._resolve_backend()
         OUT["backend"] = bench.BACKEND
@@ -120,6 +138,7 @@ def main() -> int:
         OUT["launch_us_post"] = round(bench._probe_launch_us(), 2)
     except Exception as e:  # noqa: BLE001 — partials must still emit
         OUT["error"] = f"{type(e).__name__}: {e}"
+    watchdog.cancel()
     _emit()
     return 0
 

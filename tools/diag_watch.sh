@@ -1,0 +1,73 @@
+#!/bin/bash
+# Follow-on to tools/tpu_harvest.sh: wait for the harvest loop to exit
+# (it exits only after all benches + all selftest nodes are banked),
+# then run the small-step diagnosis (tools/diag_smallstep.py) on the
+# next live window and bank its record to docs/tpu_sweeps/. Exists so
+# a live window arriving mid-session is never wasted waiting for a
+# human turn: harvest → diag chains unattended.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/tpu_diag}
+DEST=${2:-docs/tpu_sweeps/round4_diag.json}
+mkdir -p "$OUT" "$(dirname "$DEST")"
+. tools/lib_bounded.sh
+
+echo "diag_watch: waiting for tpu_harvest to finish"
+# Startup grace: a harvest launched in the same breath may not have a
+# process entry yet — without this, the pgrep below sees nothing and
+# diag runs CONCURRENTLY with the harvest, contending for the tunnel
+# and interleaving pause/resume_suite with the harvest's.
+sleep 90
+# Anchored like lib_bounded.sh's pause_suite — an unanchored match
+# would also hit any long-lived process whose cmdline merely MENTIONS
+# the script (e.g. a session driver carrying these instructions) —
+# but loose after the interpreter so `bash -x` variants still match.
+while pgrep -f "^[^ ]*bash .*tools/tpu_harvest.sh" > /dev/null 2>&1; do
+  sleep 60
+done
+echo "$(date -u +%H:%M:%S) harvest gone — watching for a live window"
+
+trap 'resume_suite' EXIT
+
+while true; do
+  # Belt-and-braces: /tmp/tpu_live is touched by an actively-harvesting
+  # window; never time the diag against a concurrent harvest even if
+  # the pgrep wait was somehow skipped.
+  if [ -f /tmp/tpu_live ]; then
+    echo "$(date -u +%H:%M:%S) harvest window active; deferring"
+    sleep 90
+    continue
+  fi
+  if ! probe tpu; then
+    echo "$(date -u +%H:%M:%S) tunnel down"
+    sleep 90
+    continue
+  fi
+  echo "$(date -u +%H:%M:%S) TUNNEL LIVE — running diag_smallstep"
+  pause_suite
+  run_bounded 700 "$OUT/diag.log" python tools/diag_smallstep.py --budget=600
+  resume_suite
+  # Bank the last parseable JSON line (always-emit children may print a
+  # truncated snapshot before the full record) iff it is a TPU record
+  # carrying at least the two batch points per workload the
+  # overhead-vs-kernel classification needs — else retry next window.
+  if python - "$OUT/diag.log" "$DEST" <<'EOF'
+import json, sys
+sys.path.insert(0, "tools")
+from last_json_line import last_json_line
+rec = last_json_line(sys.argv[1])
+ok = (rec is not None and rec.get("backend") == "tpu"
+      and "error" not in rec
+      and len(rec.get("cifar10") or []) >= 2
+      and len(rec.get("bert") or []) >= 2)
+if ok:
+    json.dump(rec, open(sys.argv[2], "w"))
+sys.exit(0 if ok else 1)
+EOF
+  then
+    echo "$(date -u +%H:%M:%S) diag banked: $DEST"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) diag incomplete (see $OUT/diag.log); retrying"
+  sleep 90
+done

@@ -1,0 +1,51 @@
+# Shared wedge-tolerant process discipline for the TPU watcher scripts
+# (tools/tpu_harvest.sh, tools/diag_watch.sh). Source, don't execute.
+#
+# The axon tunnel's failure mode is a wedge that survives SIGKILL (the
+# child sticks in D state inside the driver), so nothing here ever
+# `wait`s unconditionally on a child, and the shared 1-core host means
+# any `pytest tests/` must be SIGSTOPped while device timing runs.
+
+# run_bounded SECS LOGFILE CMD... — run CMD with stdout+stderr to
+# LOGFILE, hard deadline SECS. Returns CMD's rc, or 124 on deadline.
+run_bounded() {
+  local secs=$1 log=$2; shift 2
+  "$@" > "$log" 2>&1 &
+  local pid=$! waited=0
+  while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$secs" ]; do
+    sleep 5; waited=$((waited + 5))
+  done
+  if kill -0 "$pid" 2>/dev/null; then
+    kill -9 "$pid" 2>/dev/null
+    sleep 2
+    if kill -0 "$pid" 2>/dev/null; then
+      echo "run_bounded: pid $pid unkillable (driver wedge); abandoning" >> "$log"
+    fi
+    return 124
+  fi
+  wait "$pid" 2>/dev/null
+}
+
+# probe [want_backend] — 0 if `jax.default_backend()` answers with the
+# wanted backend (default tpu) inside 90 s. want=cpu pins the platform
+# in-process (a raw default_backend() would hang on a wedged axon
+# plugin — same trap tests/conftest.py avoids).
+probe() {
+  local want=${1:-tpu} f code
+  rm -f /tmp/bench_backend_probe.json
+  f=$(mktemp /tmp/probe_out.XXXXXX)
+  if [ "$want" = cpu ]; then
+    code='import jax; jax.config.update("jax_platforms", "cpu"); print("LIVE", jax.default_backend())'
+  else
+    code='import jax; print("LIVE", jax.default_backend())'
+  fi
+  run_bounded 90 "$f" python -c "$code"
+  if grep -q "LIVE $want" "$f" 2>/dev/null; then rm -f "$f"; return 0; fi
+  rm -f "$f"; return 1
+}
+
+# ANCHORED pattern: an unanchored "pytest tests/" would also match the
+# session driver process (its prompt text contains that substring) —
+# SIGSTOPping that would freeze the whole build session.
+pause_suite() { pkill -STOP -f "^[^ ]*python -m pytest tests/" 2>/dev/null && echo "  (paused CPU suite)"; true; }
+resume_suite() { pkill -CONT -f "^[^ ]*python -m pytest tests/" 2>/dev/null && echo "  (resumed CPU suite)"; true; }

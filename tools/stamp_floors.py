@@ -57,8 +57,16 @@ def main() -> int:
     # let a single wedged probe (e.g. a post-fingerprint taken mid
     # tunnel-death, observed at 78 vs the ~40-100k healthy range)
     # poison every floor's fingerprint at once.
+    # Diagnostics whose healthy value is a fixed point and whose
+    # failure direction _result()'s unit heuristic would misread are
+    # never floored (bench.py documents each beside FLOORS).
+    unfloored = {"decode_grid_step_time_ratio"}
     print(f'\n# --- FLOORS["{backend}"] entries ---')
     for r in results:
+        if r["metric"] in unfloored:
+            print(f'        # {r["metric"]}: {r["value"]} — diagnostic, '
+                  f'deliberately unfloored')
+            continue
         rfp = r.get("fingerprint_tflops_pre", r.get("fingerprint_tflops", fp))
         print(f'        "{r["metric"]}": ({r["value"]}, {rfp}),')
     print(f'\n# --- REL_MFU_FLOORS["{backend}"] entries ---')

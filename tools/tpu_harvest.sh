@@ -48,51 +48,9 @@ BENCH_ORDER=${TPU_HARVEST_BENCHES:-"resnet50 gpt2 bert resnet50_input collective
 WANT_BACKEND=${TPU_HARVEST_BACKEND:-tpu}
 DEST=${TPU_HARVEST_DEST:-docs/tpu_sweeps/round4_merged.json}
 
-# run_bounded SECS LOGFILE CMD... — run CMD with stdout+stderr to
-# LOGFILE, hard deadline SECS. Returns CMD's rc, or 124 on deadline.
-# Never blocks on an unkillable child: if SIGKILL doesn't take (child
-# stuck in the driver in D state), we abandon it without wait()ing.
-run_bounded() {
-  local secs=$1 log=$2; shift 2
-  "$@" > "$log" 2>&1 &
-  local pid=$! waited=0
-  while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$secs" ]; do
-    sleep 5; waited=$((waited + 5))
-  done
-  if kill -0 "$pid" 2>/dev/null; then
-    kill -9 "$pid" 2>/dev/null
-    sleep 2
-    if kill -0 "$pid" 2>/dev/null; then
-      echo "run_bounded: pid $pid unkillable (driver wedge); abandoning" >> "$log"
-    fi
-    return 124
-  fi
-  wait "$pid" 2>/dev/null
-}
-
-probe() {  # -> 0 live / 1 down
-  rm -f /tmp/bench_backend_probe.json
-  local f code
-  f=$(mktemp /tmp/probe_out.XXXXXX)
-  if [ "$WANT_BACKEND" = cpu ]; then
-    # Rehearsal: pin cpu in-process (a raw default_backend() would hang
-    # on the wedged axon plugin, same trap as tests/conftest.py).
-    code='import jax; jax.config.update("jax_platforms", "cpu"); print("LIVE", jax.default_backend())'
-  else
-    code='import jax; print("LIVE", jax.default_backend())'
-  fi
-  # 90 s: a LIVE tunnel answers in ~10 s; only hung probes burn the
-  # timeout, and they burn all of it — shorter timeout = faster cycle.
-  run_bounded 90 "$f" python -c "$code"
-  if grep -q "LIVE $WANT_BACKEND" "$f" 2>/dev/null; then rm -f "$f"; return 0; fi
-  rm -f "$f"; return 1
-}
-
-# ANCHORED pattern: an unanchored "pytest tests/" also matches the
-# session driver process (its prompt text contains that substring) —
-# SIGSTOPping that would freeze the whole build session.
-pause_suite() { pkill -STOP -f "^[^ ]*python -m pytest tests/" 2>/dev/null && echo "  (paused CPU suite)"; true; }
-resume_suite() { pkill -CONT -f "^[^ ]*python -m pytest tests/" 2>/dev/null && echo "  (resumed CPU suite)"; true; }
+# Wedge-tolerant process discipline (run_bounded / probe / pause_suite)
+# is shared with tools/diag_watch.sh:
+. tools/lib_bounded.sh
 
 budget_for() {
   case "$1" in
@@ -174,7 +132,7 @@ run_selftest_nodes() {
       # node's run overwrites last_run.log; retry next window.
       cp "$OUT/selftest_status/last_run.log" "$sf.wedge.log" 2>/dev/null
       echo "$(date -u +%H:%M:%S)   selftest $node WEDGED (retry next window)"
-      if ! probe; then return 1; fi
+      if ! probe "$WANT_BACKEND"; then return 1; fi
       continue
     fi
     # Non-timeout nonzero rc: only pytest rc=1 with a real failure
@@ -191,7 +149,7 @@ run_selftest_nodes() {
     else
       cp "$OUT/selftest_status/last_run.log" "$sf.transient.log" 2>/dev/null
       echo "$(date -u +%H:%M:%S)   selftest $node transient rc=$rc (retry next window)"
-      if ! probe; then return 1; fi
+      if ! probe "$WANT_BACKEND"; then return 1; fi
     fi
   done < "$OUT/selftest_nodes.run"
   return 0
@@ -255,7 +213,7 @@ finalize() {
 trap 'resume_suite; rm -f /tmp/tpu_live' EXIT
 
 while true; do
-  if ! probe; then
+  if ! probe "$WANT_BACKEND"; then
     rm -f /tmp/tpu_live
     echo "$(date -u +%H:%M:%S) tunnel down"
     sleep 90
@@ -287,17 +245,9 @@ while true; do
     # disagree on empty-string semantics.
     python - "$OUT/results/$b.err2" "$OUT/results/$b.part" "$WANT_BACKEND" <<'EOF'
 import json, sys
-rec = None
-try:
-    for line in open(sys.argv[1], errors="replace"):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                pass
-except OSError:
-    pass
+sys.path.insert(0, "tools")
+from last_json_line import last_json_line
+rec = last_json_line(sys.argv[1])
 if rec is not None:
     json.dump(rec, open(sys.argv[2], "w"))
 sys.exit(0 if rec is not None
@@ -316,7 +266,7 @@ EOF
     fi
     echo "$(date -u +%H:%M:%S)   $b failed (rc=$rc parse_ok=$ok)"
     rm -f "$OUT/results/$b.part"
-    if ! probe; then
+    if ! probe "$WANT_BACKEND"; then
       echo "$(date -u +%H:%M:%S) tunnel died mid-window; waiting"
       rm -f /tmp/tpu_live
       window_ok=0

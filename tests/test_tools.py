@@ -1,0 +1,184 @@
+"""Tests for the harvest/stamp tooling (tools/*.py).
+
+These scripts guard the round's on-chip evidence — a parsing or merge
+bug silently loses or mislabels TPU records — so their contracts are
+pinned here at the same level as the framework code (SURVEY.md §4
+test strategy: every layer that can corrupt results gets direct unit
+coverage).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from last_json_line import last_json_line  # noqa: E402
+
+
+def _rec(bench, backend="tpu", value=1.0, **kw):
+    r = {
+        "metric": f"{bench}_metric", "bench": bench, "value": value,
+        "unit": "u", "backend": backend, "window_values": [value],
+        "fingerprint_tflops_pre": 100.0, "fingerprint_tflops_post": 110.0,
+    }
+    r.update(kw)
+    return r
+
+
+class TestLastJsonLine:
+    def test_picks_last_parseable(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text(
+            "noise\n"
+            + json.dumps({"a": 1}) + "\n"
+            + "{broken json\n"
+            + json.dumps({"a": 2}) + "\n"
+            + "trailing noise\n"
+        )
+        assert last_json_line(str(p)) == {"a": 2}
+
+    def test_no_json_and_missing_file(self, tmp_path):
+        p = tmp_path / "log"
+        p.write_text("nothing here\n")
+        assert last_json_line(str(p)) is None
+        assert last_json_line(str(tmp_path / "absent")) is None
+
+    def test_cli_requirements(self, tmp_path):
+        log = tmp_path / "log"
+        out = tmp_path / "out.json"
+        log.write_text(json.dumps({"backend": "tpu", "v": 3}) + "\n")
+        ok = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "last_json_line.py"),
+             str(log), str(out), "backend=tpu"],
+            capture_output=True,
+        )
+        assert ok.returncode == 0
+        assert json.load(open(out))["v"] == 3
+        bad = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "last_json_line.py"),
+             str(log), str(out), "backend=cpu"],
+            capture_output=True,
+        )
+        assert bad.returncode == 1
+
+
+class TestHarvestMerge:
+    def _merge(self, tmp_path, recs, selftest=None):
+        d = tmp_path / "results"
+        d.mkdir()
+        for r in recs:
+            (d / f"{r['bench']}.json").write_text(json.dumps(r))
+        if selftest is not None:
+            (d / "selftest.json").write_text(json.dumps(
+                {"metric": "selftest", "selftest": selftest}
+            ))
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "harvest_merge.py"),
+             str(d)],
+            capture_output=True, text=True,
+        )
+        assert p.returncode == 0, p.stderr
+        return json.loads(p.stdout), p.stderr
+
+    def test_resnet50_heads_and_extras_ordered(self, tmp_path):
+        out, _ = self._merge(
+            tmp_path, [_rec("mnist"), _rec("resnet50"), _rec("gpt2")]
+        )
+        assert out["bench"] == "resnet50"
+        assert [e["bench"] for e in out["extras"]] == ["gpt2", "mnist"]
+        assert "resnet50" in out["harvested"]
+
+    def test_minority_backend_dropped_loudly(self, tmp_path):
+        out, err = self._merge(
+            tmp_path,
+            [_rec("resnet50"), _rec("gpt2"), _rec("mnist", backend="cpu")],
+        )
+        assert out["backend"] == "tpu"
+        assert all(e["bench"] != "mnist" for e in out["extras"])
+        assert "DROPPING mnist" in err
+
+    def test_tpu_preferred_even_as_minority(self, tmp_path):
+        out, _ = self._merge(
+            tmp_path,
+            [_rec("resnet50", backend="cpu"), _rec("gpt2", backend="cpu"),
+             _rec("mnist", backend="tpu")],
+        )
+        assert out["backend"] == "tpu"
+        assert out["bench"] == "mnist"
+
+    def test_head_keeps_own_fingerprints_spread_is_window_wide(
+        self, tmp_path
+    ):
+        recs = [
+            _rec("resnet50", fingerprint_tflops_pre=500.0,
+                 fingerprint_tflops_post=600.0),
+            # A wedged post-probe: must reach the spread, not the head.
+            _rec("moe", fingerprint_tflops_pre=450.0,
+                 fingerprint_tflops_post=78.0),
+        ]
+        out, _ = self._merge(tmp_path, recs)
+        assert out["fingerprint_tflops_pre"] == 500.0
+        assert out["fingerprint_tflops_post"] == 600.0
+        assert out["fingerprint_spread"] == [78.0, 600.0]
+
+    def test_truncated_lists_missing_and_selftest_carried(self, tmp_path):
+        st = {"ok": True, "summary": "9/9"}
+        out, _ = self._merge(tmp_path, [_rec("resnet50")], selftest=st)
+        assert out["selftest"] == st
+        assert "gpt2" in out["truncated"]
+
+    def test_nested_sweep_keys_stripped(self, tmp_path):
+        out, _ = self._merge(
+            tmp_path,
+            [_rec("resnet50", tpu_harvest={"old": 1}, extras=[{"x": 1}],
+                  harvested=["resnet50"])],
+        )
+        assert "tpu_harvest" not in out
+        assert out["extras"] == []
+        assert out["harvested"] == ["resnet50"]
+
+
+class TestStampFloors:
+    def _stamp(self, tmp_path, record):
+        p = tmp_path / "merged.json"
+        p.write_text(json.dumps(record))
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "stamp_floors.py"),
+             str(p)],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        return r.stdout
+
+    def test_per_record_fingerprints_and_unfloored_exclusion(self, tmp_path):
+        head = _rec("resnet50", fingerprint_tflops_pre=500.0)
+        head["rel_mfu"] = 0.08
+        diag = _rec("decode_grid", fingerprint_tflops_pre=470.0)
+        diag["metric"] = "decode_grid_step_time_ratio"
+        other = _rec("gpt2", fingerprint_tflops_pre=480.0)
+        head["extras"] = [other, diag]
+        out = self._stamp(tmp_path, head)
+        assert '"resnet50_metric": (1.0, 500.0),' in out
+        assert '"gpt2_metric": (1.0, 480.0),' in out
+        # The diagnostic must appear only as a comment, never a floor.
+        assert '"decode_grid_step_time_ratio": (' not in out
+        assert "deliberately unfloored" in out
+        assert '"resnet50_metric": 0.08,' in out  # rel_mfu section
+
+    def test_errored_metrics_flagged_not_stamped(self, tmp_path):
+        head = _rec("resnet50", fingerprint_tflops_pre=500.0)
+        head["extras"] = [{"metric": "bert_metric", "bench": "bert",
+                           "error": "boom", "backend": "tpu"}]
+        out = self._stamp(tmp_path, head)
+        assert "ERRORED" in out
+        assert "'bert'" in out or "bert" in out
+        assert '"bert_metric": (' not in out
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -20,37 +20,20 @@ Emits ONE JSON line; safe to run under `timeout` (partial results are
 emitted by the same always-emit pattern bench.py uses).
 """
 
-import json
 import os
 import statistics
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402  (repo-root bench.py: probes + timing helpers)
+from tools.diag_common import (  # noqa: E402
+    enable_compile_cache, make_emit, parse_budget, start_watchdog,
+)
 
 OUT: dict = {"diag": "smallstep"}
-
-
-def _emit(truncated: bool = False) -> None:
-    # The watchdog emits a truncated snapshot at budget-15s (so the
-    # outer run_bounded's SIGKILL can never discard the COMPLETED
-    # sweeps), and main emits the full record on normal exit; consumers
-    # (tools/diag_watch.sh) take the LAST parseable line, so a main
-    # that finishes inside run_bounded's headroom wins over the
-    # snapshot. Snapshot a shallow copy: the timer thread dumps while
-    # main still assigns keys, and the C encoder raises on a dict that
-    # changes size mid-iteration.
-    try:
-        rec = dict(OUT)
-        if truncated:
-            rec["truncated"] = True
-        sys.stdout.write(json.dumps(rec) + "\n")
-        sys.stdout.flush()
-    except Exception:  # a racing snapshot must not kill the run
-        pass
+_emit = make_emit(OUT)
 
 
 def _cifar_step_time(batch: int, steps: int = 30) -> dict:
@@ -107,17 +90,15 @@ def _bert_step_time(batch: int, steps: int = 20) -> dict:
 
 
 def main() -> int:
-    budget = 600.0
-    for a in sys.argv[1:]:
-        if a.startswith("--budget="):
-            budget = float(a.split("=", 1)[1])
+    budget = parse_budget(sys.argv[1:])
     deadline = time.monotonic() + budget
-    watchdog = threading.Timer(max(budget - 15.0, 5.0), _emit, (True,))
-    watchdog.daemon = True
-    watchdog.start()
+    watchdog = start_watchdog(budget, _emit)
     try:
         bench.BACKEND = bench._resolve_backend()
         OUT["backend"] = bench.BACKEND
+        if bench.BACKEND == "tpu":
+            # Retry windows re-pay trainer-step compiles otherwise.
+            enable_compile_cache()
         OUT["launch_us"] = round(bench._probe_launch_us(), 2)
         OUT["probe_tflops"] = round(bench._probe_quick(), 2)
         tpu = bench.BACKEND == "tpu"

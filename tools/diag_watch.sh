@@ -1,22 +1,28 @@
 #!/bin/bash
 # Follow-on to tools/tpu_harvest.sh: wait for the harvest loop to exit
 # (it exits only after all benches + all selftest nodes are banked),
-# then run the small-step diagnosis (tools/diag_smallstep.py) on the
-# next live window and bank its record to docs/tpu_sweeps/. Exists so
-# a live window arriving mid-session is never wasted waiting for a
-# human turn: harvest → diag chains unattended.
+# then spend subsequent live windows on the queued one-shot
+# measurements, each banked to docs/tpu_sweeps/ the moment it
+# completes and never re-run:
+#   1. tools/diag_smallstep.py — overhead-vs-kernel classification for
+#      the bert/cifar10 sub-floor readings (BASELINE.md round-4);
+#   2. tools/flash_tune.py — flash-attention block-size sweep so the
+#      kernel default rests on a measured table, not one point.
+# Exists so a live window arriving mid-session is never wasted waiting
+# for a human turn: harvest → diag → tune chains unattended.
 set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-/tmp/tpu_diag}
-DEST=${2:-docs/tpu_sweeps/round4_diag.json}
-mkdir -p "$OUT" "$(dirname "$DEST")"
+DIAG_DEST=${2:-docs/tpu_sweeps/round4_diag.json}
+TUNE_DEST=${3:-docs/tpu_sweeps/round4_flash_tune.json}
+mkdir -p "$OUT" "$(dirname "$DIAG_DEST")" "$(dirname "$TUNE_DEST")"
 . tools/lib_bounded.sh
 
 echo "diag_watch: waiting for tpu_harvest to finish"
 # Startup grace: a harvest launched in the same breath may not have a
 # process entry yet — without this, the pgrep below sees nothing and
-# diag runs CONCURRENTLY with the harvest, contending for the tunnel
-# and interleaving pause/resume_suite with the harvest's.
+# the stages run CONCURRENTLY with the harvest, contending for the
+# tunnel and interleaving pause/resume_suite with the harvest's.
 sleep 90
 # Anchored like lib_bounded.sh's pause_suite — an unanchored match
 # would also hit any long-lived process whose cmdline merely MENTIONS
@@ -25,13 +31,40 @@ sleep 90
 while pgrep -f "^[^ ]*bash .*tools/tpu_harvest.sh" > /dev/null 2>&1; do
   sleep 60
 done
-echo "$(date -u +%H:%M:%S) harvest gone — watching for a live window"
+echo "$(date -u +%H:%M:%S) harvest gone — watching for live windows"
 
 trap 'resume_suite' EXIT
 
+# bank_last_json LOG DEST GATE — fish the last parseable JSON line out
+# of LOG (always-emit children may print a truncated snapshot before
+# the full record) and write it to DEST iff GATE (a python expression
+# over `rec`) holds. Returns 0 on bank.
+bank_last_json() {
+  python - "$1" "$2" "$3" <<'EOF'
+import json, sys
+sys.path.insert(0, "tools")
+from last_json_line import last_json_line
+rec = last_json_line(sys.argv[1])
+ok = rec is not None and bool(eval(sys.argv[3], {"rec": rec, "len": len}))
+if ok:
+    json.dump(rec, open(sys.argv[2], "w"))
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# Parenthesized: these are eval()'d as single expressions, and a bare
+# newline between `and` clauses would be a SyntaxError.
+DIAG_GATE='(rec.get("backend") == "tpu" and "error" not in rec
+and len(rec.get("cifar10") or []) >= 2 and len(rec.get("bert") or []) >= 2)'
+# flash_tune marks rec["complete"] only when every shape's full cell
+# table timed inside the budget — banking anything less would freeze a
+# partial table forever (the [ -s ] check never re-runs a stage).
+TUNE_GATE='bool(rec.get("complete"))'
+
 while true; do
+  [ -s "$DIAG_DEST" ] && [ -s "$TUNE_DEST" ] && { echo "all banked"; exit 0; }
   # Belt-and-braces: /tmp/tpu_live is touched by an actively-harvesting
-  # window; never time the diag against a concurrent harvest even if
+  # window; never time a stage against a concurrent harvest even if
   # the pgrep wait was somehow skipped.
   if [ -f /tmp/tpu_live ]; then
     echo "$(date -u +%H:%M:%S) harvest window active; deferring"
@@ -43,31 +76,30 @@ while true; do
     sleep 90
     continue
   fi
-  echo "$(date -u +%H:%M:%S) TUNNEL LIVE — running diag_smallstep"
-  pause_suite
-  run_bounded 700 "$OUT/diag.log" python tools/diag_smallstep.py --budget=600
-  resume_suite
-  # Bank the last parseable JSON line (always-emit children may print a
-  # truncated snapshot before the full record) iff it is a TPU record
-  # carrying at least the two batch points per workload the
-  # overhead-vs-kernel classification needs — else retry next window.
-  if python - "$OUT/diag.log" "$DEST" <<'EOF'
-import json, sys
-sys.path.insert(0, "tools")
-from last_json_line import last_json_line
-rec = last_json_line(sys.argv[1])
-ok = (rec is not None and rec.get("backend") == "tpu"
-      and "error" not in rec
-      and len(rec.get("cifar10") or []) >= 2
-      and len(rec.get("bert") or []) >= 2)
-if ok:
-    json.dump(rec, open(sys.argv[2], "w"))
-sys.exit(0 if ok else 1)
-EOF
-  then
-    echo "$(date -u +%H:%M:%S) diag banked: $DEST"
-    exit 0
+  if [ ! -s "$DIAG_DEST" ]; then
+    echo "$(date -u +%H:%M:%S) TUNNEL LIVE — diag_smallstep"
+    pause_suite
+    run_bounded 700 "$OUT/diag.log" python tools/diag_smallstep.py --budget=600
+    resume_suite
+    if bank_last_json "$OUT/diag.log" "$DIAG_DEST" "$DIAG_GATE"; then
+      echo "$(date -u +%H:%M:%S) diag banked: $DIAG_DEST"
+    else
+      echo "$(date -u +%H:%M:%S) diag incomplete (see $OUT/diag.log); retrying"
+      sleep 90
+      continue
+    fi
   fi
-  echo "$(date -u +%H:%M:%S) diag incomplete (see $OUT/diag.log); retrying"
-  sleep 90
+  if [ ! -s "$TUNE_DEST" ]; then
+    if ! probe tpu; then continue; fi
+    echo "$(date -u +%H:%M:%S) TUNNEL LIVE — flash_tune"
+    pause_suite
+    run_bounded 700 "$OUT/tune.log" python tools/flash_tune.py --budget=600
+    resume_suite
+    if bank_last_json "$OUT/tune.log" "$TUNE_DEST" "$TUNE_GATE"; then
+      echo "$(date -u +%H:%M:%S) tune banked: $TUNE_DEST"
+    else
+      echo "$(date -u +%H:%M:%S) tune incomplete (see $OUT/tune.log); retrying"
+      sleep 90
+    fi
+  fi
 done

@@ -180,5 +180,71 @@ class TestStampFloors:
         assert '"bert_metric": (' not in out
 
 
+class TestDiagCommon:
+    def test_parse_budget(self):
+        from diag_common import parse_budget
+
+        assert parse_budget(["--budget=42.5"]) == 42.5
+        assert parse_budget(["--other"], default=9.0) == 9.0
+
+    def test_make_emit_last_line_wins(self, tmp_path, capsys):
+        from diag_common import make_emit
+
+        out = {"a": 1}
+        emit = make_emit(out)
+        emit(True)  # watchdog snapshot
+        out["b"] = 2
+        emit()  # main's full record
+        lines = [
+            json.loads(l) for l in capsys.readouterr().out.splitlines()
+        ]
+        assert lines[0] == {"a": 1, "truncated": True}
+        assert lines[-1] == {"a": 1, "b": 2}
+        # and the consumer contract picks the full record:
+        p = tmp_path / "log"
+        p.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+        assert last_json_line(str(p)) == {"a": 1, "b": 2}
+
+    def test_watchdog_emits_then_cancel_suppresses(self, capsys):
+        import time as _time
+
+        from diag_common import make_emit, start_watchdog
+
+        t = start_watchdog(5.0, make_emit({"x": 1}))  # floor: fires at 5s...
+        t.cancel()  # ...unless cancelled first
+        _time.sleep(0.1)
+        assert capsys.readouterr().out == ""
+
+
+class TestFlashTuneSweep:
+    def test_sweep_shape_interpret_cells_and_best(self):
+        """Sweep mechanics on a tiny interpret-mode shape: legal cells
+        only, best_* selected by min, deadline truncation honored."""
+        import time as _time
+
+        import flash_tune
+
+        rec = flash_tune._sweep_shape(
+            "tiny", 1, 1, 128, 8, True, 1, _time.monotonic() + 600
+        )
+        # seq 128 admits only the (128, 128) cell out of BLOCKS^2.
+        assert [c["block_q"] for c in rec["cells"]] == [128]
+        assert rec["best_fwd"] == rec["cells"][0]
+        assert rec["best_fwdbwd"] == rec["cells"][0]
+        assert "truncated" not in rec
+
+    def test_sweep_shape_deadline_truncates(self):
+        import time as _time
+
+        import flash_tune
+
+        rec = flash_tune._sweep_shape(
+            "tiny", 1, 1, 128, 8, True, 1, _time.monotonic() - 1.0
+        )
+        assert rec["truncated"] is True
+        assert rec["cells"] == []
+        assert "best_fwd" not in rec
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))

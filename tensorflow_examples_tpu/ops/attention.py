@@ -113,7 +113,7 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_kv]
         if has_bias:
-            s = s + kb_ref[...]  # [1, block_kv] broadcasts over rows
+            s = s + kb_ref[0]  # [1, block_kv] broadcasts over rows
         if causal:
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
@@ -160,12 +160,17 @@ def _flash_fwd(
     ]
     args = (q, k, v)
     if kb is not None:
-        # [batch, seq_kv] tile; grid dim 0 is batch·heads, so the batch
+        # Carried as [batch, 1, seq_kv]: Mosaic constrains the LAST TWO
+        # dims of a block to (8k, 128k) or the full array dim, so a
+        # rank-2 [batch, seq_kv] bias with a (1, block_kv) block is
+        # unlowerable whenever batch > 1 (compiled-TPU-only failure;
+        # interpret mode never enforces it). Rank-3 puts batch outside
+        # the constrained dims. Grid dim 0 is batch·heads, so the batch
         # row is program_id(0) // heads (static closure).
         in_specs.append(
-            pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j))
+            pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // heads, 0, j))
         )
-        args = args + (kb,)
+        args = args + (kb[:, None, :],)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -226,7 +231,7 @@ def _bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_kv]
         if has_bias:
-            s = s + kb_ref[...]
+            s = s + kb_ref[0]
         if causal:
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
@@ -294,7 +299,7 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
         if has_bias:
-            s = s + kb_ref[...]
+            s = s + kb_ref[0]
         if causal:
             row = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0
@@ -341,13 +346,16 @@ def _flash_bwd(
     q_blk = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, j, 0))
     kv_blk = pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, i, 0))
     vec_blk = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
-    # In the dkv grid the KV block index is grid dim 1 (i).
-    kb_blk = pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, i))
+    # Bias rides as [batch, 1, seq_kv] — see _flash_fwd's spec note on
+    # Mosaic's last-two-dims block constraint. In the dkv grid the KV
+    # block index is grid dim 1 (i).
+    kb3 = kb[:, None, :] if has_bias else None
+    kb_blk = pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // heads, 0, i))
     in_specs = [q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk]
     args = (q, k, v, do, lse, delta, dlse)
     if has_bias:
         in_specs.append(kb_blk)
-        args = args + (kb,)
+        args = args + (kb3,)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -371,12 +379,12 @@ def _flash_bwd(
     q_blk = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
     kv_blk = pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0))
     vec_blk = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
-    kb_blk = pl.BlockSpec((1, block_kv), lambda b, i, j: (b // heads, j))
+    kb_blk = pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // heads, 0, j))
     in_specs = [q_blk, kv_blk, kv_blk, q_blk, vec_blk, vec_blk, vec_blk]
     args = (q, k, v, do, lse, delta, dlse)
     if has_bias:
         in_specs.append(kb_blk)
-        args = args + (kb,)
+        args = args + (kb3,)
 
     dq = pl.pallas_call(
         functools.partial(

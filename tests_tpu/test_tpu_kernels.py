@@ -99,6 +99,31 @@ def test_flash_key_bias_compiled_parity():
     assert _max_abs(out, ref) < 2e-2
 
 
+def test_flash_key_bias_bwd_compiled_parity():
+    # The Mosaic rank-2 block constraint that broke the fwd bias spec
+    # applied equally to both bwd kernels' kb specs; prove them compiled
+    # too (interpret mode never enforces the constraint).
+    q, k, v = _qkv(2, 12, 512, 64, seed=6)
+    kb = jnp.where(
+        jnp.arange(512)[None] < jnp.asarray([512, 300])[:, None], 0.0, -1e30
+    ).astype(jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(8), q.shape, q.dtype)
+
+    def grads(f):
+        def inner(q, k, v):
+            return jnp.sum(f(q, k, v).astype(jnp.float32) * g.astype(jnp.float32))
+
+        return jax.jit(jax.grad(inner, argnums=(0, 1, 2)))
+
+    flash = lambda q, k, v: flash_attention(
+        q, k, v, causal=False, key_bias=kb, interpret=False
+    )
+    ref = lambda q, k, v: attention_reference(q, k, v, causal=False, key_bias=kb)
+    for got, want in zip(grads(flash)(q, k, v), grads(ref)(q, k, v)):
+        band = 2e-2 * (1.0 + float(jnp.max(jnp.abs(want.astype(jnp.float32)))))
+        assert _max_abs(got, want) < band
+
+
 def test_flash_decode_compiled_parity():
     from tensorflow_examples_tpu.ops.decode import (
         decode_attention_reference,

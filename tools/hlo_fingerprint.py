@@ -86,13 +86,32 @@ def main() -> int:
     ca = c.cost_analysis()
     ca = ca[0] if isinstance(ca, list) else ca
     hlo = c.as_text()
-    # Opcode after `= <type>`: the type may be a spaced tuple
-    # `(f32[2], u32[])` and opcodes may be hyphenated (`all-reduce`,
-    # `get-tuple-element`) — a naive `\S+ (\w+)\(` drops the former
-    # and mis-buckets the latter.
-    ops = collections.Counter(
-        re.findall(r"=\s+(?:\([^)]*\)|\S+)\s+([\w-]+)\(", hlo)
-    )
+    # Opcode after `= <type>`: the type may be a tuple — possibly
+    # NESTED, e.g. a while carrying `(f32[2]{0}, (s32[], u32[]))` —
+    # and opcodes may be hyphenated (`all-reduce`, `get-tuple-element`).
+    # A regex `\([^)]*\)` stops at the first `)`, silently dropping
+    # nested-tuple ops (exactly the control-flow ops a perf diff cares
+    # about), so tuple types are skipped by balanced-paren scan.
+    def _opcodes(text):
+        for line in text.splitlines():
+            m = re.search(r"=\s+", line)
+            if not m:
+                continue
+            i, n = m.end(), len(line)
+            if i < n and line[i] == "(":
+                depth = 0
+                while i < n:
+                    depth += (line[i] == "(") - (line[i] == ")")
+                    i += 1
+                    if depth == 0:
+                        break
+                m2 = re.match(r"\s*([\w-]+)\(", line[i:])
+            else:
+                m2 = re.match(r"\S+\s+([\w-]+)\(", line[i:])
+            if m2:
+                yield m2.group(1)
+
+    ops = collections.Counter(_opcodes(hlo))
     print(json.dumps({
         "workload": which,
         "batch": cfg.global_batch_size,

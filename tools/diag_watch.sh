@@ -63,6 +63,7 @@ TUNE_GATE='bool(rec.get("complete"))'
 
 while true; do
   [ -s "$DIAG_DEST" ] && [ -s "$TUNE_DEST" ] && { echo "all banked"; exit 0; }
+  defer_for_driver_bench
   # Belt-and-braces: /tmp/tpu_live is touched by an actively-harvesting
   # window; never time a stage against a concurrent harvest even if
   # the pgrep wait was somehow skipped.
@@ -90,6 +91,7 @@ while true; do
     fi
   fi
   if [ ! -s "$TUNE_DEST" ]; then
+    defer_for_driver_bench
     if ! probe tpu; then continue; fi
     echo "$(date -u +%H:%M:%S) TUNNEL LIVE — flash_tune"
     pause_suite

@@ -118,6 +118,7 @@ run_selftest_nodes() {
   while IFS= read -r node; do
     sf=$(node_status_file "$node")
     [ -s "$sf" ] && continue
+    defer_for_driver_bench 0
     bump_attempts "$OUT/attempts/$(echo "$node" | tr '/:[] ' '_____').attempts"
     echo "$(date -u +%H:%M:%S)   selftest $node"
     run_bounded 460 "$OUT/selftest_status/last_run.log" \
@@ -213,6 +214,7 @@ finalize() {
 trap 'resume_suite; rm -f /tmp/tpu_live' EXIT
 
 while true; do
+  defer_for_driver_bench
   if ! probe "$WANT_BACKEND"; then
     rm -f /tmp/tpu_live
     echo "$(date -u +%H:%M:%S) tunnel down"
@@ -226,6 +228,9 @@ while true; do
   mkdir -p "$OUT/attempts"
   for b in $(printf '%s\n' $BENCH_ORDER | order_by_attempts "$OUT/attempts"); do
     [ -s "$OUT/results/$b.json" ] && continue
+    # A driver bench can start mid-window; never time a bench against
+    # it (suite already paused by this window — don't manage it).
+    defer_for_driver_bench 0
     bump_attempts "$OUT/attempts/$b.attempts"
     bud=$(budget_for "$b")
     echo "$(date -u +%H:%M:%S)   bench $b (budget ${bud}s)"

@@ -101,3 +101,10 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
     axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
     return NamedSharding(mesh, P(axes if axes else None))
+
+
+def bundle_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [k, global_batch, ...] step bundle: the scan axis
+    (dim 0) is unsharded; the batch dim behind it shards exactly as
+    ``batch_sharding`` does (derived from it, not re-filtered)."""
+    return NamedSharding(mesh, P(None, *batch_sharding(mesh).spec))

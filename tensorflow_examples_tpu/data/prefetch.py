@@ -43,6 +43,34 @@ def put_local_batch(batch, sharding):
     )
 
 
+def bundle_batches(it: Iterator, k: int) -> Iterator:
+    """Stack ``k`` consecutive host batches along a new leading axis.
+
+    Feeds the ``steps_per_launch`` bundled train step: each yielded
+    pytree has leaves shaped ``[k, batch, ...]``, scanned on device one
+    step per slice. Exhaustion mid-bundle is an error — silently
+    dropping a partial bundle would skip steps the unbundled loop
+    would have run (the loop validates the step span divides by k, so
+    a well-sized stream never hits this).
+    """
+    import numpy as np
+
+    while True:
+        group = []
+        for _ in range(k):
+            try:
+                group.append(next(it))
+            except StopIteration:
+                if group:
+                    raise ValueError(
+                        f"input stream ended mid-bundle ({len(group)}/{k} "
+                        "batches); size the stream to a multiple of "
+                        "steps_per_launch"
+                    ) from None
+                return
+        yield jax.tree.map(lambda *xs: np.stack(xs), *group)
+
+
 def device_prefetch(
     it: Iterator, sharding, *, depth: int = 2, local_batches: bool = False
 ) -> Iterator:

@@ -32,6 +32,10 @@ class TrainConfig:
     weight_decay: float = 0.0
     grad_clip_norm: float = 0.0  # 0 disables
     grad_accum_steps: int = 1
+    steps_per_launch: int = 1  # run K train steps per device launch via
+    #   lax.scan (the Keras steps_per_execution equivalent): amortizes
+    #   per-launch dispatch cost for small steps. Cadences (log/eval/
+    #   checkpoint) and the step span must be multiples of K.
     precision: str = "bf16"  # f32 | bf16 | bf16_full
     remat: bool = False  # jax.checkpoint the model apply
     zero1: bool = False  # shard optimizer state over the batch axes even

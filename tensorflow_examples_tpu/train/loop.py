@@ -36,9 +36,14 @@ from tensorflow_examples_tpu.core.rng import step_rng
 from tensorflow_examples_tpu.core.sharding import (
     _path_str,
     batch_sharding,
+    bundle_sharding,
     shardings_for_params,
 )
-from tensorflow_examples_tpu.data.prefetch import device_prefetch, put_batch
+from tensorflow_examples_tpu.data.prefetch import (
+    bundle_batches,
+    device_prefetch,
+    put_batch,
+)
 from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
 from tensorflow_examples_tpu.train.config import TrainConfig
 from tensorflow_examples_tpu.train.state import TrainState
@@ -181,7 +186,7 @@ class Trainer:
 
     # ------------------------------------------------------------- steps
 
-    def _build_train_step(self):
+    def _make_train_step_fn(self):
         task, policy = self.task, self.policy
         seed_key = jax.random.PRNGKey(self.config.seed + 1)
 
@@ -216,10 +221,36 @@ class Trainer:
             )
             return new_state, metrics
 
+        return train_step
+
+    def _build_train_step(self):
         state_sh = self._state_shardings(jax.eval_shape(lambda s: s, self.state))
         return jax.jit(
-            train_step,
+            self._make_train_step_fn(),
             in_shardings=(state_sh, self._batch_sharding),
+            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,),
+        )
+
+    def _build_bundled_step(self, k: int):
+        """K train steps in ONE device launch: ``lax.scan`` over a
+        ``[k, batch, ...]`` bundle (the TPU-native equivalent of Keras's
+        ``steps_per_execution``). The per-step program is the same
+        ``train_step`` the unbundled path jits — same RNG stream (keyed
+        off ``state.step``, which the scan carry advances), same
+        optimizer (``optax.MultiSteps`` grad accumulation ticks per scan
+        iteration) — so K scanned steps match K separate launches; only
+        the host dispatch cost is amortized K-fold. Metrics come back
+        stacked ``[k]`` per key."""
+        train_step = self._make_train_step_fn()
+
+        def bundled(state: TrainState, batches):
+            return jax.lax.scan(train_step, state, batches)
+
+        state_sh = self._state_shardings(jax.eval_shape(lambda s: s, self.state))
+        return jax.jit(
+            bundled,
+            in_shardings=(state_sh, bundle_sharding(self.mesh)),
             out_shardings=(state_sh, NamedSharding(self.mesh, P())),
             donate_argnums=(0,),
         )
@@ -303,46 +334,96 @@ class Trainer:
                 train_iter = train_data(start_step)
             else:
                 train_iter = train_data
+
+            k = max(int(getattr(cfg, "steps_per_launch", 1) or 1), 1)
+            if k > 1:
+                cadences = {
+                    # Cadences fire on (step+1) % cadence == 0 and step+1
+                    # only takes values start_step + i*k, so BOTH the
+                    # phase (start_step) and each period must divide by k
+                    # or periodic events silently never fire.
+                    "start step (resume phase)": start_step,
+                    "train step span": num_steps - start_step,
+                    "log_every": cfg.log_every,
+                    "eval_every": cfg.eval_every if eval_iter_fn else 0,
+                    "checkpoint_every": cfg.checkpoint_every
+                    if self._ckpt
+                    else 0,
+                }
+                bad = {n: v for n, v in cadences.items() if v and v % k}
+                if bad:
+                    raise ValueError(
+                        f"steps_per_launch={k} requires every active loop "
+                        f"cadence to be a multiple of it; offending: {bad} "
+                        "(a resumed checkpoint from an unbundled run may "
+                        "leave the step span unaligned)"
+                    )
+            step_fn = self._train_step if k == 1 else self._build_bundled_step(k)
+
             # Async look-ahead transfer: batch N+1 streams into HBM while
             # step N runs (the reference's prefetch-to-device equivalent).
+            # For bundles, K host batches stack before the (single) put.
             train_iter = device_prefetch(
-                train_iter,
-                self._batch_sharding,
+                train_iter if k == 1 else bundle_batches(train_iter, k),
+                self._batch_sharding if k == 1 else bundle_sharding(self.mesh),
                 local_batches=local_batches and jax.process_count() > 1,
             )
 
             profiling = False
+            profiled = False  # one-shot: the trace covers steps ~10-20 once
             evaluated_now = False
             window: list[Mapping[str, jax.Array]] = []
             last: dict[str, float] = {}
             t_window = time.perf_counter()
-            for step in range(start_step, num_steps):
-                if cfg.profile and step == start_step + 10 and not profiling:
+            for chunk in range(start_step, num_steps, k):
+                # step = index of the chunk's LAST train step; with k == 1
+                # this loop is exactly the historical per-step loop.
+                step = chunk + k - 1
+                if (
+                    cfg.profile
+                    and not profiling
+                    and not profiled
+                    and chunk - start_step >= 10
+                ):
                     jax.profiler.start_trace(cfg.workdir or "/tmp/tpu_profile")
                     profiling = True
                 batch = next(train_iter)
-                self.state, metrics = self._train_step(self.state, batch)
+                self.state, metrics = step_fn(self.state, batch)
                 if watchdog is not None:
                     # Dispatch is async; sync points (log flushes) bound
                     # how stale this is — good enough for hang detection.
                     watchdog.resume()
                     watchdog.ping(step)
                 window.append(metrics)
-                if profiling and step == start_step + 20:
+                if profiling and step - start_step >= 20:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
                     profiling = False
+                    profiled = True
 
                 if (cfg.log_every and (step + 1) % cfg.log_every == 0) or (
                     step + 1 == num_steps
                 ):
                     jax.block_until_ready(metrics)
                     dt = time.perf_counter() - t_window
+                    # Bundled metrics are [k]-vectors per key; scalars and
+                    # vectors average identically through ravel+concat.
                     last = {
-                        k: float(np.mean([float(m[k]) for m in window]))
-                        for k in window[0]
+                        key: float(
+                            np.mean(
+                                np.concatenate(
+                                    [
+                                        np.ravel(
+                                            np.asarray(m[key], np.float32)
+                                        )
+                                        for m in window
+                                    ]
+                                )
+                            )
+                        )
+                        for key in window[0]
                     }
-                    steps_done = len(window)
+                    steps_done = len(window) * k
                     last["steps_per_sec"] = steps_done / dt
                     last["examples_per_sec"] = (
                         steps_done * cfg.global_batch_size / dt

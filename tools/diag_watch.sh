@@ -63,15 +63,17 @@ TUNE_GATE='bool(rec.get("complete"))'
 
 while true; do
   [ -s "$DIAG_DEST" ] && [ -s "$TUNE_DEST" ] && { echo "all banked"; exit 0; }
-  defer_for_driver_bench
   # Belt-and-braces: /tmp/tpu_live is touched by an actively-harvesting
   # window; never time a stage against a concurrent harvest even if
-  # the pgrep wait was somehow skipped.
+  # the pgrep wait was somehow skipped. Checked BEFORE the driver-bench
+  # defer so the defer's suite resume can't fire inside a live window.
   if [ -f /tmp/tpu_live ]; then
     echo "$(date -u +%H:%M:%S) harvest window active; deferring"
     sleep 90
     continue
   fi
+  defer_for_driver_bench
+  [ -f /tmp/tpu_live ] && continue
   if ! probe tpu; then
     echo "$(date -u +%H:%M:%S) tunnel down"
     sleep 90
@@ -92,6 +94,7 @@ while true; do
   fi
   if [ ! -s "$TUNE_DEST" ]; then
     defer_for_driver_bench
+    [ -f /tmp/tpu_live ] && continue
     if ! probe tpu; then continue; fi
     echo "$(date -u +%H:%M:%S) TUNNEL LIVE — flash_tune"
     pause_suite

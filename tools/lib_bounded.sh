@@ -84,6 +84,9 @@ defer_for_driver_bench() {
   if [ "$waited" -ge 900 ]; then
     echo "$(date -u +%H:%M:%S) driver bench still matching after 900s wedged; proceeding"
   fi
-  [ "$waited" -gt 0 ] && [ "$manage" = 1 ] && resume_suite
+  # A harvest window may have gone live DURING the wait; its own
+  # pause_suite already ran at window start, and resuming here would
+  # undo it mid-window (pause/resume is not refcounted).
+  [ "$waited" -gt 0 ] && [ "$manage" = 1 ] && [ ! -f /tmp/tpu_live ] && resume_suite
   true
 }

@@ -264,6 +264,95 @@ def test_two_process_tp_matches_single_process():
     np.testing.assert_allclose(losses[0], ref, rtol=2e-5, atol=1e-6)
 
 
+_LOCAL_BATCH_WORKER = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from tensorflow_examples_tpu.core import distributed
+
+    rank = int(sys.argv[1])
+    distributed.initialize(
+        coordinator_address=sys.argv[2], num_processes=2, process_id=rank
+    )
+
+    from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.data.sources import synthetic_images
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import mnist
+
+    cfg = mnist.MnistConfig(
+        global_batch_size=16, train_steps=6, hidden=32, num_layers=1,
+        precision="f32", log_every=6, checkpoint_every=0, watchdog_secs=0,
+        steps_per_launch=2,
+    )
+    mesh = create_mesh(MeshConfig(data=2))
+    trainer = Trainer(mnist.make_task(cfg), cfg, mesh=mesh)
+    ds = synthetic_images(n=128, shape=(28, 28, 1), num_classes=10, seed=0)
+
+    def local_iter(start_step):
+        # PER-HOST semantics: each process yields only ITS half of every
+        # global batch (rank 0 rows 0-7, rank 1 rows 8-15), as a per-host
+        # TFRecord shard reader would; put_local_batch assembles the
+        # global [16, ...] array (stacked [2, 16, ...] under bundling).
+        rows = cfg.global_batch_size // 2
+        for b in train_iterator(ds, cfg.global_batch_size, seed=0):
+            yield {k: v[rank * rows : (rank + 1) * rows] for k, v in b.items()}
+
+    m = trainer.fit(
+        local_iter, num_steps=cfg.train_steps, local_batches=True
+    )
+    print(f"FINAL {rank} {m['loss']:.8f} {m['accuracy']:.8f}", flush=True)
+    """
+)
+
+
+@pytest.mark.timeout(300)
+def test_two_process_local_batches_bundled_matches_global():
+    """The per-host input path (fit(local_batches=True) →
+    put_local_batch / make_array_from_process_local_data), COMBINED
+    with steps_per_launch bundling: two processes each feeding disjoint
+    halves of every global batch must reproduce the single-process
+    global-view run on the same mesh shape — same data, same program,
+    same window-mean metrics."""
+    import jax
+
+    from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.data.sources import synthetic_images
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import mnist
+
+    outs = _run_workers(_LOCAL_BATCH_WORKER, timeout=270)
+    got = {}
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("FINAL")][0]
+        _, rank, loss, acc = line.split()
+        got[int(rank)] = (float(loss), float(acc))
+    assert set(got) == {0, 1}
+    assert got[0] == got[1], got  # identical merged metrics on both ranks
+
+    # Single-process global-view reference: same data=2 mesh shape over
+    # two of this process's fake devices, same bundled config, the SAME
+    # global batches fed whole.
+    cfg = mnist.MnistConfig(
+        global_batch_size=16, train_steps=6, hidden=32, num_layers=1,
+        precision="f32", log_every=6, checkpoint_every=0, watchdog_secs=0,
+        steps_per_launch=2,
+    )
+    mesh = create_mesh(MeshConfig(data=2), devices=jax.devices()[:2])
+    trainer = Trainer(mnist.make_task(cfg), cfg, mesh=mesh)
+    ds = synthetic_images(n=128, shape=(28, 28, 1), num_classes=10, seed=0)
+    ref = trainer.fit(
+        train_iterator(ds, cfg.global_batch_size, seed=0),
+        num_steps=cfg.train_steps,
+    )
+    assert abs(got[0][0] - ref["loss"]) < 1e-5, (got[0], ref["loss"])
+    assert abs(got[0][1] - ref["accuracy"]) < 1e-6, (got[0], ref["accuracy"])
+
+
 @pytest.mark.timeout(180)
 def test_two_process_training():
     outs = _run_workers(_WORKER)

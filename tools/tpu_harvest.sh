@@ -166,10 +166,14 @@ selftest_done() {
 }
 
 write_selftest_record() {
-  selftest_done || return 0
-  # Status files are the single source of truth: line 1 = pass/fail,
-  # line 2 = the node id (so this reader never re-derives the shell's
-  # filename sanitization).
+  # Emitted even PARTIAL (some nodes still unattempted/wedged): the
+  # banked per-node passes are on-chip evidence and must survive the
+  # tunnel never reviving — `ok` stays strict (every node passed), and
+  # `complete` says whether the whole suite has run. Status files are
+  # the single source of truth: line 1 = pass/fail, line 2 = the node
+  # id (so this reader never re-derives the shell's filename
+  # sanitization).
+  [ -s "$OUT/selftest_nodes.txt" ] || return 0
   python - "$OUT" "$WANT_BACKEND" <<'EOF'
 import glob, json, os, sys
 out, backend = sys.argv[1], sys.argv[2]
@@ -181,14 +185,20 @@ for path in sorted(glob.glob(os.path.join(out, "selftest_status", "*.status"))):
         node = f.readline().strip() or os.path.basename(path)
     statuses.append((node, status))
 fails = sorted(n for n, s in statuses if not s.startswith("pass"))
-ok = not fails and len(statuses) == n_nodes
-summary = (f"{len(statuses) - len(fails)}/{n_nodes} compiled-kernel tests "
-           f"passed on {backend} (per-node bounded subprocesses, banked "
-           f"across live windows)")
+n_pass = len(statuses) - len(fails)
+complete = len(statuses) == n_nodes
+ok = not fails and complete
+summary = (f"{n_pass}/{n_nodes} compiled-kernel tests passed on {backend} "
+           f"(per-node bounded subprocesses, banked across live windows)")
+if not complete:
+    summary += (f"; {n_nodes - len(statuses)} not yet run on a live window "
+                "(retried per window)")
 if fails:
     summary += "; failed: " + ", ".join(fails)
 rec = {"metric": "selftest", "backend": backend,
-       "selftest": {"ok": ok, "summary": summary}}
+       "selftest": {"ok": ok, "complete": complete, "passed": n_pass,
+                    "total": n_nodes, "summary": summary,
+                    "nodes": {n: s for n, s in statuses}}}
 json.dump(rec, open(os.path.join(out, "results", "selftest.json"), "w"))
 EOF
 }

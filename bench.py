@@ -249,17 +249,22 @@ def _assemble() -> dict:
     if os.environ.get("BENCH_HARVEST_CHILD"):
         return out  # harvest subprocess: never embed the banked record
     try:
-        harvest_path = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "docs", "tpu_sweeps", "round4_merged.json",
-        )
-        with open(harvest_path) as f:
+        with open(_banked_harvest_path()) as f:
             harvested = json.load(f)
         if harvested.get("backend") == "tpu":
             out["tpu_harvest"] = harvested
     except Exception:
         pass
     return out
+
+
+def _banked_harvest_path() -> str:
+    """Where tools/tpu_harvest.sh banks the merged on-chip record.
+    ``BENCH_BANKED_HARVEST`` overrides (tests; future-round renames)."""
+    return os.environ.get("BENCH_BANKED_HARVEST") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "docs", "tpu_sweeps", "round4_merged.json",
+    )
 
 
 def _emit(out: "dict | None" = None) -> None:
@@ -1294,13 +1299,40 @@ def bench_decode_grid() -> dict:
 # -------------------------------------------------------------- selftest
 
 
-def run_selftest(timeout_s: float = 900.0) -> dict:
+def run_selftest(timeout_s: float = 900.0, *, allow_banked: bool = False) -> dict:
     """Compiled-kernel parity on the live chip: run tests_tpu/ in a
     subprocess (hard timeout — the plugin can hang) and summarize.
     VERDICT r2 item 6: parity must be asserted on the real chip, not
     only in interpret mode on CPU. Capped by the remaining wall budget
     (it runs after the sweep, so truncation loses the selftest, never
-    the perf record)."""
+    the perf record).
+
+    ``allow_banked``: reuse a COMPLETE banked per-node selftest from
+    the incremental harvest (backend-guarded: the bank must itself be
+    a tpu record, not a cpu rehearsal). Only the post-sweep AUTO
+    selftest passes this — a monolithic ``pytest tests_tpu/`` there is
+    the exact pattern that wedged the round-3 window mid-compile, and
+    re-proving what per-node bounded subprocesses already proved on
+    silicon spends wedge-risk for nothing. An EXPLICIT ``--selftest``
+    request always runs fresh (the banked evidence is only as new as
+    the harvest's status files; clear those when kernel code changes)."""
+    if allow_banked:
+        try:
+            with open(_banked_harvest_path()) as f:
+                rec = json.load(f)
+            banked = rec.get("selftest") or {}
+            if (
+                rec.get("backend") == "tpu"
+                and banked.get("complete")
+                and banked.get("ok")
+            ):
+                return {
+                    "ok": True,
+                    "summary": "banked harvest selftest reused: "
+                    + banked.get("summary", "")[:220],
+                }
+        except Exception:
+            pass
     timeout_s = min(timeout_s, _remaining() - 30.0)
     if timeout_s < 45.0:
         return {"ok": False, "summary": "skipped: insufficient budget"}
@@ -1501,7 +1533,7 @@ def main() -> int:
             _emit(
                 {
                     "metric": "selftest",
-                    "selftest": run_selftest(),
+                    "selftest": run_selftest(),  # explicit: always fresh
                     "backend": BACKEND,
                 }
             )
@@ -1527,7 +1559,8 @@ def main() -> int:
         # the budget should be spent on perf evidence first, and the
         # selftest cap consumes whatever is left.
         if selftest or (selftest is None and which == "all" and BACKEND == "tpu"):
-            _META["selftest"] = run_selftest()
+            # Auto post-sweep selftest may reuse complete banked evidence.
+            _META["selftest"] = run_selftest(allow_banked=selftest is None)
     except Exception as e:
         # Keyed so it can never clobber a completed headline's "metric"
         # (out.update(_META) in _assemble); _assemble already supplies

@@ -248,3 +248,67 @@ class TestFlashTuneSweep:
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))
+
+
+class TestSelftestReuse:
+    """bench.run_selftest must reuse a COMPLETE banked per-node harvest
+    selftest (re-running the monolithic tests_tpu/ is the round-3 wedge
+    pattern) and fall through when the banked record is partial."""
+
+    def _bench(self):
+        sys.path.insert(0, REPO)
+        import bench
+
+        return bench
+
+    def _pin_budget(self, bench, monkeypatch):
+        # Nearly-spent budget: any fall-through takes the "insufficient
+        # budget" exit instead of spawning a real (hangable) pytest run.
+        monkeypatch.setattr(
+            bench, "_DEADLINE", __import__("time").monotonic() + 40
+        )
+
+    def test_banked_complete_ok_reused(self, tmp_path, monkeypatch):
+        bench = self._bench()
+        p = tmp_path / "merged.json"
+        p.write_text(json.dumps({
+            "backend": "tpu",
+            "selftest": {"ok": True, "complete": True, "passed": 10,
+                         "total": 10, "summary": "10/10 passed on tpu"},
+        }))
+        monkeypatch.setenv("BENCH_BANKED_HARVEST", str(p))
+        self._pin_budget(bench, monkeypatch)
+        out = bench.run_selftest(allow_banked=True)
+        assert out["ok"] is True
+        assert "banked" in out["summary"] and "10/10" in out["summary"]
+        # An explicit selftest request (allow_banked default) runs fresh.
+        out = bench.run_selftest()
+        assert "insufficient budget" in out["summary"]
+
+    def test_cpu_rehearsal_bank_not_reused(self, tmp_path, monkeypatch):
+        bench = self._bench()
+        p = tmp_path / "merged.json"
+        p.write_text(json.dumps({
+            "backend": "cpu",  # rehearsal bank: NOT on-chip evidence
+            "selftest": {"ok": True, "complete": True, "passed": 10,
+                         "total": 10, "summary": "10/10 passed on cpu"},
+        }))
+        monkeypatch.setenv("BENCH_BANKED_HARVEST", str(p))
+        self._pin_budget(bench, monkeypatch)
+        out = bench.run_selftest(allow_banked=True)
+        assert out["ok"] is False
+        assert "insufficient budget" in out["summary"]
+
+    def test_banked_partial_falls_through(self, tmp_path, monkeypatch):
+        bench = self._bench()
+        p = tmp_path / "merged.json"
+        p.write_text(json.dumps({
+            "backend": "tpu",
+            "selftest": {"ok": False, "complete": False, "passed": 5,
+                         "total": 10, "summary": "5/10"},
+        }))
+        monkeypatch.setenv("BENCH_BANKED_HARVEST", str(p))
+        self._pin_budget(bench, monkeypatch)
+        out = bench.run_selftest(allow_banked=True)
+        assert out["ok"] is False
+        assert "insufficient budget" in out["summary"]

@@ -312,3 +312,63 @@ class TestSelftestReuse:
         out = bench.run_selftest(allow_banked=True)
         assert out["ok"] is False
         assert "insufficient budget" in out["summary"]
+
+
+class TestApplyFloors:
+    """tools/apply_floors.py: mechanical floor restamps must be
+    line-scoped (comments and unstamped metrics byte-identical) and
+    refuse partial/no-op stamps unless told otherwise."""
+
+    SRC = (
+        "FLOORS = {\n"
+        '    "tpu": {\n'
+        "        # provenance comment stays\n"
+        '        "m_a": (1.0, 10.0),  # inline note stays\n'
+        '        "m_b": (2.0, 20.0),\n'
+        "    },\n"
+        '    "cpu": {\n'
+        '        "m_a": (9.0, 0.1),\n'
+        "    },\n"
+        "}\n"
+        "REL_MFU_FLOORS: dict = {\n"
+        '    "tpu": {\n'
+        '        "m_a": 0.5,\n'
+        "    },\n"
+        '    "cpu": {},\n'
+        "}\n"
+    )
+
+    def _mod(self):
+        import apply_floors
+
+        return apply_floors
+
+    def test_line_scoped_rewrite_preserves_comments(self):
+        af = self._mod()
+        out = af._rewrite(self.SRC, "FLOORS", "tpu", {"m_a": "(3.0, 30.0)"})
+        assert '"m_a": (3.0, 30.0),  # inline note stays' in out
+        assert '"m_b": (2.0, 20.0),' in out  # untouched
+        assert '"m_a": (9.0, 0.1),' in out  # cpu block untouched
+        assert "# provenance comment stays" in out
+
+    def test_new_metric_appended_to_backend_block(self):
+        af = self._mod()
+        out = af._rewrite(self.SRC, "FLOORS", "tpu", {"m_new": "(7.0, 70.0)"})
+        tpu_block = out.split('"cpu": {')[0]
+        assert '"m_new": (7.0, 70.0),  # first floor' in tpu_block
+
+    def test_missing_backend_refused(self):
+        af = self._mod()
+        with pytest.raises(SystemExit):
+            af._rewrite(self.SRC, "FLOORS", "gpu", {"m_a": "(3.0, 30.0)"})
+
+    def test_truncated_record_needs_partial_flag(self, tmp_path, monkeypatch, capsys):
+        af = self._mod()
+        rec = {"backend": "tpu", "metric": "m_a", "value": 3.0,
+               "fingerprint_tflops_pre": 30.0, "truncated": ["m_b"]}
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(rec))
+        monkeypatch.setattr(sys, "argv", ["apply_floors.py", str(p)])
+        monkeypatch.chdir(REPO)
+        assert af.main() == 1
+        assert "pass --partial" in capsys.readouterr().out

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Apply a sweep's floor stamps to bench.py in place.
+
+Usage: python tools/apply_floors.py /path/to/sweep.json [--dry-run]
+
+The mechanical half of the floors policy that stamp_floors.py leaves
+to copy-paste: for every metric PRESENT in the sweep record, rewrite
+its ``"metric": (value, fingerprint),`` line inside
+``FLOORS[<backend>]`` and its ``"metric": rel_mfu,`` line inside
+``REL_MFU_FLOORS[<backend>]``. Lines for metrics absent from the
+record — and every comment — are left byte-identical, so a partial
+harvest restamps exactly what it measured. A metric present in the
+record but MISSING from the dict is appended at the end of the
+backend block (first floor for a new bench).
+
+The edit is refused (exit 1, bench.py untouched) when:
+- the record's backend has no block in a dict;
+- the record carries ``truncated``/errored metrics AND ``--partial``
+  was not passed (a full-sweep stamp should be a full stamp);
+- a replacement produces no change at all (suspicious no-op).
+
+After applying, re-run the CPU suite's tools tests: they import
+bench.py and will catch a syntax break immediately.
+"""
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from stamp_floors import UNFLOORED, parse_sweep  # noqa: E402
+
+
+def _block_span(src: str, dict_name: str, backend: str):
+    """(start, end) character span of the ``"backend": {...}`` block
+    inside ``dict_name = {...}``, exclusive of the closing brace."""
+    m = re.search(rf"^{dict_name}[^=]*= \{{", src, re.M)
+    if not m:
+        raise SystemExit(f"apply_floors: {dict_name} not found")
+    i = src.find(f'"{backend}": {{', m.end())
+    if i < 0 or i > src.find("\n}", m.end()):
+        raise SystemExit(
+            f"apply_floors: no {backend!r} block in {dict_name}"
+        )
+    start = src.index("{", i) + 1
+    depth = 1
+    j = start
+    while depth:
+        c = src[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+        j += 1
+    return start, j - 1
+
+
+def _rewrite(src: str, dict_name: str, backend: str, entries: dict) -> str:
+    start, end = _block_span(src, dict_name, backend)
+    block = src[start:end]
+    missing = []
+    for metric, line_value in entries.items():
+        pat = re.compile(
+            rf'^(\s*)"{re.escape(metric)}": [^#\n]*,(\s*#[^\n]*)?$', re.M
+        )
+        m = pat.search(block)
+        new_line = f'"{metric}": {line_value},'
+        if m is None:
+            missing.append(new_line)
+            continue
+        keep_comment = m.group(2) or ""
+        block = (
+            block[: m.start()]
+            + f"{m.group(1)}{new_line}{keep_comment}"
+            + block[m.end() :]
+        )
+    if missing:
+        pad = "        "
+        block = block.rstrip() + "\n" + "".join(
+            f"{pad}{ln}  # first floor (appended by apply_floors)\n"
+            for ln in missing
+        ) + "    "
+    return src[:start] + block + src[end:]
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    flags = {a for a in sys.argv[1:] if a.startswith("--")}
+    unknown = flags - {"--dry-run", "--partial"}
+    if unknown:
+        print(f"apply_floors: unknown flags {sorted(unknown)} "
+              "(known: --dry-run, --partial)")
+        return 2
+    if len(args) != 1:
+        print(__doc__)
+        return 2
+    with open(args[0]) as f:
+        d = json.load(f)
+    backend, results, errored, sweep_fp = parse_sweep(d)
+    results = [r for r in results if r["metric"] not in UNFLOORED]
+    if (d.get("truncated") or errored) and "--partial" not in flags:
+        print(
+            f"apply_floors: record has truncated={d.get('truncated')} "
+            f"errored={errored}; pass --partial to stamp only what ran"
+        )
+        return 1
+    if not results:
+        print("apply_floors: no stampable metrics in record")
+        return 1
+
+    floors = {}
+    rel = {}
+    for r in results:
+        fp = r.get(
+            "fingerprint_tflops_pre", r.get("fingerprint_tflops", sweep_fp)
+        )
+        floors[r["metric"]] = f"({r['value']}, {fp})"
+        if "rel_mfu" in r:
+            rel[r["metric"]] = f"{r['rel_mfu']}"
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench.py",
+    )
+    with open(path) as f:
+        src = f.read()
+    out = _rewrite(src, "FLOORS", backend, floors)
+    out = _rewrite(out, "REL_MFU_FLOORS", backend, rel)
+    if out == src:
+        print("apply_floors: no-op (nothing changed) — refusing")
+        return 1
+    if "--dry-run" in flags:
+        import difflib
+
+        sys.stdout.writelines(
+            difflib.unified_diff(
+                src.splitlines(True), out.splitlines(True), "bench.py", "new"
+            )
+        )
+        return 0
+    with open(path, "w") as f:
+        f.write(out)
+    print(
+        f"apply_floors: stamped {len(floors)} floors + {len(rel)} rel_mfu "
+        f"for backend {backend!r}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,28 @@ a copy-paste so the first live-TPU sweep can be stamped in minutes.
 import json
 import sys
 
+# Diagnostics whose healthy value is a fixed point and whose failure
+# direction _result()'s unit heuristic would misread are never floored
+# (bench.py documents each beside FLOORS). Shared with apply_floors.py.
+UNFLOORED = {"decode_grid_step_time_ratio"}
+
+
+def parse_sweep(d):
+    """(backend, results, errored, sweep_fp) from a sweep/merge record.
+    The single parse both halves of the floors workflow (print + apply)
+    share, so they can never disagree on what counts as stampable."""
+    backend = d.get("backend", "?")
+    fp = d.get("fingerprint_tflops_pre", d.get("fingerprint_tflops", 0.0))
+    everything = [d] + d.get("extras", [])
+    results = [r for r in everything if "error" not in r and "metric" in r
+               and r.get("metric") != "selftest"]
+    errored = [
+        r.get("bench", r.get("metric"))
+        for r in everything
+        if "error" in r and r.get("metric") != "selftest"
+    ]
+    return backend, results, errored, fp
+
 
 def main() -> int:
     if len(sys.argv) != 2:
@@ -28,16 +50,8 @@ def main() -> int:
         return 2
     with open(sys.argv[1]) as f:
         d = json.load(f)
-    backend = d.get("backend", "?")
-    fp = d.get("fingerprint_tflops_pre", d.get("fingerprint_tflops", 0.0))
+    backend, results, errored, fp = parse_sweep(d)
     fp_post = d.get("fingerprint_tflops_post")
-    everything = [d] + d.get("extras", [])
-    results = [r for r in everything if "error" not in r and "metric" in r]
-    errored = [
-        r.get("bench", r.get("metric"))
-        for r in everything
-        if "error" in r and r.get("metric") != "selftest"
-    ]
 
     spread = d.get("fingerprint_spread")
     print(f"# backend={backend}  fingerprint pre={fp} post={fp_post}"
@@ -57,10 +71,7 @@ def main() -> int:
     # let a single wedged probe (e.g. a post-fingerprint taken mid
     # tunnel-death, observed at 78 vs the ~40-100k healthy range)
     # poison every floor's fingerprint at once.
-    # Diagnostics whose healthy value is a fixed point and whose
-    # failure direction _result()'s unit heuristic would misread are
-    # never floored (bench.py documents each beside FLOORS).
-    unfloored = {"decode_grid_step_time_ratio"}
+    unfloored = UNFLOORED
     print(f'\n# --- FLOORS["{backend}"] entries ---')
     for r in results:
         if r["metric"] in unfloored:

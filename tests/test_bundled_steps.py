@@ -142,6 +142,48 @@ class TestBundledSteps:
         assert restored is not None and int(restored[1]) == 8
 
 
+class TestBundledPipeline:
+    def test_bundle_over_pp_step_matches_unbundled(self, devices):
+        """lax.scan OVER the 1F1B pipeline step — a scan whose body is
+        itself a shard_map'd scheduled program, the riskiest
+        steps_per_launch composition — must reproduce the unbundled
+        trajectory."""
+        import jax
+
+        from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+        from tensorflow_examples_tpu.workloads import gpt2
+
+        def run(k):
+            cfg = gpt2.Gpt2Config(
+                vocab_size=64, seq_len=16, num_layers=2, num_heads=4,
+                d_model=32, dropout=0.0, attention="xla",
+                global_batch_size=16, train_steps=4, warmup_steps=1,
+                learning_rate=3e-3, log_every=4, checkpoint_every=0,
+                eval_every=0, precision="f32", num_microbatches=2,
+                steps_per_launch=k,
+            )
+            mesh = create_mesh(MeshConfig(data=4, pipe=2))
+            task = gpt2.make_task(cfg, mesh=mesh)
+            trainer = Trainer(task, cfg, mesh=mesh)
+            ds, _ = gpt2.datasets(cfg)
+            m = trainer.fit(
+                train_iterator(ds, cfg.global_batch_size, seed=0),
+                num_steps=cfg.train_steps,
+            )
+            vec = np.concatenate(
+                [
+                    np.ravel(np.asarray(x))
+                    for x in jax.tree.leaves(trainer.state.params)
+                ]
+            )
+            return m["loss"], vec
+
+        loss1, p1 = run(1)
+        loss2, p2 = run(2)
+        assert abs(loss1 - loss2) < 1e-4, (loss1, loss2)
+        np.testing.assert_allclose(p1, p2, rtol=2e-5, atol=2e-6)
+
+
 class TestBundleBatches:
     def test_stacks_k_batches(self):
         it = iter([{"x": np.full((2, 3), i)} for i in range(6)])

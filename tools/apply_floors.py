@@ -48,6 +48,12 @@ def _block_span(src: str, dict_name: str, backend: str):
     j = start
     while depth:
         c = src[j]
+        if c == "#":
+            # A brace inside a comment must not move the span: this
+            # tool rewrites source in place, and a comment like
+            # "# shape: {...}" would otherwise swallow the next block.
+            j = src.index("\n", j)
+            continue
         if c == "{":
             depth += 1
         elif c == "}":
@@ -105,6 +111,14 @@ def main() -> int:
             f"errored={errored}; pass --partial to stamp only what ran"
         )
         return 1
+    if errored or d.get("truncated"):
+        # Same loud warning stamp_floors prints: unstamped metrics keep
+        # their OLD (value, fingerprint) floors while the compiled
+        # program may have changed — stale until fixed or removed.
+        print(
+            "apply_floors: WARNING — NOT stamped (old floors now stale): "
+            f"errored={errored} truncated={d.get('truncated')}"
+        )
     if not results:
         print("apply_floors: no stampable metrics in record")
         return 1

@@ -193,3 +193,35 @@ def test_fused_ce_bwd_compiled_parity():
     want = mean_nll(cross_entropy_reference)(logits)
     # dlogits entries are O(softmax/n) — tiny; absolute band scaled by n.
     assert _max_abs(got, want) < 2e-2 / n * 50
+
+
+def test_flash_in_scan_compiled_parity():
+    """The flash kernel INSIDE a lax.scan body, compiled by Mosaic on
+    the chip — the steps_per_launch bundled-step composition. Proves a
+    Pallas call under scan lowers/compiles on this backend and that
+    per-slice outputs match per-launch calls, clearing the way for
+    bundling flash-attention workload benches (the bundled bert/
+    cifar10/mnist benches are XLA-attention; this is the flash case)."""
+    qs, ks, vs = (
+        jax.random.normal(
+            jax.random.PRNGKey(i), (2, 1, 4, 256, 64), jnp.bfloat16
+        )
+        for i in range(3)
+    )
+
+    @jax.jit
+    def scanned(qs, ks, vs):
+        def body(carry, qkv):
+            q, k, v = qkv
+            o = flash_attention(q, k, v, causal=True, interpret=False)
+            return carry + jnp.sum(o.astype(jnp.float32)), o
+
+        return jax.lax.scan(body, jnp.float32(0.0), (qs, ks, vs))
+
+    total, outs = scanned(qs, ks, vs)
+    for i in range(2):
+        ref = attention_reference(qs[i], ks[i], vs[i], causal=True)
+        assert _max_abs(outs[i], ref) < 2e-2, i
+    assert float(total) == pytest.approx(
+        float(jnp.sum(outs.astype(jnp.float32))), rel=1e-3
+    )

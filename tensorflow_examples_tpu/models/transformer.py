@@ -56,6 +56,10 @@ class TransformerConfig:
     dropout: float = 0.1
     attention: str = "flash"  # flash | xla | ring | ulysses
     remat: bool = False
+    remat_policy: str = "none"  # none (recompute all) | dots (save matmul
+    #   outputs, recompute elementwise — less recompute, more memory) |
+    #   dots_no_batch (save only non-batch-dim dots). Numerics are
+    #   identical across policies; only the memory/recompute trade moves.
     # Mixture-of-Experts (parallel/moe.py): 0 = dense MLP everywhere;
     # E > 0 swaps the MLP of every ``moe_every``-th block for a top-1
     # Switch MoE with E experts (sharded over `model` on a mesh = EP).
@@ -353,10 +357,22 @@ class Transformer(nn.Module):
 
         block = Block
         if cfg.remat and not decode:
+            policies = {
+                "none": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch": (
+                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+                ),
+            }
+            if cfg.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy={cfg.remat_policy!r} not in "
+                    f"{sorted(policies)}"
+                )
             block = nn.remat(
                 Block,
                 prevent_cse=False,
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=policies[cfg.remat_policy],
             )
         for i in range(cfg.num_layers):
             use_moe = (

@@ -39,6 +39,9 @@ class Gpt2Config(TrainConfig):
     d_model: int = 768
     dropout: float = 0.1
     attention: str = "flash"  # flash | xla | ring | ulysses
+    remat_policy: str = "none"  # none | dots | dots_no_batch (with --remat:
+    #   what the checkpointed blocks SAVE; numerics identical, only the
+    #   memory/recompute trade moves — see models/transformer.py)
     fused_ce: bool = True
     pretrained: str = ""  # local HF GPT2LMHeadModel path to start from
     # Pipeline parallelism (mesh_pipe > 1): microbatching over the
@@ -76,6 +79,14 @@ class Gpt2Config(TrainConfig):
 
 
 def model_config(cfg: Gpt2Config) -> transformer.TransformerConfig:
+    # Fail fast on enum typos regardless of flag combination — the
+    # model-side check only triggers under `remat and not decode` (and
+    # the stacked-block pipeline path never reaches it).
+    if cfg.remat_policy not in ("none", "dots", "dots_no_batch"):
+        raise ValueError(
+            f"remat_policy={cfg.remat_policy!r} not in "
+            "('none', 'dots', 'dots_no_batch')"
+        )
     return transformer.TransformerConfig(
         vocab_size=cfg.vocab_size,
         max_len=cfg.seq_len,
@@ -85,6 +96,7 @@ def model_config(cfg: Gpt2Config) -> transformer.TransformerConfig:
         dropout=cfg.dropout,
         attention=cfg.attention,
         remat=cfg.remat,
+        remat_policy=cfg.remat_policy,
         moe_experts=cfg.moe_experts,
         moe_every=cfg.moe_every,
         moe_top_k=cfg.moe_top_k,

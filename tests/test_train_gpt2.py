@@ -586,3 +586,28 @@ def test_checkpoint_restores_across_mesh_layouts(tmp_path):
     m_dp = trainer_dp.evaluate(eval_batches(eval_ds, cfg.global_batch_size))
     m_tp = trainer_tp.evaluate(eval_batches(eval_ds, cfg.global_batch_size))
     assert abs(m_dp["nll"] - m_tp["nll"]) < 1e-4, (m_dp, m_tp)
+
+
+def test_remat_policies_match_no_remat(mesh8):
+    """--remat never changes numerics — only the memory/recompute
+    trade. Each remat_policy's short trajectory must match the
+    un-remat'd run (same seed, same data)."""
+    runs = {}
+    for name, over in {
+        "plain": {},
+        "none": dict(remat=True, remat_policy="none"),
+        "dots": dict(remat=True, remat_policy="dots"),
+        "dots_no_batch": dict(remat=True, remat_policy="dots_no_batch"),
+    }.items():
+        cfg = tiny_config(train_steps=3, **over)
+        first, last, _ = run_tiny(cfg, mesh8)
+        runs[name] = (first, last)
+    for name, (first, last) in runs.items():
+        assert abs(first - runs["plain"][0]) < 1e-5, (name, first, runs["plain"])
+        assert abs(last - runs["plain"][1]) < 1e-4, (name, last, runs["plain"])
+
+
+def test_remat_policy_validation(mesh8):
+    cfg = tiny_config(train_steps=1, remat=True, remat_policy="bogus")
+    with pytest.raises(ValueError, match="remat_policy"):
+        run_tiny(cfg, mesh8)

@@ -511,7 +511,10 @@ def test_moe_router_gets_task_gradient():
 
 def test_moe_capacity_overflow_drops():
     """With capacity_factor << 1 most assignments must drop (the metric
-    actually measures overflow) while the residual keeps loss finite."""
+    actually measures overflow) while the residual keeps loss finite.
+    Capacity/drop semantics live in the scatter formulation (the EP
+    transport's reference); the grouped default is DROPLESS and must
+    report exactly zero drops at any capacity."""
     import jax
     import jax.numpy as jnp
 
@@ -528,9 +531,21 @@ def test_moe_capacity_overflow_drops():
         jnp.zeros((e, d)),
         jax.random.normal(ks[3], (1, n, d)),
         capacity_factor=0.1,
+        impl="scatter",
     )
     assert out.shape == (1, n, d) and np.isfinite(np.asarray(out)).all()
     assert float(drop) > 0.5, float(drop)
+    # The grouped (default) path never drops — even at absurd capacity.
+    _, _, drop_g = moe_ffn(
+        jax.random.normal(ks[0], (d, e)),
+        jax.random.normal(ks[1], (e, d, ff)) * 0.1,
+        jnp.zeros((e, ff)),
+        jax.random.normal(ks[2], (e, ff, d)) * 0.1,
+        jnp.zeros((e, d)),
+        jax.random.normal(ks[3], (1, n, d)),
+        capacity_factor=0.1,
+    )
+    assert float(drop_g) == 0.0, float(drop_g)
     # And with generous capacity nothing at all drops.
     _, _, drop2 = moe_ffn(
         jax.random.normal(ks[0], (d, e)),

@@ -139,6 +139,78 @@ def normalize(
     return out
 
 
+# ------------------------------------------------------------- fastjpeg
+
+
+def decode_augment_batch(
+    jpegs: "list[bytes]",
+    *,
+    train: bool,
+    out_size: int,
+    seeds: np.ndarray | None,
+    mean: np.ndarray,
+    std: np.ndarray,
+    threads: int | None = None,
+) -> "tuple[np.ndarray, np.ndarray] | None":
+    """One threaded C++ stage: JPEG decode (DCT-scaled) + ResNet
+    random-resized-crop (train) / central 87.5% crop (eval) + bilinear
+    resize + flip + normalize. ``seeds``: [n] uint64, one splitmix64
+    stream per image (ignored for eval). Returns ``(images f32
+    [n, S, S, 3], ok uint8 [n])`` or None when libfastjpeg (libjpeg) is
+    unavailable. Failed decodes are zero-filled with ok == 0."""
+    lib = _load("fastjpeg")
+    if lib is None:
+        return None
+    n = len(jpegs)
+    data = np.frombuffer(b"".join(jpegs), np.uint8)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(j) for j in jpegs], out=offsets[1:])
+    if seeds is None:
+        seeds = np.zeros(n, np.uint64)
+    seeds = np.ascontiguousarray(seeds.astype(np.uint64))
+    out = np.empty((n, out_size, out_size, 3), np.float32)
+    ok = np.empty(n, np.uint8)
+    inv_std = np.ascontiguousarray(1.0 / std.astype(np.float32))
+    mean = np.ascontiguousarray(mean.astype(np.float32))
+    nthreads = threads or min(16, os.cpu_count() or 1)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    f32 = ctypes.POINTER(ctypes.c_float)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    u64 = ctypes.POINTER(ctypes.c_uint64)
+    lib.fj_decode_augment_batch.restype = ctypes.c_int64
+    lib.fj_decode_augment_batch(
+        data.ctypes.data_as(u8),
+        offsets.ctypes.data_as(i64),
+        ctypes.c_int64(n),
+        ctypes.c_int32(1 if train else 0),
+        ctypes.c_int32(out_size),
+        seeds.ctypes.data_as(u64),
+        mean.ctypes.data_as(f32),
+        inv_std.ctypes.data_as(f32),
+        out.ctypes.data_as(f32),
+        ctypes.c_int64(nthreads),
+        ok.ctypes.data_as(u8),
+    )
+    return out, ok
+
+
+def jpeg_dims(data: bytes) -> "tuple[int, int] | None":
+    """Header-only (height, width); None on error or missing lib."""
+    lib = _load("fastjpeg")
+    if lib is None:
+        return None
+    arr = np.frombuffer(data, np.uint8)
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    rc = lib.fj_jpeg_dims(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(len(data)),
+        ctypes.byref(h),
+        ctypes.byref(w),
+    )
+    return None if rc else (h.value, w.value)
+
+
 # ------------------------------------------------------------- ffi_ops
 
 

@@ -4,6 +4,8 @@ The toolchain is part of the image (g++), so these do NOT skip silently —
 a build failure should fail CI, not hide.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -103,3 +105,165 @@ def test_cifar_augment_u8_matches_fallback():
     np.testing.assert_allclose(
         out_native["image"], out_np["image"], atol=1e-5
     )
+
+
+# ------------------------------------------------------------- fastjpeg
+
+
+def _make_jpeg(h, w, seed=0, quality=92):
+    import io
+
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    # Smooth low-frequency content: JPEG is near-lossless on it, so
+    # decoder-rounding differences between libjpeg builds stay tiny.
+    yy = np.linspace(0, np.pi * 2, h)[:, None]
+    xx = np.linspace(0, np.pi * 3, w)[None, :]
+    img = np.stack(
+        [
+            127 + 90 * np.sin(yy + p) * np.cos(xx + p)
+            for p in rng.uniform(0, 3, 3)
+        ],
+        axis=-1,
+    ).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+# libfastjpeg is the one OPTIONAL native lib (needs libjpeg headers;
+# the Makefile's `all` treats it best-effort and imagenet.py falls back
+# to the tf decode path). On hosts WITH the headers a build failure
+# must still fail loudly, so only the header-less case skips.
+_has_jpeg_headers = os.path.exists("/usr/include/jpeglib.h")
+requires_fastjpeg = pytest.mark.skipif(
+    not _has_jpeg_headers and not native.available("fastjpeg"),
+    reason="libjpeg headers absent; fastjpeg is optional",
+)
+
+
+@requires_fastjpeg
+def test_fastjpeg_builds():
+    assert native.available("fastjpeg"), "native/fastjpeg failed to build/load"
+
+
+@requires_fastjpeg
+def test_jpeg_dims():
+    assert native.jpeg_dims(_make_jpeg(48, 80)) == (48, 80)
+    assert native.jpeg_dims(b"not a jpeg") is None
+
+
+@requires_fastjpeg
+@pytest.mark.parametrize("train", [True, False])
+def test_decode_augment_matches_numpy_mirror(train):
+    """The one-stage C++ decode+crop+resize+flip+normalize against the
+    documented numpy mirror (same splitmix64 draws). Tolerance covers
+    libjpeg-build IDCT rounding (PIL bundles its own libjpeg).
+    out_size 48 keeps every crop < 2x the output, i.e. the denom=1
+    decode path the mirror models exactly."""
+    from tensorflow_examples_tpu.data import imagenet
+
+    jpegs = [_make_jpeg(64 + 8 * i, 96 - 8 * i, seed=i) for i in range(6)]
+    seeds = np.arange(100, 106, dtype=np.uint64)
+    res = native.decode_augment_batch(
+        jpegs,
+        train=train,
+        out_size=48,
+        seeds=seeds,
+        mean=imagenet.MEAN_RGB,
+        std=imagenet.STDDEV_RGB,
+    )
+    assert res is not None
+    out, ok = res
+    assert out.shape == (6, 48, 48, 3) and ok.all()
+    for i, j in enumerate(jpegs):
+        ref = imagenet.decode_augment_reference(
+            j, train=train, seed=int(seeds[i]), out_size=48
+        )
+        # ~2 uint8 counts of decoder slack, in normalized units.
+        np.testing.assert_allclose(
+            out[i], ref, atol=2.5 / 255.0 / 0.22,
+            err_msg=f"image {i} (train={train})",
+        )
+
+
+@requires_fastjpeg
+def test_decode_dct_scaled_path_close_to_full_decode():
+    """A large source with a small output triggers the 1/denom DCT
+    decode (the perf point of fastjpeg); the result must stay CLOSE to
+    the full-decode mirror — scaled IDCT is a box-ish prefilter, not a
+    different image."""
+    from tensorflow_examples_tpu.data import imagenet
+
+    jpeg = _make_jpeg(256, 320, seed=9)
+    out, ok = native.decode_augment_batch(
+        [jpeg],
+        train=False,
+        out_size=32,  # crop 224 -> denom 4
+        seeds=None,
+        mean=imagenet.MEAN_RGB,
+        std=imagenet.STDDEV_RGB,
+    )
+    assert ok.all()
+    ref = imagenet.decode_augment_reference(
+        jpeg, train=False, seed=0, out_size=32
+    )
+    assert float(np.abs(out[0] - ref).mean()) < 0.08
+    np.testing.assert_allclose(out[0], ref, atol=0.5)
+
+
+@requires_fastjpeg
+def test_decode_augment_failed_decode_flags():
+    from tensorflow_examples_tpu.data import imagenet
+
+    jpegs = [_make_jpeg(40, 40), b"garbage bytes", _make_jpeg(40, 40, seed=2)]
+    out, ok = native.decode_augment_batch(
+        jpegs,
+        train=False,
+        out_size=16,
+        seeds=None,
+        mean=imagenet.MEAN_RGB,
+        std=imagenet.STDDEV_RGB,
+    )
+    assert list(ok) == [1, 0, 1]
+    assert np.all(out[1] == 0)
+    assert np.any(out[0] != 0) and np.any(out[2] != 0)
+
+
+@requires_fastjpeg
+def test_native_stream_feeds_training_batches(tmp_path):
+    """End-to-end: TFRecord shards → native C++ decode stream →
+    normalized batches with correct shapes/labels."""
+    from tensorflow_examples_tpu.data import imagenet
+
+    if not imagenet._native_decode_enabled():
+        pytest.skip("fastjpeg unavailable")
+    tf = imagenet._tf()
+    path = str(tmp_path / "train-00000-of-00001")
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(8):
+            ex = tf.train.Example(
+                features=tf.train.Features(
+                    feature={
+                        "image/encoded": tf.train.Feature(
+                            bytes_list=tf.train.BytesList(
+                                value=[_make_jpeg(50 + i, 60, seed=i)]
+                            )
+                        ),
+                        "image/class/label": tf.train.Feature(
+                            int64_list=tf.train.Int64List(value=[i + 1])
+                        ),
+                    }
+                )
+            ).SerializeToString()
+            w.write(ex)
+    it = imagenet.tfrecord_iter(
+        str(tmp_path), "train", 4, train=True, image_size=24, seed=0
+    )
+    b = next(it)
+    assert b["image"].shape == (4, 24, 24, 3)
+    assert b["image"].dtype == np.float32
+    assert set(b["label"]) <= set(range(8))
+    # normalized data: roughly centered, not raw uint8 scale
+    assert abs(float(b["image"].mean())) < 3.0

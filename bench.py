@@ -144,6 +144,20 @@ FLOORS = {
     },
 }
 
+# Launch protocol each floor was stamped under: steps_per_launch of the
+# record that produced the FLOORS value (metrics absent here were
+# stamped unbundled, bundle=1). _result flags "floor_protocol_mismatch"
+# whenever a record's bundle differs from its floor's — vs_baseline
+# across that boundary mixes launch amortization with per-step change.
+# Restamps must move these entries together with FLOORS (the round-4
+# bundled-loop protocol change pre-registered bert/cifar10/mnist at
+# K=8; until that harvest lands their floors remain bundle=1 stamps and
+# the flag is expected to fire).
+FLOOR_BUNDLES: dict[str, dict[str, int]] = {
+    "tpu": {},
+    "cpu": {},
+}
+
 # Drift-cancelled floors: rel_mfu = model_tflops/probe_tflops measured
 # under the 3-window protocol. TPU side stamped from the 2026-07-31
 # round-4 harvest (first live-chip protocol sweep); CPU side from the
@@ -258,12 +272,37 @@ def _assemble() -> dict:
     return out
 
 
+def _kernel_source_hash() -> str:
+    """tools/kernel_source_hash.py without touching sys.path (repeated
+    inserts would let tools/ modules shadow same-named imports)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tools",
+        "kernel_source_hash.py",
+    )
+    spec = importlib.util.spec_from_file_location("_ksh", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.kernel_source_hash()
+
+
 def _banked_harvest_path() -> str:
     """Where tools/tpu_harvest.sh banks the merged on-chip record.
-    ``BENCH_BANKED_HARVEST`` overrides (tests; future-round renames)."""
-    return os.environ.get("BENCH_BANKED_HARVEST") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "docs", "tpu_sweeps", "round4_merged.json",
+    ``BENCH_BANKED_HARVEST`` overrides (tests; future-round renames).
+    Prefers the current round's artifact, falling back to the previous
+    round's so a round with no live window still attaches the freshest
+    banked on-chip evidence."""
+    env = os.environ.get("BENCH_BANKED_HARVEST")
+    if env:
+        return env
+    d = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "docs", "tpu_sweeps"
+    )
+    r5 = os.path.join(d, "round5_merged.json")
+    return r5 if os.path.exists(r5) else os.path.join(
+        d, "round4_merged.json"
     )
 
 
@@ -508,8 +547,27 @@ def _result(
         "window_values": [round(v, 4) for v in sorted(values)],
         **extra,
     }
+    # A floor is only comparable to a record measured under the same
+    # launch protocol: flag when the record's steps_per_launch differs
+    # from the bundle the floor was stamped at, so a vs_baseline that
+    # conflates launch amortization with per-step perf is visibly
+    # transitional rather than silently green.
+    rec_bundle = int(extra.get("bundle", 1) or 1)
+    floor_bundle = FLOOR_BUNDLES.get(BACKEND, {}).get(metric, 1)
+    if floor and rec_bundle != floor_bundle:
+        out["floor_protocol_mismatch"] = (
+            f"record bundle={rec_bundle}, floor stamped at "
+            f"bundle={floor_bundle}"
+        )
     if model_tflops_per_sec is not None:
         out["model_tflops_per_sec"] = round(model_tflops_per_sec, 3)
+        # Which analysis produced the FLOPs numerator (ADVICE r4):
+        # "compiled" = XLA cost model on the compiled executable,
+        # "lowered" = pre-optimization lowering (verified equal on this
+        # rig but not guaranteed on other versions/backends),
+        # "hand-counted" = analytic formula in the bench itself.
+        out["flops_analysis"] = _step_flops.last_mode or "hand-counted"
+        _step_flops.last_mode = None
     return out
 
 
@@ -536,16 +594,26 @@ def _step_flops(trainer, batch, *, compiled: bool = True) -> "float | None":
     the never-executed single-step program costs no wedge-prone tunnel
     compile time. Verified on this rig to give the same flops count as
     the compiled analysis."""
+    _step_flops.last_mode = None
     try:
         lowered = trainer._train_step.lower(trainer.state, batch)
         ca = (lowered.compile() if compiled else lowered).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
+        if f > 0:  # only a usable value earns provenance (a zero-FLOPs
+            # result returns None and must not label a later bench)
+            _step_flops.last_mode = "compiled" if compiled else "lowered"
+            return f
+        return None
     except Exception as e:  # cost model availability varies by backend
         print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
         return None
+
+
+# Read-once provenance for the most recent _step_flops call; _result
+# consumes it into the record's "flops_analysis" key.
+_step_flops.last_mode = None
 
 
 def _time_steps(
@@ -1321,10 +1389,17 @@ def run_selftest(timeout_s: float = 900.0, *, allow_banked: bool = False) -> dic
             with open(_banked_harvest_path()) as f:
                 rec = json.load(f)
             banked = rec.get("selftest") or {}
+            # The banked evidence must be about THESE kernel sources:
+            # records carry the tests_tpu/+ops/ content hash from the
+            # moment the nodes ran (tools/kernel_source_hash.py); after
+            # an ops/ edit the hash diverges and the bank is stale
+            # (ADVICE r4). Legacy records without the key never match.
             if (
                 rec.get("backend") == "tpu"
                 and banked.get("complete")
                 and banked.get("ok")
+                and banked.get("kernel_source_hash")
+                == _kernel_source_hash()
             ):
                 return {
                     "ok": True,

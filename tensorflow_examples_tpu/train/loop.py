@@ -82,6 +82,7 @@ class Trainer:
         self._writer = None
         self.state = self._init_state()
         self._train_step = self._build_train_step()
+        self._bundled_steps: dict[int, object] = {}
         self._eval_step = self._build_eval_step()
 
     # ------------------------------------------------------------- init
@@ -241,19 +242,28 @@ class Trainer:
         optimizer (``optax.MultiSteps`` grad accumulation ticks per scan
         iteration) — so K scanned steps match K separate launches; only
         the host dispatch cost is amortized K-fold. Metrics come back
-        stacked ``[k]`` per key."""
+        stacked ``[k]`` per key.
+
+        Cached per ``k`` (like ``self._train_step``) so repeated
+        ``fit()`` calls on one Trainer don't pay a fresh trace+compile
+        each time."""
+        cached = self._bundled_steps.get(k)
+        if cached is not None:
+            return cached
         train_step = self._make_train_step_fn()
 
         def bundled(state: TrainState, batches):
             return jax.lax.scan(train_step, state, batches)
 
         state_sh = self._state_shardings(jax.eval_shape(lambda s: s, self.state))
-        return jax.jit(
+        step = jax.jit(
             bundled,
             in_shardings=(state_sh, bundle_sharding(self.mesh)),
             out_shardings=(state_sh, NamedSharding(self.mesh, P())),
             donate_argnums=(0,),
         )
+        self._bundled_steps[k] = step
+        return step
 
     def _build_eval_step(self):
         if self.task.eval_fn is None:

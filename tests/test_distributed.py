@@ -21,8 +21,12 @@ import pytest
 
 def _worker_env():
     """Each worker gets ONE cpu device: strip the fake-device flag the
-    test harness (conftest) sets for the parent process."""
+    test harness (conftest) sets for the parent process. Also drop
+    PALLAS_AXON_POOL_IPS: the session sitecustomize's axon register()
+    call can block interpreter START >=90 s whenever the TPU tunnel
+    endpoint is wedged — a pure-CPU worker must never pay that."""
     env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["XLA_FLAGS"] = " ".join(
         f
         for f in env.get("XLA_FLAGS", "").split()

@@ -138,6 +138,10 @@ def _run_tool(script, *args):
         capture_output=True,
         text=True,
         timeout=300,
+        # CPU-only tool: the sitecustomize axon register() can block
+        # interpreter start >=90 s while the tunnel is wedged.
+        env={k: v for k, v in os.environ.items()
+             if k != "PALLAS_AXON_POOL_IPS"},
     )
     assert r.returncode == 0, r.stderr[-2000:]
     return r.stdout

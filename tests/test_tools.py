@@ -9,6 +9,7 @@ coverage).
 
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -270,11 +271,14 @@ class TestSelftestReuse:
 
     def test_banked_complete_ok_reused(self, tmp_path, monkeypatch):
         bench = self._bench()
+        from kernel_source_hash import kernel_source_hash
+
         p = tmp_path / "merged.json"
         p.write_text(json.dumps({
             "backend": "tpu",
             "selftest": {"ok": True, "complete": True, "passed": 10,
-                         "total": 10, "summary": "10/10 passed on tpu"},
+                         "total": 10, "summary": "10/10 passed on tpu",
+                         "kernel_source_hash": kernel_source_hash()},
         }))
         monkeypatch.setenv("BENCH_BANKED_HARVEST", str(p))
         self._pin_budget(bench, monkeypatch)
@@ -284,6 +288,23 @@ class TestSelftestReuse:
         # An explicit selftest request (allow_banked default) runs fresh.
         out = bench.run_selftest()
         assert "insufficient budget" in out["summary"]
+
+    def test_stale_kernel_hash_not_reused(self, tmp_path, monkeypatch):
+        # A bank taken before an ops/ edit is stale evidence (ADVICE
+        # r4): its embedded source hash diverges and reuse must refuse.
+        bench = self._bench()
+        p = tmp_path / "merged.json"
+        p.write_text(json.dumps({
+            "backend": "tpu",
+            "selftest": {"ok": True, "complete": True, "passed": 10,
+                         "total": 10, "summary": "10/10 passed on tpu",
+                         "kernel_source_hash": "0" * 64},
+        }))
+        monkeypatch.setenv("BENCH_BANKED_HARVEST", str(p))
+        self._pin_budget(bench, monkeypatch)
+        out = bench.run_selftest(allow_banked=True)
+        assert out["ok"] is False
+        assert "insufficient budget" in out["summary"]  # fell through
 
     def test_cpu_rehearsal_bank_not_reused(self, tmp_path, monkeypatch):
         bench = self._bench()
@@ -362,6 +383,18 @@ class TestApplyFloors:
         with pytest.raises(SystemExit):
             af._rewrite(self.SRC, "FLOORS", "gpu", {"m_a": "(3.0, 30.0)"})
 
+    def test_wrapped_entry_refused_not_duplicated(self):
+        # A formatter-wrapped entry no longer matches the one-line
+        # regex; appending would leave a duplicate dict key (ADVICE
+        # r4) — the rewrite must refuse instead.
+        af = self._mod()
+        src = self.SRC.replace(
+            '"m_b": (2.0, 20.0),',
+            '"m_b": (\n            2.0, 20.0),',
+        )
+        with pytest.raises(SystemExit, match="m_b"):
+            af._rewrite(src, "FLOORS", "tpu", {"m_b": "(5.0, 50.0)"})
+
     def test_truncated_record_needs_partial_flag(self, tmp_path, monkeypatch, capsys):
         af = self._mod()
         rec = {"backend": "tpu", "metric": "m_a", "value": 3.0,
@@ -372,3 +405,51 @@ class TestApplyFloors:
         monkeypatch.chdir(REPO)
         assert af.main() == 1
         assert "pass --partial" in capsys.readouterr().out
+
+
+class TestKernelSourceHash:
+    def test_changes_with_ops_content_and_layout(self, tmp_path):
+        from kernel_source_hash import kernel_source_hash
+
+        root = tmp_path / "repo"
+        ops = root / "tensorflow_examples_tpu" / "ops"
+        tt = root / "tests_tpu"
+        ops.mkdir(parents=True)
+        tt.mkdir()
+        (ops / "k.py").write_text("a = 1\n")
+        (tt / "t.py").write_text("b = 2\n")
+        h0 = kernel_source_hash(str(root))
+        assert h0 == kernel_source_hash(str(root))  # deterministic
+        (ops / "k.py").write_text("a = 3\n")
+        h1 = kernel_source_hash(str(root))
+        assert h1 != h0  # content edit
+        (ops / "k.py").rename(ops / "k2.py")
+        assert kernel_source_hash(str(root)) != h1  # rename counts too
+
+    def test_repo_hash_is_stable_here(self):
+        from kernel_source_hash import kernel_source_hash
+
+        assert kernel_source_hash() == kernel_source_hash()
+
+
+def test_readme_test_count_is_current():
+    """README's `tests/` line states the suite size; keep it honest
+    mechanically (VERDICT r4 weak #6) by comparing against pytest's own
+    collection of this directory."""
+    with open(os.path.join(REPO, "README.md")) as f:
+        m = re.search(r"`tests/` — (\d+) tests", f.read())
+    assert m, "README.md no longer carries the `tests/` — N tests line"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no axon-register start hang
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", os.path.join(REPO, "tests"),
+         "--collect-only", "-q", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, timeout=300,
+        env=env,
+    )
+    cm = re.search(r"(\d+) tests collected", out.stdout)
+    assert cm, f"collection failed:\n{out.stdout[-2000:]}{out.stderr[-2000:]}"
+    assert int(m.group(1)) == int(cm.group(1)), (
+        f"README says {m.group(1)} tests, collection says {cm.group(1)} — "
+        "update the README.md tests/ line"
+    )

@@ -73,6 +73,18 @@ def _rewrite(src: str, dict_name: str, backend: str, entries: dict) -> str:
         m = pat.search(block)
         new_line = f'"{metric}": {line_value},'
         if m is None:
+            if f'"{metric}"' in block:
+                # The metric's key exists but the one-line regex missed
+                # it (e.g. a formatter wrapped the tuple across lines).
+                # Appending here would leave a duplicate dict key whose
+                # later value silently wins while the stale wrapped
+                # entry survives in source — refuse instead.
+                raise SystemExit(
+                    f"apply_floors: {metric!r} present in {dict_name}"
+                    f"[{backend!r}] but not as a single "
+                    '``"metric": value,`` line — fix the formatting, '
+                    "then re-run"
+                )
             missing.append(new_line)
             continue
         keep_comment = m.group(2) or ""
@@ -125,6 +137,7 @@ def main() -> int:
 
     floors = {}
     rel = {}
+    bundles = {}
     for r in results:
         fp = r.get(
             "fingerprint_tflops_pre", r.get("fingerprint_tflops", sweep_fp)
@@ -132,6 +145,12 @@ def main() -> int:
         floors[r["metric"]] = f"({r['value']}, {fp})"
         if "rel_mfu" in r:
             rel[r["metric"]] = f"{r['rel_mfu']}"
+        # The launch protocol moves WITH the floor: stamp the record's
+        # bundle (explicitly, even when 1 — an existing entry from an
+        # earlier bundled stamp must be overwritten, not kept) so
+        # bench.py's floor_protocol_mismatch flag compares against the
+        # protocol this floor was actually measured under.
+        bundles[r["metric"]] = str(int(r.get("bundle", 1) or 1))
 
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -141,6 +160,7 @@ def main() -> int:
         src = f.read()
     out = _rewrite(src, "FLOORS", backend, floors)
     out = _rewrite(out, "REL_MFU_FLOORS", backend, rel)
+    out = _rewrite(out, "FLOOR_BUNDLES", backend, bundles)
     if out == src:
         print("apply_floors: no-op (nothing changed) — refusing")
         return 1
@@ -157,7 +177,7 @@ def main() -> int:
         f.write(out)
     print(
         f"apply_floors: stamped {len(floors)} floors + {len(rel)} rel_mfu "
-        f"for backend {backend!r}"
+        f"+ {len(bundles)} bundle protocols for backend {backend!r}"
     )
     return 0
 

@@ -1,0 +1,47 @@
+"""Content hash of the kernel sources the compiled selftest proves.
+
+A banked ``tests_tpu/`` selftest record is evidence about the kernel
+code AS IT WAS when the nodes ran on the chip. Reusing it after an
+``ops/`` edit would silently satisfy the on-chip-parity requirement
+with stale evidence (ADVICE r4). This module defines the one hash both
+sides use: the harvest embeds it in the banked record, and bench.py's
+``run_selftest(allow_banked=True)`` refuses a record whose hash does
+not match the working tree.
+
+Scope: every ``.py`` under ``tests_tpu/`` (the parity assertions) and
+``tensorflow_examples_tpu/ops/`` (the kernels they compile). Hash is
+over (relative path, content) pairs in sorted order, so renames and
+adds/removes change it too.
+
+Usage: ``python tools/kernel_source_hash.py`` prints the hash.
+"""
+
+import hashlib
+import os
+
+
+def kernel_source_hash(repo_root: "str | None" = None) -> str:
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    h = hashlib.sha256()
+    for sub in ("tests_tpu", os.path.join("tensorflow_examples_tpu", "ops")):
+        base = os.path.join(root, sub)
+        files = []
+        for dirpath, _dirnames, filenames in os.walk(base):
+            files.extend(
+                os.path.join(dirpath, f)
+                for f in filenames
+                if f.endswith(".py")
+            )
+        for path in sorted(files):
+            h.update(os.path.relpath(path, root).encode())
+            h.update(b"\0")
+            with open(path, "rb") as f:
+                h.update(f.read())
+            h.update(b"\0")
+    return h.hexdigest()
+
+
+if __name__ == "__main__":
+    print(kernel_source_hash())

@@ -516,10 +516,38 @@ def _fit_block(target: int, seq: int) -> int:
     )
 
 
-def _resolve_block(block: int | None, seq: int) -> int:
+@functools.lru_cache(maxsize=1)
+def _tuned_block_table() -> dict:
+    """Measured per-sequence block defaults from the on-chip sweep
+    (tools/flash_tune.py → docs/tpu_sweeps/flash_block_table.json,
+    committed with its evidence record). Maps str(seq) →
+    {"block_q": B, "block_kv": B} from the fwd+bwd-optimal cell —
+    training is the default consumer. Missing file (fresh checkout, no
+    sweep banked yet) → empty table → the 256 fallback."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "docs", "tpu_sweeps", "flash_block_table.json",
+    )
+    try:
+        with open(path) as f:
+            return json.load(f).get("by_seq", {})
+    except Exception:
+        return {}
+
+
+def _resolve_block(block: int | None, seq: int, which: str = "block_q") -> int:
     """Explicit block sizes are honored exactly (divisibility enforced,
-    never silently overridden); None selects the auto fit."""
+    never silently overridden); None selects the swept per-seq default
+    (falling back to the 256 target fit)."""
     if block is None:
+        tuned = _tuned_block_table().get(str(seq))
+        if tuned and tuned.get(which):
+            return _fit_block(int(tuned[which]), seq)
         return _fit_block(_DEFAULT_BLOCK, seq)
     b = min(block, seq)
     if seq % b:
@@ -535,8 +563,8 @@ def _prepare(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
         interpret = jax.default_backend() != "tpu"
     b, h, seq_q, head_dim = q.shape
     seq_kv = k.shape[2]
-    block_q = _resolve_block(block_q, seq_q)
-    block_kv = _resolve_block(block_kv, seq_kv)
+    block_q = _resolve_block(block_q, seq_q, "block_q")
+    block_kv = _resolve_block(block_kv, seq_kv, "block_kv")
     if causal and seq_q > seq_kv:
         # Rows with zero visible keys are degenerate (the reference
         # softmaxes an all-masked row into uniform weights; the kernel

@@ -5,6 +5,7 @@ same code path compiles via Mosaic (exercised by bench.py / examples).
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -378,3 +379,70 @@ def test_block_autofit_odd_lengths():
         out = flash_attention(q, k, v, causal=True)
         ref = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_tuned_block_table_consulted(tmp_path, monkeypatch):
+    """_resolve_block prefers the committed swept table for a matching
+    seq and falls back to the 256 target otherwise."""
+    import json
+
+    from tensorflow_examples_tpu.ops import attention
+
+    attention._tuned_block_table.cache_clear()
+    monkeypatch.setattr(  # monkeypatch restores the lru_cache'd original
+        attention, "_tuned_block_table",
+        lambda: {"1024": {"block_q": 512, "block_kv": 128}},
+    )
+    assert attention._resolve_block(None, 1024, "block_q") == 512
+    assert attention._resolve_block(None, 1024, "block_kv") == 128
+    assert attention._resolve_block(None, 2048, "block_q") == 256
+    # explicit sizes still win over the table
+    assert attention._resolve_block(128, 1024, "block_q") == 128
+
+
+def test_tuned_block_table_loader_handles_absent_file():
+    from tensorflow_examples_tpu.ops import attention
+
+    attention._tuned_block_table.cache_clear()
+    table = attention._tuned_block_table()
+    assert isinstance(table, dict)  # {} when no sweep is banked
+    attention._tuned_block_table.cache_clear()
+
+
+def test_flash_table_from_sweep_tool(tmp_path):
+    import json
+    import subprocess
+    import sys as _sys
+
+    sweep = {
+        "complete": True,
+        "shapes": [
+            {"name": "s1024", "batch": 8, "heads": 12, "seq": 1024,
+             "head_dim": 64, "causal": True,
+             "best_fwd": {"block_q": 256, "block_kv": 256, "fwd_ms": 1.0},
+             "best_fwdbwd": {"block_q": 512, "block_kv": 256,
+                             "fwdbwd_ms": 3.0}},
+        ],
+    }
+    p = tmp_path / "sweep.json"
+    p.write_text(json.dumps(sweep))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    r = subprocess.run(
+        [_sys.executable,
+         os.path.join(repo, "tools", "flash_table_from_sweep.py"), str(p)],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    table = json.loads((tmp_path / "flash_block_table.json").read_text())
+    assert table["by_seq"]["1024"]["block_q"] == 512
+    # partial sweep refused
+    sweep["complete"] = False
+    p.write_text(json.dumps(sweep))
+    r = subprocess.run(
+        [_sys.executable,
+         os.path.join(repo, "tools", "flash_table_from_sweep.py"), str(p)],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 1

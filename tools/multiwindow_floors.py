@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Median-of-separate-windows floor stamps for the repeat benches.
+
+The harvest's rotate_repeats banks one record per LIVE WINDOW for each
+bench in REPEAT_BENCHES (live copy in ``results/``, earlier windows in
+``results/history/<bench>.w<N>.json``). A single-window floor on a rig
+whose dispatch rate drifts 3x between windows is not a trustworthy
+regression gate (VERDICT r4 missing #4); this tool turns the
+accumulated per-window records into ONE median stamp per metric:
+
+- value: median of the per-window record values (each itself a
+  median-of-3 in-window timings);
+- fingerprint: the fingerprint of the record that supplied the median
+  value (floors are (value, fingerprint) pairs — the pair must come
+  from the same measurement);
+- rel_mfu: same record's.
+
+Prints ready-to-paste FLOORS / REL_MFU_FLOORS lines plus the
+window spread. Feed the printed JSON to apply_floors.py with
+``--from-multiwindow`` semantics by writing it to a file and running
+``python tools/apply_floors.py <file> --partial``.
+
+Usage: python tools/multiwindow_floors.py /tmp/tpu_harvest/results
+"""
+
+import glob
+import json
+import os
+import statistics
+import sys
+
+
+def collect(results_dir: str) -> dict:
+    """bench name -> list of records (live + history), window order."""
+    out = {}
+    hist = os.path.join(results_dir, "history")
+    for path in sorted(glob.glob(os.path.join(hist, "*.w*.json"))):
+        bench = os.path.basename(path).split(".w")[0]
+        with open(path) as f:
+            out.setdefault(bench, []).append(json.load(f))
+    for bench in list(out):
+        live = os.path.join(results_dir, f"{bench}.json")
+        if os.path.exists(live):
+            with open(live) as f:
+                out[bench].append(json.load(f))
+    return out
+
+
+def median_record(recs: list) -> dict:
+    """The record supplying the median value (lower-median for even
+    counts, so the stamp always corresponds to a real measurement)."""
+    vals = sorted(r["value"] for r in recs)
+    med = statistics.median_low(vals)
+    return next(r for r in recs if r["value"] == med)
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    per_bench = collect(sys.argv[1])
+    if not per_bench:
+        print("multiwindow_floors: no history records found")
+        return 1
+    stamp = {"backend": None, "records": []}
+    for bench, recs in sorted(per_bench.items()):
+        backends = {r.get("backend") for r in recs}
+        if len(backends) != 1:
+            print(f"{bench}: MIXED backends {backends} — skipping")
+            continue
+        stamp["backend"] = backends.pop()
+        rec = median_record(recs)
+        vals = sorted(round(r["value"], 4) for r in recs)
+        print(
+            f"{bench}: {len(recs)} windows {vals} -> median record "
+            f"value={rec['value']} fp={rec.get('fingerprint_tflops_pre')} "
+            f"rel_mfu={rec.get('rel_mfu')}"
+        )
+        print(
+            f'  FLOORS:         "{rec["metric"]}": '
+            f"({rec['value']}, {rec.get('fingerprint_tflops_pre')}),"
+        )
+        if "rel_mfu" in rec:
+            print(
+                f'  REL_MFU_FLOORS: "{rec["metric"]}": {rec["rel_mfu"]},'
+            )
+        stamp["records"].append(rec)
+    out_path = os.path.join(sys.argv[1], "multiwindow_stamp.json")
+    if stamp["records"]:
+        # apply_floors-compatible shape: head record + extras.
+        head, extras = stamp["records"][0], stamp["records"][1:]
+        merged = dict(head)
+        merged["extras"] = extras
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+        print(f"stamp record written: {out_path} (apply with "
+              "tools/apply_floors.py <path> --partial)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,6 +143,35 @@ def _combine(yout, flat_slots, keeps, gates, n):
     return out
 
 
+def _grouped_matmul(lhs, rhs, sizes):
+    """[m, k] x [g, k, n] with per-group row segments -> [m, n].
+
+    TPU: the MegaBlocks-style Pallas grouped-matmul kernel
+    (jax.experimental megablox ``gmm``, custom-vjp complete — dlhs via
+    gmm, drhs via tgmm), which does ~1x the ideal FLOPs with MXU-tiled
+    segments. Everywhere else (and for tile-incompatible shapes):
+    ``lax.ragged_dot``, whose generic lowering masks a [g, m, k]
+    broadcast into one batched dot — g x the ideal FLOPs, fine for
+    tests/CPU but exactly what the gmm path exists to avoid on the
+    chip."""
+    m, k, n = lhs.shape[0], lhs.shape[1], rhs.shape[-1]
+    # m (rows) is the one dimension megablox gmm REQUIRES to be
+    # tile-divisible (make_group_metadata raises otherwise, e.g. any
+    # decode-time token count); k/n remainders it masks internally, but
+    # tiny k/n would under-fill the MXU anyway — ragged_dot both cases.
+    if (
+        jax.default_backend() == "tpu"
+        and m % 128 == 0
+        and k % 128 == 0
+        and n % 128 == 0
+    ):
+        from jax.experimental.pallas.ops.tpu.megablox import ops as megablox
+
+        # positional: custom_vjp nondiff_argnums forbids keywords here
+        return megablox.gmm(lhs, rhs, sizes, lhs.dtype)
+    return lax.ragged_dot(lhs, rhs, sizes)
+
+
 @jax.custom_vjp
 def _permute_rows(x, perm, inv_perm):
     """``x[perm]`` with a GATHER backward.
@@ -216,11 +245,11 @@ def _moe_ffn_grouped(
     )                                                     # [n·k, d]
     srt_eid = jnp.take(eid, order, axis=0)
 
-    h = lax.ragged_dot(srt_tok, w_in, sizes) + jnp.take(
+    h = _grouped_matmul(srt_tok, w_in, sizes) + jnp.take(
         b_in, srt_eid, axis=0
     )
     h = jax.nn.gelu(h, approximate=True)
-    y = lax.ragged_dot(h, w_out, sizes) + jnp.take(b_out, srt_eid, axis=0)
+    y = _grouped_matmul(h, w_out, sizes) + jnp.take(b_out, srt_eid, axis=0)
 
     yw = y.astype(jnp.float32) * _permute_rows(gat, order, inv)[:, None]
     restored = _permute_rows(yw, inv, order)              # pair order

@@ -197,6 +197,90 @@ def _permute_rows_bwd(res, g):
 _permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
 
 
+@jax.custom_vjp
+def _masked_row_gather(src, idx, valid, inv_idx, inv_valid):
+    """``src[idx] * valid`` where (idx, valid) describes an INJECTIVE
+    row map (no two outputs read the same valid source row) and
+    (inv_idx, inv_valid) is its precomputed inverse. The cotangent is
+    then the inverse masked gather — never a scatter (the capacity
+    slotting below precomputes both directions from one argsort)."""
+    del inv_idx, inv_valid
+    return src[idx] * valid[:, None].astype(src.dtype)
+
+
+def _masked_row_gather_fwd(src, idx, valid, inv_idx, inv_valid):
+    out = src[idx] * valid[:, None].astype(src.dtype)
+    return out, (inv_idx, inv_valid)
+
+
+def _masked_row_gather_bwd(res, g):
+    inv_idx, inv_valid = res
+    return (
+        g[inv_idx] * inv_valid[:, None].astype(g.dtype),
+        None, None, None, None,
+    )
+
+
+_masked_row_gather.defvjp(_masked_row_gather_fwd, _masked_row_gather_bwd)
+
+
+def _pair_sort(experts, e):
+    """The shared sort prelude of both sorted formulations: flatten
+    (token, rank) pairs TOKEN-MAJOR (pair p = (token p//k, rank p%k)),
+    stable-argsort by expert. Returns (eid, order, inv, sizes)."""
+    eid = jnp.stack(experts, axis=1).reshape(-1)          # [n·k]
+    order = jnp.argsort(eid)                              # stable
+    inv = jnp.argsort(order)
+    sizes = jnp.bincount(eid, length=e)
+    return eid, order, inv, sizes
+
+
+def _capacity_slots_sorted(tokens, experts, top_k, e, capacity):
+    """Build the [E·C, d] dispatch buffer (the EP all-to-all transport
+    format) by SORTED GATHERS instead of scatter-adds.
+
+    One argsort of the (token, rank) pairs by expert yields both
+    directions of the pair↔slot bijection (each capacity slot is
+    filled by at most one kept pair), so dispatch fwd/bwd and combine
+    fwd/bwd are all masked gathers via _masked_row_gather — the
+    shard_map EP path has no row-granularity scatter left.
+
+    Queue order is sorted-pair order (token-major), not the scatter
+    reference's rank-major cumsum — a different overflow victim set,
+    same per-(source, expert) quota semantics; identical whenever
+    nothing drops (the parity-tested regime).
+
+    Returns (xin [E·C, d], pair_slot [n·k], pair_keep [n·k],
+    slot_pair [E·C], slot_valid [E·C], kept scalar).
+    """
+    n = tokens.shape[0]
+    nk = n * top_k
+    eid, order, inv, sizes = _pair_sort(experts, e)
+    offsets = jnp.cumsum(sizes) - sizes
+    pos = inv - jnp.take(offsets, eid)                    # queue position
+    pair_keep = pos < capacity
+    pair_slot = eid * capacity + jnp.clip(pos, 0, capacity - 1)
+    # slot (e, c) <- sorted row offsets[e] + c when c < sizes[e]; that
+    # sorted row is pair order[offsets[e] + c], so the slot reads the
+    # PAIR directly (one composed gather — no intermediate sorted
+    # [n·k, d] copy) and (pair_slot, pair_keep) is its exact inverse.
+    slot_j = offsets[:, None] + jnp.arange(capacity)[None, :]   # [E, C]
+    slot_valid = (
+        jnp.arange(capacity)[None, :] < sizes[:, None]
+    ).reshape(-1)
+    slot_j = jnp.clip(slot_j, 0, nk - 1).reshape(-1)
+    slot_pair = jnp.take(order, slot_j)
+    xin = _masked_row_gather(
+        jnp.repeat(tokens, top_k, axis=0),
+        slot_pair,
+        slot_valid,
+        pair_slot,
+        pair_keep,
+    )
+    kept = jnp.sum(pair_keep.astype(jnp.int32))
+    return xin, pair_slot, pair_keep, slot_pair, slot_valid, kept
+
+
 def _moe_ffn_grouped(
     gate_w, w_in, b_in, w_out, b_out, x, *, top_k, rng, jitter
 ):
@@ -230,16 +314,13 @@ def _moe_ffn_grouped(
     )
     aux = e * jnp.sum(moh0 * mpr)
 
-    # Pair p = (token p // k, rank p % k), row-major over tokens. Both
-    # permutation hops ride _permute_rows so fwd AND bwd are gathers
-    # (argsort hands us the inverse for free); the token replication is
-    # a jnp.repeat, whose transpose is a contiguous [n, k] reduce — the
-    # whole fwd+bwd dispatch path is scatter-free.
-    eid = jnp.stack(experts, axis=1).reshape(-1)          # [n·k] int
+    # Both permutation hops ride _permute_rows so fwd AND bwd are
+    # gathers (argsort hands us the inverse for free); the token
+    # replication is a jnp.repeat, whose transpose is a contiguous
+    # [n, k] reduce — the whole fwd+bwd dispatch path is scatter-free.
+    eid, order, inv, sizes = _pair_sort(experts, e)
+    sizes = sizes.astype(jnp.int32)
     gat = jnp.stack(gates, axis=1).reshape(-1)            # [n·k] f32
-    order = jnp.argsort(eid)                              # stable
-    inv = jnp.argsort(order)
-    sizes = jnp.bincount(eid, length=e).astype(jnp.int32)  # [E]
     srt_tok = _permute_rows(
         jnp.repeat(tokens, top_k, axis=0), order, inv
     )                                                     # [n·k, d]
@@ -418,20 +499,24 @@ def moe_ffn_ep(
             # Decorrelate router jitter across token shards.
             for a in route_axes:
                 key = jax.random.fold_in(key, lax.axis_index(a))
-        gates, flat_slots, keeps, moh0, mpr, kept = _route(
-            tokens, gw, top_k=top_k, capacity=capacity, rng=key,
-            jitter=jitter,
+        gates, experts, moh0, mpr = _router(
+            tokens, gw, top_k=top_k, rng=key, jitter=jitter
         )
         if route_axes:
             moh0 = lax.pmean(moh0, route_axes)
             mpr = lax.pmean(mpr, route_axes)
         aux = e * jnp.sum(moh0 * mpr)
+        # Sorted-gather capacity slotting (round 5): the dispatch
+        # buffer and the combine are masked gathers in BOTH fwd and
+        # bwd — no row-granularity scatter inside the EP program.
+        xin, pair_slot, pair_keep, slot_pair, slot_valid, kept = (
+            _capacity_slots_sorted(tokens, experts, top_k, e, capacity)
+        )
         drop = 1.0 - kept.astype(jnp.float32) / (n_loc * top_k)
         if route_axes:
             drop = lax.pmean(drop, route_axes)
 
         # [E·C, d] → [m, E/m, C, d]: group g's slice belongs to device g.
-        xin = _dispatch(tokens, flat_slots, keeps, e, capacity)
         xin = xin.reshape(m, e // m, capacity, d)
         # One hop: device g receives [m(src), E/m, C, d] for ITS experts.
         recv = lax.all_to_all(
@@ -445,8 +530,16 @@ def moe_ffn_ep(
         yout = lax.all_to_all(
             yloc, AxisNames.MODEL, split_axis=0, concat_axis=0
         )
-        out = _combine(yout.reshape(e, capacity, d), flat_slots, keeps,
-                       gates, n_loc).astype(xl.dtype)
+        # Combine: each (token, rank) pair reads its slot (masked
+        # gather; inverse = slot->pair map), gates, sums over ranks.
+        yflat = yout.reshape(e * capacity, d).astype(jnp.float32)
+        gat = jnp.stack(gates, axis=1).reshape(-1)  # [n_loc·k] f32
+        y_pair = _masked_row_gather(
+            yflat, pair_slot, pair_keep, slot_pair, slot_valid
+        )
+        out = jnp.sum(
+            (y_pair * gat[:, None]).reshape(n_loc, top_k, d), axis=1
+        ).astype(xl.dtype)
         if split:
             # Reassemble the model-split blocks (gather order == the
             # axis_index order used for the dynamic_slice above).

@@ -308,6 +308,54 @@ class TestExplicitEP:
                 err_msg=f"d{name}",
             )
 
+    def test_sorted_capacity_slotting_invariants(self):
+        """_capacity_slots_sorted under OVERFLOW: the pair<->slot maps
+        stay mutually inverse bijections on the kept set, the buffer
+        holds exactly the kept tokens, and the kept count is
+        sum_e min(count_e, capacity)."""
+        import numpy as np
+
+        from tensorflow_examples_tpu.parallel.moe import (
+            _capacity_slots_sorted,
+        )
+
+        rng = np.random.default_rng(0)
+        # cap 14 vs per-expert pair counts [12, 9, 27, 18] (this seed):
+        # two experts UNDERFILL (invalid-slot branch) and two OVERFLOW
+        # (dropped-pair branch) — both sides of the quota exercised.
+        n, d, e, top_k, cap = 33, 5, 4, 2, 14
+        tokens = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+        experts = [
+            jnp.asarray(rng.integers(0, e, n), jnp.int32)
+            for _ in range(top_k)
+        ]
+        xin, pair_slot, pair_keep, slot_pair, slot_valid, kept = (
+            _capacity_slots_sorted(tokens, experts, top_k, e, cap)
+        )
+        eid = np.stack([np.asarray(x) for x in experts], 1).reshape(-1)
+        counts = np.bincount(eid, minlength=e)
+        assert int(kept) == int(np.minimum(counts, cap).sum())
+        ps, pk = np.asarray(pair_slot), np.asarray(pair_keep)
+        sp, sv = np.asarray(slot_pair), np.asarray(slot_valid)
+        x = np.asarray(xin)
+        filled = 0
+        for slot in range(e * cap):
+            if not sv[slot]:
+                # invalid slots are zero and (if in range) not claimed
+                assert np.all(x[slot] == 0)
+                continue
+            p = sp[slot]
+            assert pk[p] and ps[p] == slot  # inverse bijection
+            assert eid[p] == slot // cap  # right expert's queue
+            np.testing.assert_array_equal(
+                x[slot], np.asarray(tokens)[p // top_k]
+            )
+            filled += 1
+        assert filled == int(kept)
+        # every kept pair's slot points back at it
+        for p in np.nonzero(pk)[0]:
+            assert sv[ps[p]] and sp[ps[p]] == p
+
     def test_ep_fallback_without_model_axis(self):
         """E % model != 0 (or model == 1) must fall through to the
         single-program path and still be correct."""

@@ -395,6 +395,30 @@ class TestApplyFloors:
         with pytest.raises(SystemExit, match="m_b"):
             af._rewrite(src, "FLOORS", "tpu", {"m_b": "(5.0, 50.0)"})
 
+    def test_bundle_protocol_stamped_with_floor(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        """A restamp carries the record's launch protocol into
+        FLOOR_BUNDLES (dry-run against the real bench.py — the floors
+        policy says protocol moves WITH the floor)."""
+        af = self._mod()
+        rec = {
+            "backend": "tpu",
+            "metric": "bert_base_examples_per_sec_per_chip",
+            "bench": "bert", "value": 25000.0,
+            "fingerprint_tflops_pre": 50000.0, "bundle": 8,
+        }
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(rec))
+        monkeypatch.setattr(
+            sys, "argv", ["apply_floors.py", str(p), "--dry-run"]
+        )
+        monkeypatch.chdir(REPO)
+        assert af.main() == 0
+        diff = capsys.readouterr().out
+        assert '"bert_base_examples_per_sec_per_chip": (25000.0, 50000.0),' in diff
+        assert '"bert_base_examples_per_sec_per_chip": 8,' in diff
+
     def test_truncated_record_needs_partial_flag(self, tmp_path, monkeypatch, capsys):
         af = self._mod()
         rec = {"backend": "tpu", "metric": "m_a", "value": 3.0,

@@ -397,8 +397,19 @@ class Trainer:
                 ):
                     jax.profiler.start_trace(cfg.workdir or "/tmp/tpu_profile")
                     profiling = True
-                batch = next(train_iter)
-                self.state, metrics = step_fn(self.state, batch)
+                # StepTraceAnnotation marks step boundaries in the
+                # profiler timeline (SURVEY §5a); next() sits INSIDE it
+                # so host input-wait shows up in the per-step
+                # input/compute breakdown. A no-op when no trace is
+                # active. NB with steps_per_launch=k>1 one annotation
+                # spans the whole k-step bundle (step_num advances by
+                # k): divide trace step times by k when comparing
+                # against unbundled runs.
+                with jax.profiler.StepTraceAnnotation(
+                    "train", step_num=step
+                ):
+                    batch = next(train_iter)
+                    self.state, metrics = step_fn(self.state, batch)
                 if watchdog is not None:
                     # Dispatch is async; sync points (log flushes) bound
                     # how stale this is — good enough for hang detection.

@@ -94,7 +94,8 @@ def _trace_gpt2(steps: int = 10, warmup: int = 5) -> dict:
     jax.profiler.start_trace(TRACE_DIR)
     t0 = time.perf_counter()
     for i in range(steps):
-        state, _ = trainer._train_step(state, batches[i % 4])
+        with jax.profiler.StepTraceAnnotation("train", step_num=i):
+            state, _ = trainer._train_step(state, batches[i % 4])
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
     jax.profiler.stop_trace()

@@ -67,6 +67,12 @@ class TransformerConfig:
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 1
+    # Single-program dispatch formulation: "" = backend default
+    # (grouped on TPU, scatter elsewhere — parallel/moe.py). Pin
+    # "grouped" or "scatter" when a run must compute the SAME
+    # function across backends (grouped is dropless; scatter drops
+    # at capacity).
+    moe_impl: str = ""
 
     @property
     def head_dim(self) -> int:
@@ -270,6 +276,7 @@ class MoeMlp(nn.Module):
             capacity_factor=cfg.moe_capacity_factor,
             top_k=cfg.moe_top_k,
             rng=rng,
+            impl=cfg.moe_impl or None,
         )
         self.sow("intermediates", "moe_aux", aux)
         self.sow("intermediates", "moe_drop", drop)

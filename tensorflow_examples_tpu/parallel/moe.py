@@ -354,7 +354,7 @@ def moe_ffn(
     top_k: int = 1,
     rng: jax.Array | None = None,
     jitter: float = 1e-2,
-    impl: str = "grouped",
+    impl: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k MoE FFN (single-program formulations).
 
@@ -362,11 +362,18 @@ def moe_ffn(
     ``drop_fraction`` is the fraction of (token, rank) assignments that
     overflowed expert capacity and fell through the residual.
 
-    ``impl``: ``"grouped"`` (default) — sort-based dropless dispatch
-    through ``lax.ragged_dot`` (drop_fraction ≡ 0; the TPU hot path);
+    ``impl``: ``"grouped"`` — sort-based dropless dispatch through
+    grouped matmuls (drop_fraction ≡ 0; megablox gmm on TPU);
     ``"scatter"`` — the static-capacity scatter/gather formulation
-    (Switch drop semantics, the EP transport's reference).
+    (Switch drop semantics, the EP transport's reference). Default
+    (None) resolves by backend: "grouped" on TPU — where the grouped
+    matmul is a real Pallas kernel and row scatters serialize — and
+    "scatter" elsewhere, where the grouped path's ragged_dot fallback
+    lowers to an E-times-FLOPs masked dot (measured ~6x slower than
+    scatter on this CPU) and would skew CPU floors for no benefit.
     """
+    if impl is None:
+        impl = "grouped" if jax.default_backend() == "tpu" else "scatter"
     if impl not in ("grouped", "scatter"):
         raise ValueError(
             f"moe_ffn impl={impl!r} unknown (expected 'grouped' or "
@@ -411,8 +418,13 @@ def moe_ffn_ep(
     top_k: int = 1,
     rng: jax.Array | None = None,
     jitter: float = 1e-2,
+    impl: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Explicit expert-parallel MoE FFN: all-to-all token exchange.
+
+    ``impl`` applies to the SINGLE-PROGRAM fallback only (trivial/
+    non-dividing ``model`` axis — see moe_ffn); the shard_map EP path
+    is capacity-based by construction (fixed-size all-to-all buffers).
 
     Same routing math as :func:`moe_ffn`, but dispatch is a
     ``shard_map`` program with POINT-TO-POINT token exchange
@@ -464,7 +476,7 @@ def moe_ffn_ep(
         return moe_ffn(
             gate_w, w_in, b_in, w_out, b_out, x,
             capacity_factor=capacity_factor, top_k=top_k,
-            rng=rng, jitter=jitter,
+            rng=rng, jitter=jitter, impl=impl,
         )
     top_k = min(top_k, e)
     # Token sharding via the shared axis-dropping policy

@@ -62,6 +62,9 @@ class Gpt2Config(TrainConfig):
     moe_every: int = 2
     moe_top_k: int = 1
     moe_aux_weight: float = 0.01
+    # "" = backend default (grouped on TPU, scatter elsewhere); pin
+    # "grouped"/"scatter" for cross-backend-identical numerics.
+    moe_impl: str = ""
     # Vocab-parallel LM head + fused CE over the `model` axis (Megatron
     # parallel cross-entropy): the [tokens, 50257] logits never exist;
     # each shard holds [tokens, V/m]. Requires mesh_model > 1.
@@ -100,6 +103,7 @@ def model_config(cfg: Gpt2Config) -> transformer.TransformerConfig:
         moe_experts=cfg.moe_experts,
         moe_every=cfg.moe_every,
         moe_top_k=cfg.moe_top_k,
+        moe_impl=cfg.moe_impl,
     )
 
 

@@ -535,7 +535,8 @@ def test_moe_capacity_overflow_drops():
     )
     assert out.shape == (1, n, d) and np.isfinite(np.asarray(out)).all()
     assert float(drop) > 0.5, float(drop)
-    # The grouped (default) path never drops — even at absurd capacity.
+    # The grouped path (the TPU default) never drops — even at absurd
+    # capacity settings.
     _, _, drop_g = moe_ffn(
         jax.random.normal(ks[0], (d, e)),
         jax.random.normal(ks[1], (e, d, ff)) * 0.1,
@@ -544,9 +545,12 @@ def test_moe_capacity_overflow_drops():
         jnp.zeros((e, d)),
         jax.random.normal(ks[3], (1, n, d)),
         capacity_factor=0.1,
+        impl="grouped",
     )
     assert float(drop_g) == 0.0, float(drop_g)
-    # And with generous capacity nothing at all drops.
+    # And with generous capacity the SCATTER capacity math drops
+    # nothing (explicit impl: the backend-resolved default would pick
+    # the grouped path on TPU, whose 0.0 is a tautology).
     _, _, drop2 = moe_ffn(
         jax.random.normal(ks[0], (d, e)),
         jax.random.normal(ks[1], (e, d, ff)) * 0.1,
@@ -555,6 +559,7 @@ def test_moe_capacity_overflow_drops():
         jnp.zeros((e, d)),
         jax.random.normal(ks[3], (1, n, d)),
         capacity_factor=float(e),
+        impl="scatter",
     )
     assert float(drop2) == 0.0, float(drop2)
 

@@ -4,26 +4,34 @@ Framework-native extension (SURVEY.md §2d notes the reference has no MoE
 workload; EP is provided as a first-class capability of the parallelism
 layer). Switch/GShard-style top-k routing, TPU-first:
 
-- Static shapes everywhere: tokens are routed with a fixed per-expert
-  ``capacity``; overflow tokens fall through the residual connection
-  (standard Switch behavior) — no dynamic shapes under jit. The dropped
-  fraction is returned so training can LOG it (a silently-high drop rate
-  is the classic MoE failure mode).
-- Dispatch/combine are index ops — a scatter-add into the ``[E, C, d]``
-  expert buffers and a gather back — O(n·d) memory and data movement.
-  (The round-1 formulation built a dense one-hot ``[n, E, C]`` dispatch
-  tensor and einsummed against it: O(n·E·C) memory — fine for toy
-  shapes, dead at real n·E. VERDICT r1 item 8.)
-- Experts are the *same* FFN pytree with a leading [experts] axis,
-  sharded over the ``model`` mesh axis (GPT2_RULES). Activations inside
-  the blocks are replicated over ``model`` (TP shards heads/ff, not
-  tokens), so under XLA SPMD the scatter lands tokens directly on the
-  expert's shard and the combine gathers back — collectives over ICI
-  are inserted by the partitioner, the reference stack's hand-written
-  NCCL all-to-all has no user-space equivalent here (SURVEY.md §2c).
-- Router computes in f32 with jitter noise at train time and the Switch
-  auxiliary load-balancing loss (mean fraction · mean prob per expert,
-  over rank-0 assignments).
+Three dispatch formulations share one router:
+
+- ``moe_ffn(impl="grouped")`` — sort-based DROPLESS dispatch (round 5,
+  the TPU single-program default): argsort (token, rank) pairs by
+  expert → grouped matmuls over contiguous segments (MegaBlocks
+  ``megablox.gmm`` Pallas kernel at tile-divisible shapes, masked
+  ``lax.ragged_dot`` otherwise) → inverse-permutation gather → gated
+  sum. Scatter-free in fwd AND bwd (custom-vjp permutation/partial-
+  permutation gathers): the round-4 harvest measured the scatter
+  formulation leaving the chip >99% idle (rel_mfu 0.00154 vs dense
+  0.0624).
+- ``moe_ffn(impl="scatter")`` — static-capacity Switch semantics (the
+  CPU default and the parity reference): fixed per-expert ``capacity``,
+  overflow falls through the residual — no dynamic shapes under jit;
+  the dropped fraction is returned so training can LOG it (a
+  silently-high drop rate is the classic MoE failure mode).
+- ``moe_ffn_ep`` — explicit expert parallelism under ``shard_map``:
+  capacity buffers (the fixed-size all-to-all transport format) built
+  by the SORTED-GATHER slotting (scatter-free), one ``lax.all_to_all``
+  hop each way over the ``model`` axis.
+
+Experts are the *same* FFN pytree with a leading [experts] axis,
+sharded over the ``model`` mesh axis (GPT2_RULES). The router computes
+in f32 with jitter noise at train time and the Switch auxiliary
+load-balancing loss (mean fraction · mean prob per expert, over rank-0
+assignments). (The round-1 formulation built a dense one-hot
+``[n, E, C]`` dispatch tensor and einsummed against it: O(n·E·C)
+memory — fine for toy shapes, dead at real n·E. VERDICT r1 item 8.)
 
 ``moe_ffn`` is pure (params in, tokens out) so it slots into flax
 modules (models/transformer.py MoeMlp) and composes with remat/scan.

@@ -94,23 +94,25 @@ FLOORS = {
         # matmul probe ran faster; dispatch-rate differences are the
         # suspect (those stamps predate the launch-µs fingerprint), see
         # BASELINE.md for the diag.
-        "resnet50_examples_per_sec_per_chip": (185187.0, 65958.3),
-        "resnet50_input_examples_per_sec_per_chip": (80.3, 60547.46),  # 1-CPU host!
-        "gpt2_124m_tokens_per_sec": (3592223.0, 59962.35),
-        "gpt2_long4k_tokens_per_sec": (4231329.0, 47927.17),
-        "gpt2_long16k_tokens_per_sec": (9130385.0, 70377.3),
-        "gpt2_decode_tokens_per_sec": (3094517.0, 62363.12),
+        "resnet50_examples_per_sec_per_chip": (185187.0807, 65958.3),
+        "resnet50_input_examples_per_sec_per_chip": (124.0052, 53598.89),  # 1-CPU host!
+        "gpt2_124m_tokens_per_sec": (3592223.8352, 59962.35),
+        "gpt2_long4k_tokens_per_sec": (4231329.5553, 47927.17),
+        "gpt2_long16k_tokens_per_sec": (9130385.6576, 70377.3),
+        "gpt2_decode_tokens_per_sec": (3094517.5665, 62363.12),
         "gpt2_decode_long_tokens_per_sec": (1510532.0, 51264.06),
-        # bert/cifar10/mnist floors below were stamped at 1 step/launch;
-        # their TPU benches now run the bundled loop (steps_per_launch=8,
-        # the "bundle" key in each record), so until the first bundled
-        # harvest restamps them, vs_baseline on these three reads as
-        # "bundled loop vs per-step floor" — a launch-amortization gain,
-        # not a per-step program change (the scanned body is identical).
-        "bert_base_examples_per_sec_per_chip": (19348.0, 41795.56),
-        "cifar10_resnet20_examples_per_sec_per_chip": (102784.0, 61254.47),
-        "mnist_mlp_step_time": (0.1114, 76867.42),  # ms/step
-        "allreduce_busbw": (3401.0, 86610.5),  # GB/s, n=1 loopback
+        # bert/cifar10/mnist: restamped 2026-08-01 from the round-5
+        # harvest's first live window under the K=8 bundled protocol
+        # (FLOOR_BUNDLES carries the 8; a future unbundled record flags
+        # floor_protocol_mismatch). The window's dispatch was fast
+        # (launch ~15-19 µs vs the ~ms-scale round-4 instances), so
+        # these floors encode bundling AND a healthy tunnel — rel_mfu
+        # and the per-record fingerprints are the cross-instance
+        # comparables, per the floors policy.
+        "bert_base_examples_per_sec_per_chip": (174256.466, 69610.49),
+        "cifar10_resnet20_examples_per_sec_per_chip": (1602954.8218, 54962.94),
+        "mnist_mlp_step_time": (0.0104, 55840.55),  # ms/step
+        "allreduce_busbw": (3401.0685, 86610.5),  # GB/s, n=1 loopback
         "moe_top2_tokens_per_sec": (62555.0, 45538.05),
         # decode_grid_step_time_ratio is deliberately NOT floored: it is
         # a diagnostic whose healthy value is ~1.0 (O(context)
@@ -149,12 +151,23 @@ FLOORS = {
 # stamped unbundled, bundle=1). _result flags "floor_protocol_mismatch"
 # whenever a record's bundle differs from its floor's — vs_baseline
 # across that boundary mixes launch amortization with per-step change.
-# Restamps must move these entries together with FLOORS (the round-4
-# bundled-loop protocol change pre-registered bert/cifar10/mnist at
-# K=8; until that harvest lands their floors remain bundle=1 stamps and
-# the flag is expected to fire).
+# Restamps must move these entries together with FLOORS (stamped
+# mechanically by tools/apply_floors.py from each record's "bundle"
+# key; the round-4 pre-registered bert/cifar10/mnist K=8 protocol
+# landed with the 2026-08-01 round-5 restamp below).
 FLOOR_BUNDLES: dict[str, dict[str, int]] = {
-    "tpu": {},
+    "tpu": {
+        "resnet50_examples_per_sec_per_chip": 1,
+        "resnet50_input_examples_per_sec_per_chip": 1,
+        "gpt2_124m_tokens_per_sec": 1,
+        "gpt2_long4k_tokens_per_sec": 1,
+        "gpt2_long16k_tokens_per_sec": 1,
+        "gpt2_decode_tokens_per_sec": 1,
+        "bert_base_examples_per_sec_per_chip": 8,
+        "cifar10_resnet20_examples_per_sec_per_chip": 8,
+        "mnist_mlp_step_time": 8,
+        "allreduce_busbw": 1,
+    },
     "cpu": {},
 }
 
@@ -165,15 +178,20 @@ FLOOR_BUNDLES: dict[str, dict[str, int]] = {
 REL_MFU_FLOORS: dict[str, dict[str, float]] = {
     "tpu": {
         "resnet50_examples_per_sec_per_chip": 0.07961,
-        "resnet50_input_examples_per_sec_per_chip": 4e-05,
+        "resnet50_input_examples_per_sec_per_chip": 6e-05,
         "gpt2_124m_tokens_per_sec": 0.06236,
         "gpt2_long4k_tokens_per_sec": 0.0515,
         "gpt2_long16k_tokens_per_sec": 0.10832,
         "gpt2_decode_tokens_per_sec": 0.01937,
         "gpt2_decode_long_tokens_per_sec": 0.13992,
-        "bert_base_examples_per_sec_per_chip": 0.03419,
-        "cifar10_resnet20_examples_per_sec_per_chip": 0.00044,
-        "mnist_mlp_step_time": 2e-05,
+        # bert/cifar10/mnist rel_mfu floors were DROPPED with the K=8
+        # restamp (2026-08-01): their round-4 stamps were per-step
+        # values, and a bundled record's rel_mfu (chip no longer idle
+        # between launches) would read ~10x over them — a silent
+        # protocol conflation, not an efficiency gain. They return when
+        # the queued re-measure banks bundled records WITH rel_mfu
+        # (the compiled-bundled/k FLOPs fallback) and apply_floors
+        # restamps all three consistently.
         "moe_top2_tokens_per_sec": 0.00154,
     },
     "cpu": {
@@ -580,31 +598,57 @@ def _chip_mesh():
     return create_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
 
 
-def _step_flops(trainer, batch, *, compiled: bool = True) -> "float | None":
+def _step_flops(
+    trainer, batch, *, bundle: int = 1
+) -> "float | None":
     """Analytic FLOPs/step from XLA's cost model on the train step.
 
-    ``compiled=True`` (unbundled benches): analyse the exact compiled
+    ``bundle == 1`` (unbundled benches): analyse the exact compiled
     executable — AOT lower+compile populates the jit cache (verified on
     this rig), so the bench pays the one compile it would pay anyway.
     Call BEFORE the first execution — the step donates its state
     buffers.
 
-    ``compiled=False`` (bundled benches, which execute a DIFFERENT
-    scanned program): analyse the lowering only — no backend compile, so
-    the never-executed single-step program costs no wedge-prone tunnel
-    compile time. Verified on this rig to give the same flops count as
-    the compiled analysis."""
+    ``bundle`` > 1 (bundled benches, which execute a DIFFERENT scanned
+    program; ``batch`` is the [k, ...] stack): first try the
+    single-step LOWERING only — no backend compile, so the
+    never-executed single-step program costs no wedge-prone tunnel
+    compile time. The axon plugin's pre-compile cost model returns
+    None though (the round-5 first window banked bert/cifar10/mnist
+    with no rel_mfu because of it), so when the lowering gives
+    nothing, analyse the compiled BUNDLED program itself — the same
+    executable the bench warms up anyway — and report flops / k.
+    The record's "flops_analysis" key says which path produced the
+    number (ADVICE r4)."""
+    import jax
+
     _step_flops.last_mode = None
-    try:
-        lowered = trainer._train_step.lower(trainer.state, batch)
-        ca = (lowered.compile() if compiled else lowered).cost_analysis()
+
+    def _flops_of(analysable) -> "float | None":
+        ca = analysable.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
+        if ca is None:  # lowering-only analysis unsupported (axon)
+            return None
         f = float(ca.get("flops", 0.0))
-        if f > 0:  # only a usable value earns provenance (a zero-FLOPs
-            # result returns None and must not label a later bench)
-            _step_flops.last_mode = "compiled" if compiled else "lowered"
+        # Only a usable value earns provenance (a zero-FLOPs result
+        # returns None and must not label a later bench).
+        return f if f > 0 else None
+
+    try:
+        one = jax.tree.map(lambda x: x[0], batch) if bundle > 1 else batch
+        use_compiled = bundle == 1
+        lowered = trainer._train_step.lower(trainer.state, one)
+        f = _flops_of(lowered.compile() if use_compiled else lowered)
+        if f is not None:
+            _step_flops.last_mode = "compiled" if use_compiled else "lowered"
             return f
+        if bundle > 1:
+            bundled = trainer._build_bundled_step(bundle)
+            f = _flops_of(bundled.lower(trainer.state, batch).compile())
+            if f is not None:
+                _step_flops.last_mode = "compiled-bundled/k"
+                return f / bundle
         return None
     except Exception as e:  # cost model availability varies by backend
         print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
@@ -1014,13 +1058,12 @@ def bench_bert() -> dict:
     trainer = Trainer(bert_glue.make_task(cfg), cfg, mesh=_chip_mesh())
     ds, _ = bert_glue.datasets(cfg)
     it = train_iterator(ds, cfg.global_batch_size, seed=0)
-    flops = _step_flops(
-        trainer, trainer._put_batch(next(it)), compiled=bundle == 1
-    )
     if bundle > 1:
         batches = _bundle_prep(trainer, it, 2, bundle)
+        flops = _step_flops(trainer, batches[0], bundle=bundle)
     else:
         batches = [trainer._put_batch(next(it)) for _ in range(2)]
+        flops = _step_flops(trainer, batches[0])
     dts = _time_steps(trainer, batches, steps, warmup, bundle=bundle)
     dt_med = statistics.median(dts)
     return _result(
@@ -1058,13 +1101,12 @@ def bench_cifar10() -> dict:
     trainer = Trainer(cifar10.make_task(cfg), cfg, mesh=_chip_mesh())
     ds = synthetic_images(n=2048, shape=(32, 32, 3), num_classes=10, seed=0)
     it = train_iterator(ds, cfg.global_batch_size, seed=0)
-    flops = _step_flops(
-        trainer, trainer._put_batch(next(it)), compiled=bundle == 1
-    )
     if bundle > 1:
         batches = _bundle_prep(trainer, it, 2, bundle)
+        flops = _step_flops(trainer, batches[0], bundle=bundle)
     else:
         batches = [trainer._put_batch(next(it)) for _ in range(4)]
+        flops = _step_flops(trainer, batches[0])
     dts = _time_steps(trainer, batches, steps, warmup, bundle=bundle)
     dt_med = statistics.median(dts)
     return _result(
@@ -1101,13 +1143,12 @@ def bench_mnist() -> dict:
     ds = synthetic_images(n=4096, shape=(28, 28, 1), num_classes=10, seed=0)
     trainer = Trainer(mnist.make_task(cfg), cfg, mesh=_chip_mesh())
     it = train_iterator(ds, cfg.global_batch_size, seed=0)
-    flops = _step_flops(
-        trainer, trainer._put_batch(next(it)), compiled=bundle == 1
-    )
     if bundle > 1:
         batches = _bundle_prep(trainer, it, 4, bundle)
+        flops = _step_flops(trainer, batches[0], bundle=bundle)
     else:
         batches = [trainer._put_batch(next(it)) for _ in range(8)]
+        flops = _step_flops(trainer, batches[0])
     dts = _time_steps(trainer, batches, steps, warmup, bundle=bundle)
     dt_med = statistics.median(dts)
     return _result(
@@ -1264,17 +1305,17 @@ def _moe_mesh_collectives(timeout_s: float = 600.0) -> dict:
         return {"error": f"mesh probe timed out >{timeout_s:.0f}s"}
 
 
-def bench_moe() -> dict:
-    """MoE GPT-2 training throughput (E=8, top-2, every block) on the
-    chip, with the 8-device-mesh dispatch-collective census attached."""
-    from tensorflow_examples_tpu.data.memory import train_iterator
-    from tensorflow_examples_tpu.train.loop import Trainer
+def moe_bench_config(moe_impl: str = ""):
+    """The ONE moe-bench model/workload config, shared with
+    tools/moe_diag.py so the diagnosis always times the exact program
+    the ``moe_top2_tokens_per_sec`` record measures (a drifted copy
+    would attribute the wrong workload)."""
     from tensorflow_examples_tpu.workloads import gpt2
 
     tpu = BACKEND == "tpu"
     batch = 8 if tpu else 1
     seq = 1024 if tpu else 128
-    cfg = gpt2.Gpt2Config(
+    return gpt2.Gpt2Config(
         global_batch_size=batch,
         seq_len=seq,
         dropout=0.0,
@@ -1284,6 +1325,7 @@ def bench_moe() -> dict:
         moe_experts=8,
         moe_top_k=2,
         moe_every=2,
+        moe_impl=moe_impl,
         log_every=10**9,
         checkpoint_every=0,
         train_steps=10**6,
@@ -1292,6 +1334,19 @@ def bench_moe() -> dict:
             vocab_size=512, num_layers=2, num_heads=4, d_model=64
         )),
     )
+
+
+def bench_moe() -> dict:
+    """MoE GPT-2 training throughput (E=8, top-2, every 2nd block) on
+    the chip, with the 8-device-mesh dispatch-collective census
+    attached."""
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.workloads import gpt2
+
+    tpu = BACKEND == "tpu"
+    cfg = moe_bench_config()
+    batch, seq = cfg.global_batch_size, cfg.seq_len
     steps, warmup = (20, 5) if tpu else (3, 1)
     trainer = Trainer(gpt2.make_task(cfg), cfg, mesh=_chip_mesh())
     ds, _ = gpt2.datasets(cfg)

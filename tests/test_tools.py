@@ -402,11 +402,14 @@ class TestApplyFloors:
         FLOOR_BUNDLES (dry-run against the real bench.py — the floors
         policy says protocol moves WITH the floor)."""
         af = self._mod()
+        # bundle=4 differs from bench.py's current stamp (8) on purpose:
+        # the assertion needs the rewrite to CHANGE the line, or it
+        # cannot appear in the dry-run diff at all.
         rec = {
             "backend": "tpu",
             "metric": "bert_base_examples_per_sec_per_chip",
             "bench": "bert", "value": 25000.0,
-            "fingerprint_tflops_pre": 50000.0, "bundle": 8,
+            "fingerprint_tflops_pre": 50000.0, "bundle": 4,
         }
         p = tmp_path / "r.json"
         p.write_text(json.dumps(rec))
@@ -417,7 +420,7 @@ class TestApplyFloors:
         assert af.main() == 0
         diff = capsys.readouterr().out
         assert '"bert_base_examples_per_sec_per_chip": (25000.0, 50000.0),' in diff
-        assert '"bert_base_examples_per_sec_per_chip": 8,' in diff
+        assert '"bert_base_examples_per_sec_per_chip": 4,' in diff
 
     def test_truncated_record_needs_partial_flag(self, tmp_path, monkeypatch, capsys):
         af = self._mod()

@@ -263,9 +263,10 @@ EOF
 # tunnel-wedging compile) can't starve the others. Each banks its last
 # parseable JSON line to a fixed dest iff its gate holds, and is never
 # re-run once banked.
-ONESHOTS="diag tune profile lsebisect"
+ONESHOTS="moediag diag tune profile lsebisect"
 oneshot_spec() {  # $1=name -> "budget|dest|gate|cmd..."
   case "$1" in
+    moediag) echo "700|docs/tpu_sweeps/round5_moe_diag.json|(rec.get(\"backend\") == \"tpu\" and bool(rec.get(\"complete\")))|python tools/moe_diag.py --budget=600";;
     diag) echo "700|docs/tpu_sweeps/round5_diag.json|(rec.get(\"backend\") == \"tpu\" and \"error\" not in rec and len(rec.get(\"cifar10\") or []) >= 2 and len(rec.get(\"bert\") or []) >= 2)|python tools/diag_smallstep.py --budget=600";;
     tune) echo "700|docs/tpu_sweeps/round5_flash_tune.json|bool(rec.get(\"complete\"))|python tools/flash_tune.py --budget=600";;
     profile) echo "520|docs/tpu_sweeps/round5_profile.json|bool(rec.get(\"complete\"))|python tools/profile_trace.py --budget=420";;

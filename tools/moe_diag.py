@@ -84,6 +84,22 @@ def _component_benches(deadline: float) -> None:
         from jax.experimental.pallas.ops.tpu.megablox import ops as mb
         return mb.gmm(lo, hi, sizes_even, lo.dtype)
 
+    def gmm_tiling_sweep():
+        # The kernel's default (128,128,128) was never swept on v5e;
+        # if gmm_fwd_in reads slow, this says whether tiling is why.
+        from jax.experimental.pallas.ops.tpu.megablox import ops as mb
+        res = {}
+        for t in ((128, 128, 128), (256, 256, 256), (512, 256, 256),
+                  (512, 512, 512), (1024, 768, 512)):
+            try:
+                res["x".join(map(str, t))] = _timeit(
+                    lambda lo, hi, _t=t: mb.gmm(
+                        lo, hi, sizes_even, lo.dtype, tiling=_t),
+                    lhs, rhs_in)
+            except Exception as e:  # noqa: BLE001 — a tiling may be
+                res["x".join(map(str, t))] = f"error: {type(e).__name__}"
+        return res
+
     comp: dict = {}
     steps = ([
         ("gmm_fwd_in", lambda: _timeit(gmm_like, lhs, rhs_in)),
@@ -91,6 +107,7 @@ def _component_benches(deadline: float) -> None:
         ("gmm_fwdbwd_in", lambda: _timeit(
             jax.grad(lambda lo, hi: gmm_like(lo, hi).astype(
                 jnp.float32).sum(), argnums=(0, 1)), lhs, rhs_in)),
+        ("gmm_tiling_sweep", gmm_tiling_sweep),
     ] if tpu else []) + [
         ("ragged_fwd_in", lambda: _timeit(
             lambda lo, hi: lax.ragged_dot(lo, hi, sizes_even), lhs, rhs_in)),

@@ -151,6 +151,27 @@ def _combine(yout, flat_slots, keeps, gates, n):
     return out
 
 
+# megablox gmm tile cap (tm, tk, tn). The kernel's grid is
+# ~(n/tn)·(m/tm + g)·(k/tk) steps of one tm x tk x tn MXU pass each; at
+# the bench shape ([16384, 768] x [8, 768, 3072]) the upstream default
+# (128, 128, 128) is ~19k grid steps whose per-step overhead dwarfs the
+# 4.2-MFLOP tile matmul. tools/moe_diag.py sweeps tilings on-chip
+# (docs/tpu_sweeps/round5_moe_diag.json when banked); this cap is the
+# grid-arithmetic choice pending that sweep, and the compiled-parity
+# selftest re-proves numerics under it either way.
+GMM_TILE_CAP: int = 512
+
+
+def _gmm_tiling(m: int, k: int, n: int) -> "tuple[int, int, int]":
+    """Largest tiles <= GMM_TILE_CAP the shape admits: tm must DIVIDE m
+    (make_group_metadata raises otherwise); k/n are masked internally
+    so their tiles are only capped to the dim."""
+    tm = GMM_TILE_CAP
+    while m % tm:
+        tm //= 2
+    return tm, min(GMM_TILE_CAP, k), min(GMM_TILE_CAP, n)
+
+
 def _grouped_matmul(lhs, rhs, sizes):
     """[m, k] x [g, k, n] with per-group row segments -> [m, n].
 
@@ -176,7 +197,7 @@ def _grouped_matmul(lhs, rhs, sizes):
         from jax.experimental.pallas.ops.tpu.megablox import ops as megablox
 
         # positional: custom_vjp nondiff_argnums forbids keywords here
-        return megablox.gmm(lhs, rhs, sizes, lhs.dtype)
+        return megablox.gmm(lhs, rhs, sizes, lhs.dtype, _gmm_tiling(m, k, n))
     return lax.ragged_dot(lhs, rhs, sizes)
 
 

@@ -270,6 +270,23 @@ class TestExplicitEP:
         )
         np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
 
+    def test_gmm_tiling_respects_row_divisibility(self):
+        """gmm's make_group_metadata requires tm | m; the adaptive
+        tiling must halve tm until it divides, cap tk/tn to the dim,
+        and pick the large tiles at the bench shape (the whole point —
+        128^3 at [16384, 768, 3072] is ~19k grid steps of overhead)."""
+        from tensorflow_examples_tpu.parallel.moe import (
+            GMM_TILE_CAP, _gmm_tiling,
+        )
+
+        cap = GMM_TILE_CAP
+        assert _gmm_tiling(16384, 768, 3072) == (cap, min(cap, 768), cap)
+        assert _gmm_tiling(256, 128, 128) == (256, 128, 128)
+        m, k, n = 384, 768, 3072  # m = 3·128: cap halves to 128
+        tm, tk, tn = _gmm_tiling(m, k, n)
+        assert m % tm == 0 and tm == 128
+        assert tk <= k and tn <= n
+
     @pytest.mark.parametrize("top_k", [1, 2])
     def test_grouped_matches_scatter_impl(self, top_k):
         """The sort-based dropless ragged_dot path (the TPU hot path)

@@ -9,12 +9,11 @@ Writes docs/tpu_sweeps/flash_block_table.json:
    ...}}
 using each shape's ``best_fwdbwd`` cell (training is the default
 consumer; the fwd-only optimum is recorded alongside for reference).
-ops/attention.py loads the table at kernel-build time; after changing
-it, clear the harvest's selftest statuses (kernel sources hash covers
-ops/ but the table lives in docs/, so re-proving compiled parity after
-a table change is on the operator — the sweep itself ran every cell
-compiled on-chip, which is the parity evidence for the swapped
-defaults).
+ops/attention.py loads the table at kernel-build time. The kernel
+source hash (tools/kernel_source_hash.py) covers the table file, so
+swapping it automatically stales banked selftest evidence and the
+harvest re-proves compiled parity on the next live window (the sweep
+itself also ran every cell compiled on-chip).
 """
 
 import json

@@ -8,10 +8,13 @@ sides use: the harvest embeds it in the banked record, and bench.py's
 ``run_selftest(allow_banked=True)`` refuses a record whose hash does
 not match the working tree.
 
-Scope: every ``.py`` under ``tests_tpu/`` (the parity assertions) and
-``tensorflow_examples_tpu/ops/`` (the kernels they compile). Hash is
-over (relative path, content) pairs in sorted order, so renames and
-adds/removes change it too.
+Scope: every ``.py`` under ``tests_tpu/`` (the parity assertions),
+``tensorflow_examples_tpu/ops/`` (the kernels they compile), and
+``tensorflow_examples_tpu/parallel/`` (round 5: the gmm parity nodes
+compile through parallel/moe.py's dispatch — a gmm-tiling edit there
+must stale them, and ring/ulysses sit in the same boat for the lse
+nodes). Hash is over (relative path, content) pairs in sorted order,
+so renames and adds/removes change it too.
 
 Usage: ``python tools/kernel_source_hash.py`` prints the hash.
 """
@@ -25,7 +28,24 @@ def kernel_source_hash(repo_root: "str | None" = None) -> str:
         os.path.dirname(os.path.abspath(__file__))
     )
     h = hashlib.sha256()
-    for sub in ("tests_tpu", os.path.join("tensorflow_examples_tpu", "ops")):
+    # The flash block table is kernel configuration living outside the
+    # package (docs/): swapping it changes every compiled flash kernel,
+    # so it must stale banked selftest evidence exactly like a source
+    # edit (flash_table_from_sweep.py used to delegate that to the
+    # operator).
+    table = os.path.join(
+        root, "docs", "tpu_sweeps", "flash_block_table.json"
+    )
+    if os.path.exists(table):
+        h.update(b"flash_block_table.json\0")
+        with open(table, "rb") as f:
+            h.update(f.read())
+        h.update(b"\0")
+    for sub in (
+        "tests_tpu",
+        os.path.join("tensorflow_examples_tpu", "ops"),
+        os.path.join("tensorflow_examples_tpu", "parallel"),
+    ):
         base = os.path.join(root, sub)
         files = []
         for dirpath, _dirnames, filenames in os.walk(base):

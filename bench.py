@@ -172,9 +172,11 @@ FLOOR_BUNDLES: dict[str, dict[str, int]] = {
 }
 
 # Drift-cancelled floors: rel_mfu = model_tflops/probe_tflops measured
-# under the 3-window protocol. TPU side stamped from the 2026-07-31
-# round-4 harvest (first live-chip protocol sweep); CPU side from the
-# 2026-07-30 round-4 sweep. Same move-with-evidence policy as FLOORS.
+# under the 3-window protocol. Stamped per-metric by
+# tools/apply_floors.py from each metric's most recent harvest record
+# (mixed rounds by design — the floors policy moves each floor WITH
+# its own evidence; provenance per metric in BASELINE.md). CPU side
+# from the 2026-07-30 round-4 sweep. Same policy as FLOORS.
 REL_MFU_FLOORS: dict[str, dict[str, float]] = {
     "tpu": {
         "resnet50_examples_per_sec_per_chip": 0.07961,

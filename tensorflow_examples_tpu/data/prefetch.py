@@ -4,15 +4,26 @@ Equivalent of the reference's ``dataset.prefetch`` + device prefetch into
 HBM (BASELINE.json:north_star). A small look-ahead queue of batches is
 ``device_put`` ahead of time with the mesh batch sharding; transfers are
 async in JAX, so batch N+1 streams into HBM while step N runs.
+
+Resilience (ISSUE 1): each fetch runs through the fault-injection hook
+(utils/faults.py — slow-batch and corrupt-batch faults land here), and a
+batch whose host→device conversion/transfer fails is SKIPPED and counted
+rather than killing the run, up to a bounded ``max_skips`` budget
+(``TrainConfig.max_skipped_batches``; 0 keeps the historical fail-fast).
 """
 
 from __future__ import annotations
 
 import collections
+import logging
 from typing import Iterator
 
 import jax
 import jax.numpy as jnp
+
+from tensorflow_examples_tpu.utils import faults as _faults
+
+log = logging.getLogger(__name__)
 
 
 def put_batch(batch, sharding):
@@ -71,24 +82,67 @@ def bundle_batches(it: Iterator, k: int) -> Iterator:
         yield jax.tree.map(lambda *xs: np.stack(xs), *group)
 
 
+_END = object()
+
+
 def device_prefetch(
-    it: Iterator, sharding, *, depth: int = 2, local_batches: bool = False
+    it: Iterator,
+    sharding,
+    *,
+    depth: int = 2,
+    local_batches: bool = False,
+    max_skips: int = 0,
+    fault_hooks: bool = True,
 ) -> Iterator:
+    """``fault_hooks=False`` (the eval path) keeps this pipeline out of
+    the injection engine's fetch-index space, so ``slow@N``/``badbatch@N``
+    target train fetch N deterministically even when eval interleaves."""
     queue = collections.deque()
     put_fn = put_local_batch if local_batches else put_batch
+    skipped = 0
 
-    def put(batch):
-        return put_fn(batch, sharding)
+    def fetch():
+        """Next device-resident batch, or _END. With ``max_skips > 0`` a
+        batch that fails the host→device put is poisoned: skip it (and
+        count), bounded by the budget. With the default ``max_skips=0``
+        the original exception propagates untouched — a deterministic
+        pipeline bug must surface as itself, not as 'corrupt input'."""
+        nonlocal skipped
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return _END
+            try:
+                if fault_hooks:
+                    eng = _faults.active()
+                    if eng is not None:
+                        batch = eng.batch_hook(batch)
+                return put_fn(batch, sharding)
+            except Exception as e:
+                if max_skips <= 0:
+                    raise
+                skipped += 1
+                if skipped > max_skips:
+                    raise RuntimeError(
+                        f"poisoned input batch ({skipped} bad, budget "
+                        f"max_skipped_batches={max_skips} exhausted): {e}"
+                    ) from e
+                log.warning(
+                    "skipping poisoned input batch %d/%d: %s",
+                    skipped,
+                    max_skips,
+                    e,
+                )
 
-    try:
-        for _ in range(depth):
-            queue.append(put(next(it)))
-    except StopIteration:
-        pass
+    for _ in range(depth):
+        batch = fetch()
+        if batch is _END:
+            break
+        queue.append(batch)
     while queue:
         out = queue.popleft()
-        try:
-            queue.append(put(next(it)))
-        except StopIteration:
-            pass
+        batch = fetch()
+        if batch is not _END:
+            queue.append(batch)
         yield out

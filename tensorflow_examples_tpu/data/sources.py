@@ -5,6 +5,13 @@ shards; in this hermetic environment (zero egress) each loader first looks
 for the standard on-disk format under ``data_dir`` and otherwise produces
 a seeded synthetic dataset with the true shapes/dtypes/cardinalities, so
 every example CLI and test runs anywhere.
+
+Every actual filesystem read goes through ``retry_io`` (utils/faults.py):
+bounded retries with exponential backoff on OSError — at pod scale the
+input store (NFS / GCS-fuse) is flaky long before the TPUs are — and the
+same wrapper is where fault-injection IO errors land in tests. Existence
+checks and their deliberate FileNotFoundError messages stay outside the
+retry (a missing dataset is a config error, not a transient fault).
 """
 
 from __future__ import annotations
@@ -17,6 +24,7 @@ import struct
 import numpy as np
 
 from tensorflow_examples_tpu.data.memory import InMemoryDataset
+from tensorflow_examples_tpu.utils.faults import retry_io
 
 
 # ------------------------------------------------------------------ MNIST
@@ -24,15 +32,20 @@ from tensorflow_examples_tpu.data.memory import InMemoryDataset
 
 def _read_idx(path: str) -> np.ndarray:
     """Read an IDX file (the standard MNIST distribution format)."""
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rb") as f:
-        magic = struct.unpack(">HBB", f.read(4))
-        _, dtype_code, ndim = magic
-        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
-        dtype = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32, 13: np.float32}[
-            dtype_code
-        ]
-        return np.frombuffer(f.read(), dtype=dtype).reshape(dims)
+
+    def read():
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic = struct.unpack(">HBB", f.read(4))
+            _, dtype_code, ndim = magic
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            dtype = {
+                8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+                13: np.float32,
+            }[dtype_code]
+            return np.frombuffer(f.read(), dtype=dtype).reshape(dims)
+
+    return retry_io(read, path)
 
 
 def _find(data_dir: str, names: list[str]) -> str | None:
@@ -98,10 +111,13 @@ def load_cifar10(
                 f"--data_dir={data_dir} set but CIFAR-10 python batches not "
                 "found there; omit --data_dir for synthetic data"
             )
+        def read_batch(p):
+            with open(p, "rb") as f:
+                return pickle.load(f, encoding="bytes")
+
         xs, ys = [], []
         for p in paths:
-            with open(p, "rb") as f:
-                d = pickle.load(f, encoding="bytes")
+            d = retry_io(lambda p=p: read_batch(p), p)
             xs.append(d[b"data"])
             ys.append(np.asarray(d[b"labels"]))
         x = (
@@ -144,12 +160,21 @@ def load_lm_tokens(
     if data_dir:
         base = os.path.join(data_dir, split)
         if os.path.exists(base + ".bin"):
-            flat = np.memmap(base + ".bin", dtype=np.uint16, mode="r")
+            flat = retry_io(
+                lambda: np.memmap(base + ".bin", dtype=np.uint16, mode="r"),
+                base + ".bin",
+            )
         elif os.path.exists(base + ".npy"):
-            flat = np.load(base + ".npy", mmap_mode="r")
+            flat = retry_io(
+                lambda: np.load(base + ".npy", mmap_mode="r"), base + ".npy"
+            )
         elif os.path.exists(base + ".txt"):
-            with open(base + ".txt", "rb") as f:
-                flat = np.frombuffer(f.read(), dtype=np.uint8)
+
+            def read_txt():
+                with open(base + ".txt", "rb") as f:
+                    return np.frombuffer(f.read(), dtype=np.uint8)
+
+            flat = retry_io(read_txt, base + ".txt")
         else:
             raise FileNotFoundError(
                 f"--data_dir={data_dir} set but {split}.bin/.npy/.txt not "
@@ -243,7 +268,7 @@ def load_glue(
                 f"--data_dir={data_dir} set but {task}_{split}.npz not found; "
                 "omit --data_dir for synthetic data"
             )
-        d = np.load(path)
+        d = retry_io(lambda: np.load(path), path)
         arrays = {
             "tokens": d["tokens"].astype(np.int32),
             "attention_mask": d["attention_mask"].astype(np.int32),

@@ -4,6 +4,14 @@ Replaces the reference's per-example ``tf.train.CheckpointManager``
 (SURVEY.md §2b/§5d) with orbax: async saves (the step never blocks on
 filesystem IO), sharded arrays saved/restored directly to the live mesh
 layout, and automatic latest-checkpoint resume.
+
+Crash safety: ``CheckpointManager`` is a context manager; ``close()``
+(which waits for any in-flight async save) runs on the exception path
+out of ``Trainer.fit`` too, so a crash never abandons a half-written
+async save as the torn "latest" checkpoint. ``restore_latest`` validates
+the saved tree structure/shapes/dtypes against the live state up front
+and names the mismatching paths, instead of failing deep inside orbax on
+shape or dtype drift.
 """
 
 from __future__ import annotations
@@ -20,27 +28,121 @@ class CheckpointManager:
     def __init__(self, workdir: str, *, max_to_keep: int = 3, async_save: bool = True):
         import os
 
+        # item_handlers pre-registers the standard handler so a FRESH
+        # manager (the resume path) can read item_metadata — without it
+        # orbax returns None metadata until the first save, and
+        # restore-time structure validation would silently skip.
         self._mngr = ocp.CheckpointManager(
             os.path.abspath(os.path.join(workdir, "checkpoints")),
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
             ),
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # Always wait+close — an async save abandoned on the exception
+        # path would otherwise be a torn latest-checkpoint.
+        self.close()
+        return False
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
 
     def save(self, step: int, state: Any) -> None:
         self._mngr.save(step, args=ocp.args.StandardSave(_as_dict(state)))
 
-    def restore_latest(self, state: Any) -> tuple[Any, int] | None:
+    def restore_latest(
+        self, state: Any, *, validate: bool = True
+    ) -> tuple[Any, int] | None:
         """Restore into ``state``'s structure/shardings; None if no ckpt."""
         step = self._mngr.latest_step()
         if step is None:
             return None
         target = _as_dict(state)
+        if validate:
+            self._validate_structure(step, target)
         restored = self._mngr.restore(step, args=ocp.args.StandardRestore(target))
         merged = _merge_arrays(state, restored)
         log.info("restored checkpoint at step %d", step)
         return merged, step
+
+    def _validate_structure(self, step: int, target: dict) -> None:
+        """Compare the saved tree against the live state; raise a clear
+        error naming every drifted path (missing / unexpected / shape or
+        dtype mismatch) instead of letting orbax fail deep inside its
+        restore machinery."""
+        import jax.tree_util as jtu
+
+        try:
+            meta = self._mngr.item_metadata(step)
+        except Exception as e:  # metadata is best-effort across versions
+            log.debug("checkpoint metadata unavailable (%s); skipping", e)
+            return
+        if not isinstance(meta, dict):
+            return
+
+        def norm(path) -> str:
+            # Saved metadata renders optax NamedTuple nodes as dicts while
+            # the live tree flattens them with attribute keys ([0].count
+            # vs ['0']['count']); normalize every entry to its bare
+            # key/index so the two spellings compare equal.
+            parts = []
+            for p in path:
+                for attr in ("key", "name", "idx"):
+                    if hasattr(p, attr):
+                        parts.append(str(getattr(p, attr)))
+                        break
+                else:  # pragma: no cover - unknown key type
+                    parts.append(str(p))
+            return "/".join(parts)
+
+        def by_path(tree):
+            return {
+                norm(path): leaf
+                for path, leaf in jtu.tree_flatten_with_path(tree)[0]
+            }
+
+        saved, live = by_path(meta), by_path(target)
+        problems = []
+        for path in sorted(set(live) - set(saved)):
+            problems.append(f"missing from checkpoint: {path}")
+        for path in sorted(set(saved) - set(live)):
+            problems.append(f"not in live state: {path}")
+        for path in sorted(set(saved) & set(live)):
+            m, x = saved[path], live[path]
+            m_shape = getattr(m, "shape", None)
+            m_dtype = getattr(m, "dtype", None)
+            x_shape = tuple(getattr(x, "shape", ()))
+            if m_shape is not None and tuple(m_shape) != x_shape:
+                problems.append(
+                    f"shape mismatch at {path}: checkpoint "
+                    f"{tuple(m_shape)} vs live {x_shape}"
+                )
+            elif m_dtype is not None and str(m_dtype) != str(
+                getattr(x, "dtype", m_dtype)
+            ):
+                problems.append(
+                    f"dtype mismatch at {path}: checkpoint {m_dtype} vs "
+                    f"live {x.dtype}"
+                )
+        if problems:
+            shown = "\n  ".join(problems[:20])
+            more = (
+                f"\n  ... and {len(problems) - 20} more"
+                if len(problems) > 20
+                else ""
+            )
+            raise ValueError(
+                f"checkpoint at step {step} does not match the live train "
+                f"state ({len(problems)} path(s) drifted — wrong model "
+                "config or optimizer for this workdir?):\n  "
+                f"{shown}{more}"
+            )
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()

@@ -37,8 +37,11 @@ def _setup(workload, default_cfg):
     cfg = config_from_flags(default_cfg)
     apply_device_flag(cfg.device, debug_nans=cfg.debug_nans)
     from tensorflow_examples_tpu.utils.diagnostics import install_crash_handlers
+    from tensorflow_examples_tpu.utils.faults import configure_io_retry
 
     install_crash_handlers(cfg.workdir)
+    # Flaky-input-store policy for every file reader (data/sources.py).
+    configure_io_retry(cfg.io_retries, cfg.io_backoff_secs)
     distributed.initialize()
     return cfg
 

@@ -60,6 +60,29 @@ class TrainConfig:
     watchdog_secs: float = 600.0  # hang detector: dump all thread stacks
     #   if no step completes for this long (0 disables; SURVEY.md §5c)
 
+    # Resilience (train/resilience.py; docs/resilience.md)
+    preempt_checkpoint: bool = True  # SIGTERM/SIGINT: checkpoint at the
+    #   next step boundary, then exit cleanly (code 0) — the resumed run
+    #   is bitwise-identical to an uninterrupted one
+    bad_step_policy: str = "skip"  # off | skip | rollback | abort —
+    #   what to do about NaN/Inf losses/grads and loss spikes. "skip"
+    #   drops the bad update ON DEVICE (no host sync on the happy path)
+    #   and aborts after bad_step_patience consecutive bad steps;
+    #   "rollback" instead restores the latest checkpoint there
+    bad_step_patience: int = 5  # consecutive bad steps before the
+    #   skip->abort / rollback escalation
+    loss_spike_factor: float = 0.0  # >0: a loss above factor*EMA(loss)
+    #   also counts as a bad step (host-side, detection lags a few steps)
+    watchdog_fatal_secs: float = 0.0  # >0: if a step/input stall lasts
+    #   this long, dump diagnostics and fail fast (exit 87) instead of
+    #   hanging the slice; 0 keeps the watchdog detection-only
+    io_retries: int = 3  # bounded retries for flaky file reads
+    #   (data/sources.py) with exponential backoff
+    io_backoff_secs: float = 0.25  # initial backoff; doubles per retry
+    max_skipped_batches: int = 0  # poisoned-batch skip budget in the
+    #   prefetch pipeline: corrupt host batches are skipped (and counted)
+    #   up to this many times before the run errors out; 0 = fail fast
+
     def mesh_config(self) -> MeshConfig:
         return MeshConfig(
             data=self.mesh_data,

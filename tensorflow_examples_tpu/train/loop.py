@@ -44,10 +44,12 @@ from tensorflow_examples_tpu.data.prefetch import (
     device_prefetch,
     put_batch,
 )
+from tensorflow_examples_tpu.train import resilience
 from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
 from tensorflow_examples_tpu.train.config import TrainConfig
 from tensorflow_examples_tpu.train.state import TrainState
 from tensorflow_examples_tpu.train.task import Task
+from tensorflow_examples_tpu.utils import faults as fault_inject
 
 log = logging.getLogger(__name__)
 
@@ -80,6 +82,7 @@ class Trainer:
         self._batch_sharding = batch_sharding(self.mesh)
         self._ckpt: CheckpointManager | None = None
         self._writer = None
+        self._guard: resilience.BadStepGuard | None = None
         self.state = self._init_state()
         self._train_step = self._build_train_step()
         self._bundled_steps: dict[int, object] = {}
@@ -190,6 +193,15 @@ class Trainer:
     def _make_train_step_fn(self):
         task, policy = self.task, self.policy
         seed_key = jax.random.PRNGKey(self.config.seed + 1)
+        # Bad-step guard compiled INTO the step (train/resilience.py): a
+        # non-finite loss or grad norm skips the update via jnp.where —
+        # params/opt_state/model_state keep their old values while `step`
+        # still advances (rng stream and data order move on) — and a 0/1
+        # `bad_step` metric is emitted for the host guard to poll. No
+        # host sync anywhere on the happy path.
+        guard_on = (
+            getattr(self.config, "bad_step_policy", "off") not in ("off", "")
+        )
 
         def train_step(state: TrainState, batch):
             rng = step_rng(seed_key, state.step)
@@ -220,6 +232,24 @@ class Trainer:
             metrics["grad_norm"] = optax.global_norm(
                 jax.tree.map(lambda x: x.astype(jnp.float32), grads)
             )
+            if guard_on:
+                bad = jnp.logical_not(
+                    jnp.isfinite(loss) & jnp.isfinite(metrics["grad_norm"])
+                )
+
+                def keep_old(new, old):
+                    return jnp.where(bad, old, new)
+
+                new_state = new_state.replace(
+                    params=jax.tree.map(keep_old, new_state.params, state.params),
+                    opt_state=jax.tree.map(
+                        keep_old, new_state.opt_state, state.opt_state
+                    ),
+                    model_state=jax.tree.map(
+                        keep_old, new_state.model_state, state.model_state
+                    ),
+                )
+                metrics["bad_step"] = bad.astype(jnp.float32)
             return new_state, metrics
 
         return train_step
@@ -317,19 +347,42 @@ class Trainer:
         property of the eval iterator, NOT of ``local_batches``). Pass
         False explicitly for a genuinely global-view eval iterator in a
         multi-process run.
+
+        Resilience (docs/resilience.md): SIGTERM/SIGINT checkpoint at
+        the next step boundary and raise :class:`resilience.Preempted`
+        (exit code 0); bad steps are skipped/rolled back/aborted per
+        ``cfg.bad_step_policy``; a stalled step or input fetch trips the
+        watchdog (``cfg.watchdog_secs`` dump, ``cfg.watchdog_fatal_secs``
+        fail-fast). The checkpoint manager is closed — waiting out any
+        in-flight async save — on ALL exit paths, including exceptions.
         """
         cfg = self.config
         num_steps = num_steps or cfg.train_steps
         start_step = int(self.state.step)
 
+        # Config validation (bad_step_policy) happens BEFORE any thread or
+        # handler is created, so a bad config can't leak a watchdog.
+        faults_engine = fault_inject.active()
+        guard = resilience.BadStepGuard.from_config(cfg)
+        self._guard = guard  # introspectable by tests/tools
+
         watchdog = None
-        if cfg.watchdog_secs > 0:
+        if cfg.watchdog_secs > 0 or cfg.watchdog_fatal_secs > 0:
             from tensorflow_examples_tpu.utils.diagnostics import Watchdog
 
             # Start paused: restore + first-step compile are legitimately
             # slow. Detection arms at the first completed step's ping.
-            watchdog = Watchdog(cfg.watchdog_secs).start()
+            watchdog = Watchdog(
+                cfg.watchdog_secs or cfg.watchdog_fatal_secs,
+                fatal_timeout_s=cfg.watchdog_fatal_secs,
+            ).start()
             watchdog.pause()
+
+        preempt = (
+            resilience.PreemptionGuard().install()
+            if cfg.preempt_checkpoint
+            else None
+        )
 
         try:
             if cfg.workdir:
@@ -339,11 +392,6 @@ class Trainer:
                     if restored is not None:
                         self.state, start_step = restored[0], int(restored[1])
                 self._writer = _make_writer(cfg.workdir)
-
-            if callable(train_data) and not hasattr(train_data, "__next__"):
-                train_iter = train_data(start_step)
-            else:
-                train_iter = train_data
 
             k = max(int(getattr(cfg, "steps_per_launch", 1) or 1), 1)
             if k > 1:
@@ -373,22 +421,54 @@ class Trainer:
             # Async look-ahead transfer: batch N+1 streams into HBM while
             # step N runs (the reference's prefetch-to-device equivalent).
             # For bundles, K host batches stack before the (single) put.
-            train_iter = device_prefetch(
-                train_iter if k == 1 else bundle_batches(train_iter, k),
-                self._batch_sharding if k == 1 else bundle_sharding(self.mesh),
-                local_batches=local_batches and jax.process_count() > 1,
+            # Rebuilt from a new start step on bad-step rollback — exact
+            # batch replay needs the callable form of ``train_data``.
+            resumable = callable(train_data) and not hasattr(
+                train_data, "__next__"
             )
+
+            def build_iter(start: int):
+                src = train_data(start) if resumable else train_data
+                return device_prefetch(
+                    src if k == 1 else bundle_batches(src, k),
+                    self._batch_sharding
+                    if k == 1
+                    else bundle_sharding(self.mesh),
+                    local_batches=local_batches and jax.process_count() > 1,
+                    max_skips=cfg.max_skipped_batches,
+                )
+
+            train_iter = build_iter(start_step)
 
             profiling = False
             profiled = False  # one-shot: the trace covers steps ~10-20 once
             evaluated_now = False
+            stepped_once = False  # first step_fn call pays jit compile
             window: list[Mapping[str, jax.Array]] = []
             last: dict[str, float] = {}
             t_window = time.perf_counter()
-            for chunk in range(start_step, num_steps, k):
+            chunk = start_step
+            while True:
+                if guard is not None:
+                    # Non-blocking: consumes only already-finished step
+                    # metrics (drained once the loop is done). Raises
+                    # BadStepError for the abort outcomes.
+                    if guard.poll(drain=chunk >= num_steps) == "rollback":
+                        if watchdog is not None:
+                            watchdog.pause()
+                        chunk, train_iter = self._rollback_to_checkpoint(
+                            guard, build_iter if resumable else None, train_iter
+                        )
+                        window.clear()
+                        t_window = time.perf_counter()
+                        continue
+                if chunk >= num_steps:
+                    break
                 # step = index of the chunk's LAST train step; with k == 1
                 # this loop is exactly the historical per-step loop.
                 step = chunk + k - 1
+                if faults_engine is not None:
+                    faults_engine.step_hook(chunk, k)
                 if (
                     cfg.profile
                     and not profiling
@@ -408,14 +488,30 @@ class Trainer:
                 with jax.profiler.StepTraceAnnotation(
                     "train", step_num=step
                 ):
+                    if watchdog is not None:
+                        # Arm for the fetch even before the first step:
+                        # a wedged input pipeline at job start must trip
+                        # the watchdog too, and a host fetch is never
+                        # legitimately compile-slow.
+                        watchdog.enter("input_fetch")
+                        watchdog.resume()
                     batch = next(train_iter)
+                    if faults_engine is not None:
+                        batch = faults_engine.nan_hook(chunk, k, batch)
+                    if watchdog is not None:
+                        watchdog.enter("device_step")
+                        if not stepped_once:
+                            watchdog.pause()  # first step pays jit compile
                     self.state, metrics = step_fn(self.state, batch)
+                stepped_once = True
                 if watchdog is not None:
                     # Dispatch is async; sync points (log flushes) bound
                     # how stale this is — good enough for hang detection.
                     watchdog.resume()
                     watchdog.ping(step)
                 window.append(metrics)
+                if guard is not None:
+                    guard.observe(step, metrics)
                 if profiling and step - start_step >= 20:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
@@ -425,21 +521,34 @@ class Trainer:
                 if (cfg.log_every and (step + 1) % cfg.log_every == 0) or (
                     step + 1 == num_steps
                 ):
+                    if watchdog is not None:
+                        # Fresh heartbeat + named phase: this wait is up
+                        # to a full log window of queued device work, so
+                        # it gets its own full timeout budget — but stays
+                        # ARMED, because a device hang surfaces exactly
+                        # here. Size watchdog(_fatal)_secs above the
+                        # worst-case log window.
+                        watchdog.enter("log_flush")
                     jax.block_until_ready(metrics)
                     dt = time.perf_counter() - t_window
                     # Bundled metrics are [k]-vectors per key; scalars and
                     # vectors average identically through ravel+concat.
+                    # With the guard active, means are over FINITE values
+                    # only (a skipped bad step's NaN loss must not poison
+                    # the window); with the guard OFF, a NaN window mean
+                    # is the divergence signal — don't mask it.
+                    mean_fn = (
+                        _finite_mean
+                        if guard is not None
+                        else lambda v: float(np.mean(v))
+                    )
                     last = {
-                        key: float(
-                            np.mean(
-                                np.concatenate(
-                                    [
-                                        np.ravel(
-                                            np.asarray(m[key], np.float32)
-                                        )
-                                        for m in window
-                                    ]
-                                )
+                        key: mean_fn(
+                            np.concatenate(
+                                [
+                                    np.ravel(np.asarray(m[key], np.float32))
+                                    for m in window
+                                ]
                             )
                         )
                         for key in window[0]
@@ -452,6 +561,14 @@ class Trainer:
                     window.clear()
                     t_window = time.perf_counter()
                     _log_metrics(self._writer, step + 1, last, prefix="train")
+
+                if preempt is not None and preempt.requested:
+                    # Checked BEFORE the periodic eval: a pending SIGTERM
+                    # must not burn the scheduler's kill grace window on
+                    # a full evaluation before the checkpoint lands.
+                    if profiling:
+                        jax.profiler.stop_trace()
+                    self._preempt_exit(step + 1, preempt, watchdog)
 
                 evaluated_now = False
                 if (
@@ -480,12 +597,30 @@ class Trainer:
                     and cfg.checkpoint_every
                     and (step + 1) % cfg.checkpoint_every == 0
                 ):
+                    if watchdog is not None:
+                        # Save time (device->host copy + waiting out the
+                        # previous async commit) is storage-bound, not a
+                        # hang — don't let the fatal watchdog kill it.
+                        watchdog.pause()
                     self._ckpt.save(step + 1, self.state)
+                    if watchdog is not None:
+                        watchdog.resume()
+
+                if preempt is not None and preempt.requested:
+                    if profiling:
+                        jax.profiler.stop_trace()
+                    self._preempt_exit(step + 1, preempt, watchdog)
+                chunk += k
 
             if profiling:
                 jax.profiler.stop_trace()
             if watchdog is not None:
                 watchdog.pause()  # final eval + checkpoint close
+            if preempt is not None and preempt.requested:
+                # Signal arrived between the last chunk's check and here:
+                # skip the final eval (the scheduler's grace window is
+                # ticking), checkpoint, and exit cleanly.
+                self._preempt_exit(num_steps, preempt, watchdog)
             if eval_iter_fn is not None and not evaluated_now:
                 last.update(
                     {
@@ -495,15 +630,84 @@ class Trainer:
                         ).items()
                     }
                 )
-            if self._ckpt:
+            if self._ckpt and self._ckpt.latest_step() != num_steps:
                 self._ckpt.save(num_steps, self.state)
-                self._ckpt.close()
             if self._writer:
                 self._writer.flush()
             return last
         finally:
+            # Crash-safe teardown (ISSUE 1 satellite): the checkpoint
+            # manager waits out any in-flight async save and closes on
+            # EVERY exit path — success, preemption, or exception — so a
+            # crash can't abandon a torn latest-checkpoint. The watchdog
+            # stops FIRST: on the exception path it may still be armed,
+            # and a fatal timeout firing mid-close would kill the very
+            # commit the close protects. Signal handlers are restored so
+            # fit() doesn't leak process state.
             if watchdog is not None:
                 watchdog.stop()
+            if preempt is not None:
+                preempt.uninstall()
+            if self._ckpt is not None:
+                try:
+                    self._ckpt.close()
+                finally:
+                    self._ckpt = None
+
+    def _preempt_exit(self, done_step: int, preempt, watchdog) -> None:
+        """Synchronous checkpoint + clean exit at a step boundary."""
+        if watchdog is not None:
+            watchdog.pause()
+        if self._ckpt is not None:
+            # Quiesce any in-flight cadence save first: saving the same
+            # step twice (or racing an uncommitted save) is an error.
+            self._ckpt.wait()
+            if self._ckpt.latest_step() != done_step:
+                self._ckpt.save(done_step, self.state)
+            self._ckpt.wait()  # the save must be durable BEFORE we exit
+            log.warning(
+                "preemption: synchronous checkpoint at step %d saved; "
+                "exiting cleanly",
+                done_step,
+            )
+        else:
+            log.warning(
+                "preemption at step %d with no workdir: nothing to "
+                "checkpoint; exiting cleanly",
+                done_step,
+            )
+        if self._writer:
+            self._writer.flush()
+        raise resilience.Preempted(done_step, preempt.signum)
+
+    def _rollback_to_checkpoint(self, guard, build_iter, train_iter):
+        """Bad-step rollback: restore the latest checkpoint and replay."""
+        if self._ckpt is not None:
+            self._ckpt.wait()  # only committed steps are restorable
+        if self._ckpt is None or self._ckpt.latest_step() is None:
+            raise resilience.BadStepError(
+                "bad_step_policy=rollback needs a checkpoint to restore, "
+                f"but none exists under workdir={self.config.workdir!r}. "
+                f"{guard.status()}"
+            )
+        restored = self._ckpt.restore_latest(self.state)
+        state, step = restored[0], int(restored[1])
+        guard.note_rollback(step)  # raises BadStepError on a repeat
+        log.warning(
+            "bad-step rollback: restored checkpoint at step %d (%s)",
+            step,
+            guard.status(),
+        )
+        self.state = state
+        if build_iter is not None:
+            train_iter = build_iter(step)
+        else:
+            log.warning(
+                "train iterator is not resumable (pass a callable "
+                "(start)->iterator for exact replay); continuing on the "
+                "live stream after rollback"
+            )
+        return step, train_iter
 
     def evaluate(
         self, eval_iter: Iterable, *, per_host: bool | None = None
@@ -536,7 +740,10 @@ class Trainer:
         totals: dict[str, jax.Array] = {}
         count = None
         for batch in device_prefetch(
-            batches, self._batch_sharding, local_batches=per_host
+            batches,
+            self._batch_sharding,
+            local_batches=per_host,
+            fault_hooks=False,  # slow@N/badbatch@N index TRAIN fetches
         ):
             m = dict(
                 self._eval_step(self.state.params, self.state.model_state, batch)
@@ -621,6 +828,13 @@ def _pad_per_host_batches(it: Iterator) -> Iterator:
             batch["mask"] = np.ones(rows, np.float32)
         pad = {k: np.zeros_like(v) for k, v in batch.items()}
         yield batch
+
+
+def _finite_mean(vals: np.ndarray) -> float:
+    """Mean over finite entries (a skipped bad step's NaN loss must not
+    poison the whole logging window); NaN only if NOTHING was finite."""
+    finite = vals[np.isfinite(vals)]
+    return float(np.mean(finite)) if finite.size else float("nan")
 
 
 def _make_writer(workdir: str):

@@ -1,0 +1,289 @@
+"""Resilience layer: preemption safety + bad-step guards (ISSUE 1).
+
+The failure modes this handles are the pod-scale routine ones:
+
+* **Preemption** (``PreemptionGuard``): SIGTERM/SIGINT set a flag; the
+  training loop notices at the next step boundary, synchronously
+  checkpoints, and raises :class:`Preempted` — a ``SystemExit`` subclass
+  with exit code 0, so a preempted CLI run exits cleanly and the next
+  run resumes bitwise-identically (stateless-resumable input order +
+  step-keyed rng, see tests/test_resilience.py).
+
+* **Bad steps** (``BadStepGuard``): NaN/Inf losses or gradients and loss
+  spikes. Detection is split so the happy path adds NO host sync:
+
+  - non-finite loss/grad_norm is caught ON DEVICE inside the jitted
+    train step (train/loop.py): the update is skipped via ``jnp.where``
+    (params/opt_state/model_state keep their old values, ``step`` still
+    advances so the rng stream and data order move on) and a
+    ``bad_step`` 0/1 metric is emitted;
+  - the host guard POLLS those metrics without blocking (``is_ready``)
+    a few steps behind the device, counts consecutive bad steps, tracks
+    a loss EMA for spike detection, and escalates per
+    ``TrainConfig.bad_step_policy``:
+
+      ``skip``      keep skipping on device; abort only after
+                    ``bad_step_patience`` consecutive bad steps (pure
+                    skipping forever would be a silent hang).
+      ``rollback``  after ``bad_step_patience`` consecutive bad steps,
+                    restore the latest checkpoint and replay (the loop
+                    rebuilds the input iterator at the restored step).
+                    A second rollback landing on the same checkpoint
+                    aborts — the fault is evidently not transient.
+      ``abort``     raise on the first bad step observed.
+      ``off``       no device guard compiled in, no host polling.
+
+Watchdog / hung-step handling lives in utils/diagnostics.py; IO retry
+and fault injection in utils/faults.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import signal
+import threading
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+POLICIES = ("off", "skip", "rollback", "abort")
+
+
+class Preempted(SystemExit):
+    """Clean-exit signal: checkpoint saved, process should stop (code 0)."""
+
+    def __init__(self, step: int, signum: int | None = None):
+        super().__init__(0)
+        self.step = step
+        self.signum = signum
+
+    def __str__(self):
+        name = signal.Signals(self.signum).name if self.signum else "request"
+        return f"preempted by {name}; resumable checkpoint at step {self.step}"
+
+
+class BadStepError(RuntimeError):
+    """The bad-step policy decided the run cannot continue."""
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT -> 'checkpoint at the next step boundary' flag.
+
+    Installable only from the main thread (signal module restriction);
+    elsewhere it degrades to an inert guard. A second signal while one
+    is already pending restores the original handler and re-raises, so a
+    wedged run can still be force-killed.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._requested = False
+        self._signum: int | None = None
+        self._old: dict[int, Any] = {}
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    @property
+    def signum(self) -> int | None:
+        return self._signum
+
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            log.warning(
+                "preemption guard not installed (not on the main thread)"
+            )
+            return self
+        for sig in self.SIGNALS:
+            self._old[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, TypeError):  # pragma: no cover - teardown
+                pass
+        self._old.clear()
+
+    def _handle(self, signum, frame):
+        if self._requested:
+            # Second signal: the operator means it. Restore + re-raise.
+            import os
+
+            self.uninstall()
+            if signal.getsignal(signum) in (self._handle, None):
+                # The saved handler could not be restored (e.g. it was
+                # C-installed and getsignal() gave None): fall back to
+                # SIG_DFL so the re-raise terminates instead of looping
+                # straight back into this handler.
+                signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self._requested = True
+        self._signum = signum
+        log.warning(
+            "%s received: will checkpoint at the next step boundary and "
+            "exit cleanly (send again to force-quit)",
+            signal.Signals(signum).name,
+        )
+
+
+def _is_ready(x) -> bool:
+    ready = getattr(x, "is_ready", None)
+    if ready is None:
+        return True  # numpy / python scalars are always ready
+    try:
+        return bool(ready())
+    except Exception:  # pragma: no cover - deleted/donated array edge
+        return True
+
+
+class BadStepGuard:
+    """Host-side divergence monitor over the device-emitted step metrics.
+
+    ``observe()`` enqueues each step's (loss, bad_step) device scalars;
+    ``poll()`` consumes only entries whose computation already finished
+    (zero block on the happy path; the device runs a few steps ahead of
+    the host thanks to async dispatch). The queue is force-drained when
+    it exceeds ``max_pending`` — by then the oldest entry is long done —
+    and at end of training via ``poll(drain=True)``.
+    """
+
+    def __init__(
+        self,
+        policy: str,
+        *,
+        patience: int = 5,
+        spike_factor: float = 0.0,
+        ema_decay: float = 0.9,
+        max_pending: int = 64,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"bad_step_policy={policy!r}; expected one of {POLICIES}"
+            )
+        self.policy = policy
+        self.patience = max(int(patience), 1)
+        self.spike_factor = float(spike_factor)
+        self._ema_decay = float(ema_decay)
+        self._max_pending = max_pending
+        self._pending: collections.deque = collections.deque()
+        self._consecutive = 0
+        self._ema: float | None = None
+        self.rollbacks = 0
+        self.bad_steps_seen = 0
+        self._last_rollback_step: int | None = None
+        self._last_bad: tuple[int, float] | None = None  # (step, loss)
+
+    @classmethod
+    def from_config(cls, cfg) -> "BadStepGuard | None":
+        policy = getattr(cfg, "bad_step_policy", "off")
+        if policy in ("off", "", None):
+            return None
+        return cls(
+            policy,
+            patience=getattr(cfg, "bad_step_patience", 5),
+            spike_factor=getattr(cfg, "loss_spike_factor", 0.0),
+        )
+
+    # ------------------------------------------------------------- intake
+
+    def observe(self, last_step: int, metrics) -> None:
+        """Enqueue a chunk's metrics; ``last_step`` is the chunk's final
+        step index. Bundled chunks carry [k]-vector metrics."""
+        self._pending.append(
+            (last_step, metrics.get("loss"), metrics.get("bad_step"))
+        )
+
+    def poll(self, *, drain: bool = False) -> str | None:
+        """Inspect completed entries; returns None, "rollback", or raises
+        :class:`BadStepError` for the abort outcomes."""
+        while self._pending:
+            step, loss, bad = self._pending[0]
+            forced = drain or len(self._pending) > self._max_pending
+            if not forced and not (_is_ready(loss) and _is_ready(bad)):
+                break
+            self._pending.popleft()
+            action = self._inspect(step, loss, bad)
+            if action is not None:
+                return action
+        return None
+
+    def reset(self) -> None:
+        """Post-rollback: stale pending entries refer to replayed steps."""
+        self._pending.clear()
+        self._consecutive = 0
+        self._ema = None
+
+    def note_rollback(self, restored_step: int) -> None:
+        if self._last_rollback_step == restored_step:
+            raise BadStepError(
+                f"bad steps recurred after rolling back to step "
+                f"{restored_step} twice — fault is not transient; aborting. "
+                f"{self.status()}"
+            )
+        self._last_rollback_step = restored_step
+        self.rollbacks += 1
+        self.reset()
+
+    def status(self) -> str:
+        where = (
+            f"last bad step {self._last_bad[0]} (loss={self._last_bad[1]:g})"
+            if self._last_bad
+            else "no bad step recorded"
+        )
+        return (
+            f"policy={self.policy} patience={self.patience} "
+            f"bad_steps_seen={self.bad_steps_seen} "
+            f"consecutive={self._consecutive} rollbacks={self.rollbacks}; "
+            f"{where}"
+        )
+
+    # ----------------------------------------------------------- decision
+
+    def _inspect(self, last_step: int, loss, bad) -> str | None:
+        losses = np.ravel(np.asarray(loss, np.float64))
+        bads = (
+            np.ravel(np.asarray(bad, np.float64))
+            if bad is not None
+            else np.zeros_like(losses)
+        )
+        k = len(losses)
+        for i, (lv, bv) in enumerate(zip(losses, bads)):
+            step = last_step - (k - 1) + i
+            is_bad = bv > 0 or not np.isfinite(lv)
+            if not is_bad and self.spike_factor > 0 and self._ema is not None:
+                is_bad = lv > self.spike_factor * max(abs(self._ema), 1e-8)
+            if is_bad:
+                self.bad_steps_seen += 1
+                self._consecutive += 1
+                self._last_bad = (step, float(lv))
+                if self.policy == "abort":
+                    raise BadStepError(
+                        f"bad train step {step} (loss={lv:g}) with "
+                        f"policy=abort. {self.status()}"
+                    )
+                if self._consecutive >= self.patience:
+                    if self.policy == "rollback":
+                        return "rollback"
+                    raise BadStepError(
+                        f"{self._consecutive} consecutive bad steps ending "
+                        f"at {step} exceeded patience={self.patience} with "
+                        f"policy=skip. {self.status()}"
+                    )
+            else:
+                self._consecutive = 0
+                if np.isfinite(lv):
+                    self._ema = (
+                        lv
+                        if self._ema is None
+                        else self._ema_decay * self._ema
+                        + (1 - self._ema_decay) * lv
+                    )
+        return None

@@ -10,11 +10,17 @@ handling here is three layers:
 2. **Hang watchdog** (``Watchdog``): a daemon thread the training loop
    pings every step. If no progress for ``timeout_s`` (device hang, stuck
    collective, wedged host↔TPU tunnel), it dumps every Python thread's
-   stack — turning a silent hang into a diagnosable event. Detection
-   only: it never kills the run (a pod-slice restart is the operator's /
-   scheduler's call).
+   stack — turning a silent hang into a diagnosable event. The loop also
+   marks which *phase* it is in (``enter("input_fetch")`` /
+   ``enter("device_step")``), so the dump says whether the host input
+   pipeline or the device step stalled. By default detection-only; with
+   ``fatal_timeout_s > 0`` the watchdog FAILS FAST once the stall
+   exceeds that bound — dump, then ``on_fatal`` (default:
+   ``os._exit(HUNG_EXIT_CODE)``) — because at pod scale a silently hung
+   host wedges the whole slice (ISSUE 1 / arXiv:1909.09756).
 3. **Recovery** is checkpoint-resume, which the shared loop already does
-   (orbax latest-checkpoint restore + stateless-resumable input order).
+   (orbax latest-checkpoint restore + stateless-resumable input order),
+   plus the preemption/bad-step machinery in train/resilience.py.
 """
 
 from __future__ import annotations
@@ -62,26 +68,48 @@ def install_crash_handlers(workdir: str = "") -> None:
         pass
 
 
+# Exit code for a watchdog-terminated (fail-fast) run: distinguishable
+# from clean exits (0), python errors (1), and signal deaths (128+N).
+HUNG_EXIT_CODE = 87
+
+
 class Watchdog:
     """Detects training-loop hangs; dumps all thread stacks once per hang.
 
     >>> wd = Watchdog(timeout_s=600); wd.start()
     >>> for step ...: wd.ping(step)
     >>> wd.stop()
+
+    ``enter(phase)`` marks loop phases ("input_fetch", "device_step", …)
+    and counts as a heartbeat — a phase transition IS progress — so the
+    hang report can name the stalled phase and how long it sat there.
+    With ``fatal_timeout_s > 0``, a stall that long triggers fail-fast:
+    diagnostic dump, then ``on_fatal(step, stalled_s)`` (default
+    ``os._exit(HUNG_EXIT_CODE)`` — a deliberate hard exit: the main
+    thread is by definition wedged, possibly inside a C call that a
+    Python-level exception could never interrupt).
     """
 
     def __init__(
         self,
         timeout_s: float,
         *,
+        fatal_timeout_s: float = 0.0,
         on_hang: Callable[[int, float], None] | None = None,
+        on_fatal: Callable[[int, float], None] | None = None,
         poll_s: float | None = None,
     ):
         self.timeout_s = timeout_s
+        self.fatal_timeout_s = fatal_timeout_s
         self._on_hang = on_hang
+        self._on_fatal = on_fatal
         self._poll_s = poll_s if poll_s is not None else min(timeout_s / 4, 30.0)
+        if fatal_timeout_s > 0:
+            self._poll_s = min(self._poll_s, max(fatal_timeout_s / 4, 0.05))
         self._last_ping = time.monotonic()
         self._last_step = -1
+        self._phase = "startup"
+        self._phase_since = time.monotonic()
         self._paused = False
         self._fired_for = -2  # last step a hang was reported for
         self._stop = threading.Event()
@@ -97,6 +125,16 @@ class Watchdog:
     def ping(self, step: int) -> None:
         self._last_ping = time.monotonic()
         self._last_step = step
+
+    def enter(self, phase: str) -> None:
+        """Mark a loop phase ("input_fetch", "device_step", "restore", …).
+
+        A phase transition is progress, so this refreshes the heartbeat
+        (but not the step counter)."""
+        now = time.monotonic()
+        self._phase = phase
+        self._phase_since = now
+        self._last_ping = now
 
     def pause(self) -> None:
         """Suspend hang detection (long known-slow phase: eval, ckpt,
@@ -114,24 +152,54 @@ class Watchdog:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def _dump(self, stalled: float, *, fatal: bool) -> None:
+        log.error(
+            "WATCHDOG%s: no training progress for %.1fs (last step %d, "
+            "phase %r for %.1fs) — dumping all thread stacks",
+            " FATAL" if fatal else "",
+            stalled,
+            self._last_step,
+            self._phase,
+            time.monotonic() - self._phase_since,
+        )
+        faulthandler.dump_traceback(file=sys.stderr)
+        if _fault_file is not None:
+            # Also into the durable fault log (stderr may not be
+            # captured on managed VMs — the motivating scenario).
+            faulthandler.dump_traceback(file=_fault_file)
+            _fault_file.flush()
+
     def _run(self) -> None:
+        fatal_fired = False
         while not self._stop.wait(self._poll_s):
             if self._paused:
                 continue
             stalled = time.monotonic() - self._last_ping
-            if stalled >= self.timeout_s and self._fired_for != self._last_step:
+            fatal_now = (
+                self.fatal_timeout_s > 0
+                and stalled >= self.fatal_timeout_s
+                and not fatal_fired
+            )
+            if (
+                not fatal_now  # one dump when both fire in the same pass
+                and stalled >= self.timeout_s
+                and self._fired_for != self._last_step
+            ):
                 self._fired_for = self._last_step
-                log.error(
-                    "WATCHDOG: no training progress for %.0fs (last step %d) "
-                    "— dumping all thread stacks",
-                    stalled,
-                    self._last_step,
-                )
-                faulthandler.dump_traceback(file=sys.stderr)
-                if _fault_file is not None:
-                    # Also into the durable fault log (stderr may not be
-                    # captured on managed VMs — the motivating scenario).
-                    faulthandler.dump_traceback(file=_fault_file)
-                    _fault_file.flush()
+                self._dump(stalled, fatal=False)
                 if self._on_hang is not None:
                     self._on_hang(self._last_step, stalled)
+            if fatal_now:
+                fatal_fired = True
+                self._dump(stalled, fatal=True)
+                if self._on_fatal is not None:
+                    self._on_fatal(self._last_step, stalled)
+                else:
+                    log.critical(
+                        "WATCHDOG: failing fast with exit code %d rather "
+                        "than hanging the slice",
+                        HUNG_EXIT_CODE,
+                    )
+                    if _fault_file is not None:
+                        _fault_file.flush()
+                    os._exit(HUNG_EXIT_CODE)

@@ -1,0 +1,292 @@
+"""Deterministic fault injection + bounded-retry IO (ISSUE 1 resilience).
+
+At pod scale (v5e-8 .. v5p-64) preemptions, flaky input storage, and
+numeric blow-ups are routine; every recovery path in the trainer must be
+exercisable in CI on CPU. This module is the injection engine the
+training loop, prefetch pipeline, and file readers consult at their
+instrumentation points, plus the ``retry_io`` wrapper those readers run
+their filesystem operations through.
+
+Fault specs are comma-separated ``kind@arg`` tokens, deterministic by
+construction (keyed on step / fetch index, never wall clock):
+
+  ``sigterm@N``    deliver SIGTERM to this process right before train
+                   step N runs (the loop finishes the in-flight chunk,
+                   checkpoints, and exits cleanly with code 0).
+  ``nan@N``        poison the float leaves of step N's batch with NaN.
+  ``nan@N:M``      ... for M consecutive steps starting at N.
+  ``slow@N:S``     sleep S seconds while fetching train-pipeline batch
+                   number N (0-based fetch index — eval prefetch opts
+                   out of the hooks, so the numbering is stable even
+                   when eval interleaves; trips the watchdog). With
+                   ``steps_per_launch=k > 1`` the pipeline fetches
+                   k-batch BUNDLES, so index N is the Nth bundle
+                   (covering steps N*k .. N*k+k-1), not the Nth host
+                   batch. Same indexing for ``badbatch@N``.
+  ``ioerr@K``      the first K filesystem operations routed through
+                   ``retry_io`` raise OSError (exercises retry/backoff).
+  ``badbatch@N``   corrupt host batch number N so host->device transfer
+                   fails (exercises the poisoned-batch skip counter).
+
+Each step/index-keyed fault fires ONCE: a rollback that replays step N
+does not re-poison it, which models transient faults and keeps the
+rollback tests convergent.
+
+Activation: ``install(spec)`` in-process (the ``faults`` pytest fixture)
+or the ``TPU_FAULT_INJECT`` environment variable (read lazily on first
+``active()`` call — how ``tools/fault_inject.py`` arms a child CLI).
+When no plan is armed every hook site is a single global-read + None
+check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "TPU_FAULT_INJECT"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    sigterm_at: frozenset[int] = frozenset()
+    nan_at: frozenset[int] = frozenset()  # expanded: nan@N:M -> {N..N+M-1}
+    slow_at: dict[int, float] = dataclasses.field(default_factory=dict)
+    io_errors: int = 0
+    bad_batch_at: frozenset[int] = frozenset()
+
+
+def parse_spec(spec: str) -> FaultPlan:
+    """Parse ``"sigterm@10,nan@5:2,slow@3:8,ioerr@2,badbatch@1"``."""
+    kinds = ("sigterm", "nan", "slow", "ioerr", "badbatch")
+    sigterm, nan, slow, bad = set(), set(), {}, set()
+    io_errors = 0
+    for token in filter(None, (t.strip() for t in spec.split(","))):
+        kind, _, arg = token.partition("@")
+        if kind not in kinds:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (one of {'/'.join(kinds)})"
+            )
+        if not arg:
+            raise ValueError(f"fault token {token!r} needs '@<arg>'")
+        head, _, tail = arg.partition(":")
+        try:  # only the numeric conversions — routing stays outside
+            if kind == "sigterm":
+                sigterm.add(int(head))
+            elif kind == "nan":
+                start, count = int(head), int(tail) if tail else 1
+                nan.update(range(start, start + count))
+            elif kind == "slow":
+                slow[int(head)] = float(tail) if tail else 5.0
+            elif kind == "ioerr":
+                io_errors += int(head)
+            else:
+                bad.add(int(head))
+        except ValueError as e:
+            raise ValueError(f"malformed fault token {token!r}: {e}") from None
+    return FaultPlan(
+        sigterm_at=frozenset(sigterm),
+        nan_at=frozenset(nan),
+        slow_at=slow,
+        io_errors=io_errors,
+        bad_batch_at=frozenset(bad),
+    )
+
+
+class _Unconvertible:
+    """A leaf ``jnp.asarray`` cannot convert — the poisoned-batch payload."""
+
+    def __repr__(self):  # pragma: no cover - repr only surfaces in logs
+        return "<injected-corrupt-leaf>"
+
+
+class Engine:
+    """Runtime state for one armed FaultPlan (counters, fired-once sets)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._fetch_idx = 0
+        self._io_fails_left = plan.io_errors
+        self._fired_sigterm: set[int] = set()
+        self._fired_nan: set[int] = set()
+        self._fired_bad: set[int] = set()
+        self._fired_slow: set[int] = set()
+
+    # ----------------------------------------------------- loop-side hooks
+
+    def step_hook(self, first_step: int, k: int = 1) -> None:
+        """Called at the top of each train chunk covering steps
+        ``[first_step, first_step + k)``."""
+        for s in range(first_step, first_step + k):
+            if s in self.plan.sigterm_at and s not in self._fired_sigterm:
+                self._fired_sigterm.add(s)
+                log.warning("FAULT: delivering SIGTERM before step %d", s)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def nan_hook(self, first_step: int, k: int, batch):
+        """Poison the float leaves of any planned step in the chunk."""
+        hits = [
+            s in self.plan.nan_at and s not in self._fired_nan
+            for s in range(first_step, first_step + k)
+        ]
+        if not any(hits):
+            return batch
+        for i, hit in enumerate(hits):
+            if hit:
+                self._fired_nan.add(first_step + i)
+        import jax.numpy as jnp
+        import numpy as np
+
+        poisoned = [False]
+
+        def poison(x):
+            if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                return x
+            poisoned[0] = True
+            if k == 1:
+                return x * np.float32(np.nan)
+            mult = np.ones((k,) + (1,) * (np.ndim(x) - 1), np.float32)
+            for i, hit in enumerate(hits):
+                if hit:
+                    mult[i] = np.nan
+            return x * mult
+
+        import jax
+
+        out = jax.tree.map(poison, batch)
+        if not poisoned[0]:
+            raise RuntimeError(
+                "nan fault requested for step "
+                f"{[first_step + i for i, h in enumerate(hits) if h]} but the "
+                "batch has no float leaves to poison (token-only workloads "
+                "cannot carry a NaN input)"
+            )
+        log.warning(
+            "FAULT: poisoned batch floats with NaN for steps %s",
+            [first_step + i for i, h in enumerate(hits) if h],
+        )
+        return out
+
+    # ------------------------------------------------------ data-side hooks
+
+    def batch_hook(self, batch):
+        """Called once per host batch fetch (prefetch pipeline), BEFORE the
+        host->device transfer. May sleep (slow) or corrupt (badbatch)."""
+        idx = self._fetch_idx
+        self._fetch_idx += 1
+        s = self.plan.slow_at.get(idx)
+        if s is not None and idx not in self._fired_slow:
+            self._fired_slow.add(idx)
+            log.warning("FAULT: stalling batch fetch %d for %.1fs", idx, s)
+            time.sleep(s)
+        if idx in self.plan.bad_batch_at and idx not in self._fired_bad:
+            self._fired_bad.add(idx)
+            log.warning("FAULT: corrupting batch fetch %d", idx)
+            return {k: _Unconvertible() for k in batch}
+        return batch
+
+    def io_check(self, what: str) -> None:
+        """Called per filesystem attempt inside ``retry_io``."""
+        if self._io_fails_left > 0:
+            self._io_fails_left -= 1
+            raise OSError(
+                f"injected io error for {what} "
+                f"({self._io_fails_left} more to come)"
+            )
+
+
+# ------------------------------------------------------- global activation
+
+_engine: Engine | None = None
+_env_checked = False
+
+
+def install(spec_or_plan: str | FaultPlan) -> Engine:
+    """Arm a fault plan in-process (tests use the ``faults`` fixture)."""
+    global _engine, _env_checked
+    plan = (
+        parse_spec(spec_or_plan)
+        if isinstance(spec_or_plan, str)
+        else spec_or_plan
+    )
+    _engine = Engine(plan)
+    _env_checked = True
+    return _engine
+
+
+def clear() -> None:
+    global _engine, _env_checked
+    _engine = None
+    _env_checked = False
+
+
+def active() -> Engine | None:
+    """The armed engine, lazily initialized from $TPU_FAULT_INJECT."""
+    global _engine, _env_checked
+    if _engine is None and not _env_checked:
+        _env_checked = True
+        spec = os.environ.get(ENV_VAR, "")
+        if spec:
+            _engine = Engine(parse_spec(spec))
+            log.info("fault injection armed from $%s=%s", ENV_VAR, spec)
+    return _engine
+
+
+# ------------------------------------------------------------ IO retries
+
+# Defaults; overridden from TrainConfig (io_retries / io_backoff_secs) by
+# train/cli._setup via configure_io_retry.
+_io_retry = {"attempts": 3, "backoff": 0.25}
+
+
+def configure_io_retry(attempts: int, backoff_secs: float) -> None:
+    _io_retry["attempts"] = max(int(attempts), 0)
+    _io_retry["backoff"] = max(float(backoff_secs), 0.0)
+
+
+def retry_io(
+    fn: Callable,
+    what: str,
+    *,
+    attempts: int | None = None,
+    backoff_secs: float | None = None,
+):
+    """Run a filesystem operation with bounded retry + exponential backoff.
+
+    Retries only OSError (flaky NFS/GCS-fuse reads, the pod-scale reality);
+    data errors (ValueError etc.) propagate immediately. ``attempts`` is
+    the number of RETRIES after the first try. An armed fault engine's
+    ``io_check`` runs before each attempt so injected IO faults exercise
+    exactly this path.
+    """
+    import gzip
+
+    attempts = _io_retry["attempts"] if attempts is None else attempts
+    backoff = _io_retry["backoff"] if backoff_secs is None else backoff_secs
+    for attempt in range(attempts + 1):
+        try:
+            eng = active()
+            if eng is not None:
+                eng.io_check(what)
+            return fn()
+        except OSError as e:
+            if isinstance(e, gzip.BadGzipFile):
+                raise  # corrupt data, not a transient store fault
+            if attempt >= attempts:
+                raise
+            delay = backoff * (2**attempt)
+            log.warning(
+                "io error on %s (attempt %d/%d), retrying in %.2fs: %s",
+                what,
+                attempt + 1,
+                attempts + 1,
+                delay,
+                e,
+            )
+            time.sleep(delay)

@@ -70,6 +70,29 @@ def pytest_runtest_call(item):
         signal.signal(signal.SIGALRM, old)
 
 
+@pytest.fixture
+def faults():
+    """Arm a deterministic fault plan for the duration of one test.
+
+    Usage::
+
+        def test_x(faults):
+            engine = faults("sigterm@5,ioerr@2")
+            ...
+
+    The plan is torn down afterwards even if the test dies mid-fault.
+    Spec grammar: tensorflow_examples_tpu/utils/faults.py (sigterm@N,
+    nan@N[:M], slow@N[:S], ioerr@K, badbatch@N).
+    """
+    from tensorflow_examples_tpu.utils import faults as faults_mod
+
+    def arm(spec: str):
+        return faults_mod.install(spec)
+
+    yield arm
+    faults_mod.clear()
+
+
 @pytest.fixture(scope="session")
 def devices():
     d = jax.devices()

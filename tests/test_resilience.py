@@ -1,0 +1,545 @@
+"""Fault-injection tests for the resilience layer (ISSUE 1).
+
+Every recovery behavior is exercised on CPU with deterministic faults
+(tests/conftest.py ``faults`` fixture -> utils/faults.py): preemption
+checkpoints + bitwise-identical resume (MNIST and GPT-2), NaN skip /
+rollback / abort policies, the hung-step watchdog (dump and fail-fast),
+bounded IO retry, and the poisoned-batch skip counter. Marked ``faults``
+(deliberately not ``slow``) so the tier-1 command always runs them.
+"""
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tensorflow_examples_tpu.data.memory import train_iterator
+from tensorflow_examples_tpu.data.sources import synthetic_images
+from tensorflow_examples_tpu.train import resilience
+from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
+from tensorflow_examples_tpu.train.loop import Trainer
+from tensorflow_examples_tpu.utils import faults as faults_mod
+from tensorflow_examples_tpu.workloads import mnist
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        device="cpu",
+        global_batch_size=64,
+        train_steps=12,
+        log_every=50,
+        learning_rate=1e-2,
+        hidden=16,
+        num_layers=1,
+        dropout=0.0,
+        precision="f32",
+        checkpoint_every=100,
+        watchdog_secs=0,
+    )
+    defaults.update(kw)
+    return mnist.MnistConfig(**defaults)
+
+
+def _data(n=256):
+    return synthetic_images(n=n, shape=(28, 28, 1), num_classes=10, seed=0)
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+# ------------------------------------------------------------ spec parsing
+
+
+def test_spec_parsing():
+    p = faults_mod.parse_spec("sigterm@10,nan@5:2,slow@3:8,ioerr@2,badbatch@1")
+    assert p.sigterm_at == frozenset({10})
+    assert p.nan_at == frozenset({5, 6})
+    assert p.slow_at == {3: 8.0}
+    assert p.io_errors == 2
+    assert p.bad_batch_at == frozenset({1})
+    assert faults_mod.parse_spec("slow@4").slow_at == {4: 5.0}
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults_mod.parse_spec("frobnicate@3")
+    with pytest.raises(ValueError, match="needs '@"):
+        faults_mod.parse_spec("sigterm")
+    with pytest.raises(ValueError, match="malformed"):
+        faults_mod.parse_spec("nan@x")
+
+
+# ----------------------------------------------------------- io retry path
+
+
+def test_retry_io_recovers(faults):
+    faults("ioerr@2")
+    calls = []
+    out = faults_mod.retry_io(
+        lambda: calls.append(1) or 42, "x", backoff_secs=0.001
+    )
+    assert out == 42 and len(calls) == 1  # fn ran once, after 2 injected errs
+
+
+def test_retry_io_bounded(faults):
+    faults("ioerr@10")
+    with pytest.raises(OSError, match="injected io error"):
+        faults_mod.retry_io(lambda: 42, "x", attempts=2, backoff_secs=0.001)
+
+
+def test_sources_read_retries(faults, tmp_path):
+    """A real loader path (MNIST IDX) survives transient IO errors."""
+    import gzip
+    import struct
+
+    imgs = np.zeros((4, 28, 28), np.uint8)
+    lbls = np.arange(4, dtype=np.uint8)
+    with gzip.open(tmp_path / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3))
+        f.write(struct.pack(">III", 4, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(tmp_path / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1))
+        f.write(struct.pack(">I", 4))
+        f.write(lbls.tobytes())
+
+    faults_mod.configure_io_retry(3, 0.001)
+    try:
+        faults("ioerr@2")
+        from tensorflow_examples_tpu.data.sources import load_mnist
+
+        ds = load_mnist(str(tmp_path), split="train")
+        assert ds.size == 4
+        np.testing.assert_array_equal(ds.arrays["label"], lbls)
+    finally:
+        faults_mod.configure_io_retry(3, 0.25)
+
+
+# ------------------------------------------------------- poisoned batches
+
+
+def _batches(n):
+    for i in range(n):
+        yield {"x": np.full((4,), i, np.float32)}
+
+
+def test_poisoned_batch_skipped_and_counted(faults):
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    from tensorflow_examples_tpu.data.prefetch import device_prefetch
+
+    sharding = SingleDeviceSharding(jax.devices()[0])
+    faults("badbatch@1")
+    got = [
+        float(b["x"][0])
+        for b in device_prefetch(_batches(4), sharding, max_skips=1)
+    ]
+    assert got == [0.0, 2.0, 3.0]  # batch 1 skipped, rest intact
+
+
+def test_poisoned_batch_budget_exhausted(faults):
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    from tensorflow_examples_tpu.data.prefetch import device_prefetch
+
+    sharding = SingleDeviceSharding(jax.devices()[0])
+    faults("badbatch@1,badbatch@2")  # two bad batches, budget of one
+    with pytest.raises(RuntimeError, match="budget max_skipped_batches=1"):
+        list(device_prefetch(_batches(5), sharding, max_skips=1))
+
+
+def test_poisoned_batch_default_propagates_original_error(faults):
+    """max_skips=0 (the default) must surface the ORIGINAL exception —
+    a deterministic pipeline bug is not 'corrupt input'."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    from tensorflow_examples_tpu.data.prefetch import device_prefetch
+
+    sharding = SingleDeviceSharding(jax.devices()[0])
+    faults("badbatch@1")
+    with pytest.raises(TypeError, match="not a valid JAX array type"):
+        list(device_prefetch(_batches(4), sharding))
+
+
+# ------------------------------------------------- preemption + resume
+
+
+@pytest.mark.timeout(300)
+def test_preempt_resume_bitwise_mnist(faults, tmp_path, devices):
+    """SIGTERM mid-run -> clean Preempted exit with a checkpoint; the
+    resumed run's final params are BITWISE identical to an uninterrupted
+    run's (stateless-resumable input order + step-keyed rng)."""
+    ds = _data()
+
+    def data_fn(start):
+        return train_iterator(ds, 64, seed=7, start_step=start)
+
+    cfg_a = tiny_cfg(train_steps=8, workdir=str(tmp_path / "a"))
+    tr_a = Trainer(mnist.make_task(cfg_a), cfg_a)
+    tr_a.fit(data_fn)
+
+    wd = str(tmp_path / "b")
+    cfg_b = tiny_cfg(train_steps=8, workdir=wd)
+    tr_b1 = Trainer(mnist.make_task(cfg_b), cfg_b)
+    faults("sigterm@4")
+    with pytest.raises(resilience.Preempted) as exc:
+        tr_b1.fit(data_fn)
+    assert exc.value.code == 0  # clean exit code
+    assert exc.value.step == 5  # boundary after the in-flight step
+    assert CheckpointManager(wd).latest_step() == 5
+
+    faults_mod.clear()
+    tr_b2 = Trainer(mnist.make_task(cfg_b), cfg_b)
+    tr_b2.fit(data_fn)
+    assert int(tr_b2.state.step) == 8
+    for a, b in zip(_leaves(tr_a.state.params), _leaves(tr_b2.state.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.timeout(300)
+def test_preempt_resume_bitwise_gpt2(faults, tmp_path, devices):
+    from tensorflow_examples_tpu.workloads import gpt2
+
+    def cfg_for(workdir):
+        return gpt2.Gpt2Config(
+            vocab_size=64, seq_len=16, num_layers=1, num_heads=2, d_model=16,
+            dropout=0.0, attention="xla", global_batch_size=16,
+            train_steps=6, warmup_steps=2, learning_rate=3e-3, log_every=50,
+            checkpoint_every=100, eval_every=0, precision="f32",
+            watchdog_secs=0, workdir=workdir,
+        )
+
+    train_ds, _ = gpt2.datasets(cfg_for(""))
+
+    def data_fn(start):
+        return train_iterator(train_ds, 16, seed=3, start_step=start)
+
+    cfg_a = cfg_for(str(tmp_path / "a"))
+    tr_a = Trainer(gpt2.make_task(cfg_a), cfg_a)
+    tr_a.fit(data_fn)
+
+    cfg_b = cfg_for(str(tmp_path / "b"))
+    tr_b1 = Trainer(gpt2.make_task(cfg_b), cfg_b)
+    faults("sigterm@3")
+    with pytest.raises(resilience.Preempted) as exc:
+        tr_b1.fit(data_fn)
+    assert exc.value.code == 0
+
+    faults_mod.clear()
+    tr_b2 = Trainer(gpt2.make_task(cfg_b), cfg_b)
+    tr_b2.fit(data_fn)
+    assert int(tr_b2.state.step) == 6
+    for a, b in zip(_leaves(tr_a.state.params), _leaves(tr_b2.state.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_preempt_without_workdir_still_exits_cleanly(faults, devices):
+    cfg = tiny_cfg(train_steps=6)
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("sigterm@2")
+    with pytest.raises(resilience.Preempted) as exc:
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+    assert exc.value.code == 0 and exc.value.signum == signal.SIGTERM
+
+
+# ------------------------------------------------------- bad-step guards
+
+
+@pytest.mark.timeout(300)
+def test_nan_skip_policy(faults, devices):
+    """An injected NaN batch is skipped ON DEVICE: params stay finite,
+    training continues, and the bad step is counted."""
+    cfg = tiny_cfg(train_steps=10, bad_step_policy="skip")
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("nan@3")
+    metrics = trainer.fit(train_iterator(_data(), 64, seed=0))
+    assert int(trainer.state.step) == 10
+    for leaf in _leaves(trainer.state.params):
+        assert np.isfinite(leaf).all()
+    assert np.isfinite(metrics["loss"])  # finite-mean excludes the NaN step
+    assert trainer._guard.bad_steps_seen == 1
+    assert metrics["bad_step"] > 0
+
+
+@pytest.mark.timeout(300)
+def test_nan_rollback_policy(faults, tmp_path, devices):
+    """K consecutive NaN steps trigger a restore of the latest checkpoint
+    and a replay; transient faults (fire-once) converge."""
+    ds = _data()
+
+    def data_fn(start):
+        return train_iterator(ds, 64, seed=5, start_step=start)
+
+    cfg = tiny_cfg(
+        train_steps=12,
+        checkpoint_every=4,
+        workdir=str(tmp_path),
+        bad_step_policy="rollback",
+        bad_step_patience=3,
+    )
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("nan@6:3")
+    trainer.fit(data_fn)
+    assert trainer._guard.rollbacks == 1
+    assert int(trainer.state.step) == 12
+    for leaf in _leaves(trainer.state.params):
+        assert np.isfinite(leaf).all()
+
+
+def test_abort_policy(faults, devices):
+    cfg = tiny_cfg(train_steps=10, bad_step_policy="abort")
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("nan@2")
+    with pytest.raises(resilience.BadStepError, match="policy=abort"):
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+
+
+def test_skip_policy_aborts_after_patience(faults, devices):
+    cfg = tiny_cfg(
+        train_steps=12, bad_step_policy="skip", bad_step_patience=3
+    )
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("nan@2:6")
+    with pytest.raises(resilience.BadStepError, match="consecutive bad steps"):
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+
+
+def test_rollback_needs_a_checkpoint(faults, devices):
+    cfg = tiny_cfg(
+        train_steps=10, bad_step_policy="rollback", bad_step_patience=2
+    )
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("nan@2:4")
+    with pytest.raises(resilience.BadStepError, match="needs a checkpoint"):
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+
+
+def test_guard_spike_detection():
+    g = resilience.BadStepGuard("abort", spike_factor=5.0)
+    for step, loss in enumerate([1.0, 1.1, 0.9, 1.0]):
+        g.observe(step, {"loss": np.float32(loss), "bad_step": np.float32(0)})
+    assert g.poll(drain=True) is None
+    g.observe(4, {"loss": np.float32(100.0), "bad_step": np.float32(0)})
+    with pytest.raises(resilience.BadStepError, match="bad train step 4"):
+        g.poll(drain=True)
+
+
+def test_guard_repeat_rollback_aborts():
+    g = resilience.BadStepGuard("rollback", patience=1)
+    g.note_rollback(4)
+    with pytest.raises(resilience.BadStepError, match="not transient"):
+        g.note_rollback(4)
+
+
+def test_guard_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="bad_step_policy"):
+        resilience.BadStepGuard("explode")
+
+
+def test_invalid_policy_rejected_before_watchdog_starts(devices):
+    """Config validation precedes thread/handler setup: a typo'd policy
+    must not leak a running watchdog thread out of fit()."""
+    import threading
+
+    cfg = tiny_cfg(train_steps=2, bad_step_policy="skp", watchdog_secs=5)
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    with pytest.raises(ValueError, match="bad_step_policy"):
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+    leaked = [t for t in threading.enumerate() if t.name == "train-watchdog"]
+    assert not leaked
+
+
+# ------------------------------------------------------------- watchdog
+
+
+def test_watchdog_reports_phase():
+    import time
+
+    from tensorflow_examples_tpu.utils.diagnostics import Watchdog
+
+    hangs = []
+    wd = Watchdog(
+        0.15, on_hang=lambda step, stalled: hangs.append((step, stalled)),
+        poll_s=0.03,
+    ).start()
+    try:
+        wd.ping(5)
+        wd.enter("input_fetch")
+        time.sleep(0.4)
+        assert hangs and hangs[0][0] == 5
+        assert wd._phase == "input_fetch"
+    finally:
+        wd.stop()
+
+
+def test_watchdog_fatal_callback():
+    import time
+
+    from tensorflow_examples_tpu.utils.diagnostics import Watchdog
+
+    fatals = []
+    wd = Watchdog(
+        0.1,
+        fatal_timeout_s=0.2,
+        on_hang=lambda *a: None,
+        on_fatal=lambda step, stalled: fatals.append(stalled),
+        poll_s=0.03,
+    ).start()
+    try:
+        wd.ping(1)
+        time.sleep(0.5)
+        assert fatals and fatals[0] >= 0.2
+    finally:
+        wd.stop()
+
+
+@pytest.mark.timeout(300)
+def test_watchdog_trips_on_stalled_batch(faults, devices, caplog):
+    """An injected slow batch fetch trips the in-loop watchdog, which
+    names the stalled phase in its diagnostic dump."""
+    cfg = tiny_cfg(train_steps=8, watchdog_secs=0.4)
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("slow@5:1.5")
+    with caplog.at_level(logging.ERROR, logger="tensorflow_examples_tpu"):
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+    dumps = [r for r in caplog.records if "WATCHDOG" in r.getMessage()]
+    assert dumps, "watchdog never fired on the stalled fetch"
+    assert "input_fetch" in dumps[0].getMessage()
+
+
+@pytest.mark.timeout(300)
+def test_watchdog_trips_on_startup_stall(faults, devices, caplog):
+    """A wedged input pipeline on the VERY FIRST fetch (before any step
+    completes) must still trip the watchdog: fetch-stall detection arms
+    at the fetch, pausing only for the first step's jit compile."""
+    cfg = tiny_cfg(train_steps=4, watchdog_secs=0.4)
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("slow@0:1.5")
+    with caplog.at_level(logging.ERROR, logger="tensorflow_examples_tpu"):
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+    assert any(
+        "WATCHDOG" in r.getMessage() and "input_fetch" in r.getMessage()
+        for r in caplog.records
+    ), "startup input stall went undetected"
+
+
+# ------------------------------------------------ checkpoint satellites
+
+
+def test_checkpoint_context_manager(tmp_path, devices):
+    cfg = tiny_cfg(train_steps=2)
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    with CheckpointManager(str(tmp_path)) as ckpt:
+        ckpt.save(2, trainer.state)
+        # async save may still be in flight here; __exit__ must wait it out
+    assert CheckpointManager(str(tmp_path)).latest_step() == 2
+
+
+@pytest.mark.timeout(300)
+def test_ckpt_closed_on_fit_exception(faults, tmp_path, devices):
+    """A crash mid-run must not abandon the in-flight async save: the
+    exception path waits + closes, leaving a readable latest checkpoint."""
+    cfg = tiny_cfg(
+        train_steps=10, checkpoint_every=2, workdir=str(tmp_path)
+    )
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    faults("badbatch@6")  # max_skipped_batches=0 -> poisoned batch is fatal
+    with pytest.raises(TypeError, match="not a valid JAX array type"):
+        trainer.fit(train_iterator(_data(), 64, seed=0))
+    assert trainer._ckpt is None  # closed + cleared on the exception path
+    step = CheckpointManager(str(tmp_path)).latest_step()
+    assert step is not None and step >= 2
+    restored = CheckpointManager(str(tmp_path)).restore_latest(
+        Trainer(mnist.make_task(cfg), cfg).state
+    )
+    assert restored is not None and int(restored[1]) == step
+
+
+def test_restore_validates_structure(tmp_path, devices):
+    """Restoring into a drifted model config fails up front with the
+    offending paths, not deep inside orbax."""
+    cfg_small = tiny_cfg(train_steps=2, hidden=16)
+    cfg_big = tiny_cfg(train_steps=2, hidden=32)
+    with CheckpointManager(str(tmp_path), async_save=False) as ckpt:
+        ckpt.save(1, Trainer(mnist.make_task(cfg_small), cfg_small).state)
+    big = Trainer(mnist.make_task(cfg_big), cfg_big)
+    with pytest.raises(ValueError, match="shape mismatch") as exc:
+        CheckpointManager(str(tmp_path)).restore_latest(big.state)
+    assert "params" in str(exc.value)  # names the drifted path
+
+
+# ------------------------------------------------- end-to-end CLI chaos
+
+
+def _run_cli(script, extra_flags, spec, timeout=240):
+    env = dict(os.environ)
+    env[faults_mod.ENV_VAR] = spec
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, script), "--device=cpu"]
+        + extra_flags,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_cli_watchdog_fail_fast_exit_code(tmp_path):
+    """A hung input fetch past watchdog_fatal_secs kills the process with
+    the HUNG_EXIT_CODE signature instead of wedging the slice."""
+    from tensorflow_examples_tpu.utils.diagnostics import HUNG_EXIT_CODE
+
+    proc = _run_cli(
+        "examples/mnist/train.py",
+        [
+            "--train_steps=50", "--global_batch_size=64", "--hidden=16",
+            "--num_layers=1", "--log_every=5", "--checkpoint_every=0",
+            "--watchdog_secs=1", "--watchdog_fatal_secs=3",
+        ],
+        "slow@4:60",
+    )
+    assert proc.returncode == HUNG_EXIT_CODE, (
+        proc.returncode,
+        proc.stdout[-2000:],
+        proc.stderr[-2000:],
+    )
+    assert "WATCHDOG" in proc.stderr
+
+
+@pytest.mark.timeout(420)
+def test_fault_inject_tool_standalone(tmp_path):
+    """tools/fault_inject.py arms any workload CLI via the env var."""
+    wd = str(tmp_path / "run")
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "fault_inject.py"),
+            "--spec", "sigterm@2", "--",
+            sys.executable, os.path.join(REPO, "examples", "mnist", "train.py"),
+            "--device=cpu", "--train_steps=20", "--global_batch_size=64",
+            "--hidden=16", "--num_layers=1", "--checkpoint_every=100",
+            f"--workdir={wd}", "--watchdog_secs=0",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "exited cleanly" in proc.stdout
+    assert CheckpointManager(wd).latest_step() == 3

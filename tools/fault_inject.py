@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Standalone chaos runner: arm a fault plan and exec any workload CLI.
+
+Manual chaos testing against the real example entrypoints (ISSUE 1):
+
+    python tools/fault_inject.py --spec 'sigterm@10' -- \
+        python examples/mnist/train.py --device=cpu --train_steps=20 \
+        --workdir=/tmp/chaos
+
+    python tools/fault_inject.py --spec 'slow@5:60' -- \
+        python examples/gpt2/train.py --device=cpu --watchdog_secs=10 \
+        --watchdog_fatal_secs=30
+
+The spec is exported as $TPU_FAULT_INJECT; the trainer's instrumentation
+points (tensorflow_examples_tpu/utils/faults.py) pick it up lazily, so
+this works for ANY command that runs the shared training loop — no
+wrapper imports in the child. Exit code is the child's, with an
+interpretation printed for the ones the resilience layer defines:
+
+    0   clean exit — including a preemption that checkpointed and left
+    87  watchdog fail-fast (HUNG_EXIT_CODE): a step or input fetch
+        stalled past --watchdog_fatal_secs
+
+Fault kinds (comma-separated kind@arg tokens):
+    sigterm@N     SIGTERM right before train step N
+    nan@N[:M]     NaN-poison the batch floats for steps N..N+M-1
+    slow@N[:S]    sleep S (default 5) seconds fetching host batch N
+    ioerr@K       first K file reads raise OSError (retry/backoff path)
+    badbatch@N    corrupt host batch N (poisoned-batch skip path)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tensorflow_examples_tpu.utils.diagnostics import HUNG_EXIT_CODE  # noqa: E402
+from tensorflow_examples_tpu.utils.faults import ENV_VAR, parse_spec  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument(
+        "--spec",
+        required=True,
+        help="fault plan, e.g. 'sigterm@10,ioerr@2' (see module docstring)",
+    )
+    parser.add_argument(
+        "command",
+        nargs=argparse.REMAINDER,
+        help="workload CLI to run (prefix with -- )",
+    )
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given; usage: fault_inject.py --spec ... -- <cmd>")
+
+    plan = parse_spec(args.spec)  # validate before spawning anything
+    env = dict(os.environ)
+    env[ENV_VAR] = args.spec
+    print(f"[fault_inject] armed {plan} for: {' '.join(command)}", flush=True)
+    proc = subprocess.run(command, env=env)
+    rc = proc.returncode
+
+    if rc == 0:
+        print("[fault_inject] child exited cleanly (0)")
+    elif rc == HUNG_EXIT_CODE:
+        print(
+            f"[fault_inject] child exited {rc} = watchdog fail-fast "
+            "(hung step/input past watchdog_fatal_secs)"
+        )
+    elif rc < 0:
+        print(
+            f"[fault_inject] child killed by signal "
+            f"{signal.Signals(-rc).name}"
+        )
+    else:
+        print(f"[fault_inject] child exited {rc}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

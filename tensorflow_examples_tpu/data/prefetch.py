@@ -10,6 +10,11 @@ Resilience (ISSUE 1): each fetch runs through the fault-injection hook
 batch whose host→device conversion/transfer fails is SKIPPED and counted
 rather than killing the run, up to a bounded ``max_skips`` budget
 (``TrainConfig.max_skipped_batches``; 0 keeps the historical fail-fast).
+
+Telemetry (ISSUE 2): fetches and skips publish into the default metrics
+registry (``data/batches_fetched``, ``data/batches_skipped``) so the
+formerly write-only skip counter shows up in every JSONL window and in
+the run report.
 """
 
 from __future__ import annotations
@@ -21,6 +26,7 @@ from typing import Iterator
 import jax
 import jax.numpy as jnp
 
+from tensorflow_examples_tpu.telemetry import registry as _telemetry_registry
 from tensorflow_examples_tpu.utils import faults as _faults
 
 log = logging.getLogger(__name__)
@@ -100,6 +106,9 @@ def device_prefetch(
     queue = collections.deque()
     put_fn = put_local_batch if local_batches else put_batch
     skipped = 0
+    reg = _telemetry_registry.default_registry()
+    fetched_ctr = reg.counter("data/batches_fetched")
+    skipped_ctr = reg.counter("data/batches_skipped")
 
     def fetch():
         """Next device-resident batch, or _END. With ``max_skips > 0`` a
@@ -118,11 +127,14 @@ def device_prefetch(
                     eng = _faults.active()
                     if eng is not None:
                         batch = eng.batch_hook(batch)
-                return put_fn(batch, sharding)
+                out = put_fn(batch, sharding)
+                fetched_ctr.inc()
+                return out
             except Exception as e:
                 if max_skips <= 0:
                     raise
                 skipped += 1
+                skipped_ctr.inc()
                 if skipped > max_skips:
                     raise RuntimeError(
                         f"poisoned input batch ({skipped} bad, budget "

@@ -12,10 +12,17 @@ input store (NFS / GCS-fuse) is flaky long before the TPUs are — and the
 same wrapper is where fault-injection IO errors land in tests. Existence
 checks and their deliberate FileNotFoundError messages stay outside the
 retry (a missing dataset is a config error, not a transient fault).
+
+Telemetry (ISSUE 2): each loader brackets its work in a
+``dataset_load`` span (telemetry/spans.py) — startup disk-read time
+shows up on the Chrome-trace timeline next to the train-loop phases —
+and ``retry_io``'s retries count into the ``io/retries`` registry
+counter, so flaky-store churn reaches the JSONL windows and run report.
 """
 
 from __future__ import annotations
 
+import functools
 import gzip
 import os
 import pickle
@@ -24,7 +31,23 @@ import struct
 import numpy as np
 
 from tensorflow_examples_tpu.data.memory import InMemoryDataset
+from tensorflow_examples_tpu.telemetry.spans import span as _trace_span
 from tensorflow_examples_tpu.utils.faults import retry_io
+
+
+def _traced_load(dataset: str):
+    """Bracket a loader in a ``dataset_load`` trace span (named by
+    dataset so a slow startup read is attributable on the timeline)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _trace_span("dataset_load", dataset=dataset):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 # ------------------------------------------------------------------ MNIST
@@ -57,6 +80,7 @@ def _find(data_dir: str, names: list[str]) -> str | None:
     return None
 
 
+@_traced_load("mnist")
 def load_mnist(data_dir: str = "", split: str = "train") -> InMemoryDataset:
     prefix = "train" if split == "train" else "t10k"
     if data_dir:
@@ -86,6 +110,7 @@ CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
 
+@_traced_load("cifar10")
 def load_cifar10(
     data_dir: str = "", split: str = "train", *, normalized: bool = True
 ) -> InMemoryDataset:
@@ -141,6 +166,7 @@ def load_cifar10(
 # ------------------------------------------------------------- LM corpora
 
 
+@_traced_load("lm_tokens")
 def load_lm_tokens(
     data_dir: str = "",
     split: str = "train",
@@ -241,6 +267,7 @@ GLUE_NUM_LABELS = {
 }
 
 
+@_traced_load("glue")
 def load_glue(
     data_dir: str = "",
     task: str = "sst2",

@@ -1,0 +1,43 @@
+"""Telemetry subsystem (ISSUE 2): metrics registry, span tracer,
+pluggable sinks, derived throughput/MFU/goodput accounting.
+
+See docs/observability.md for the architecture and file formats.
+
+Layer map:
+
+* ``registry``   — process-local counters/gauges/time-histograms every
+                   runtime layer publishes into (``default_registry()``).
+* ``spans``      — ``with span("data_fetch")`` host timeline; Chrome
+                   trace export; open-span introspection for watchdog
+                   hang dumps.
+* ``sinks``      — JSONL (crash-safe append), clu/TensorBoard (explicit
+                   null-writer fallback), console.
+* ``accounting`` — examples/sec, 6ND model-FLOPs MFU, goodput math.
+* ``schema``     — the self-describing JSONL line schema + validator.
+* ``hub``        — the ``Telemetry`` object the trainer owns, tying the
+                   above together per run.
+"""
+
+from tensorflow_examples_tpu.telemetry.accounting import (  # noqa: F401
+    goodput,
+    mfu,
+    peak_flops_per_device,
+    train_step_flops,
+)
+from tensorflow_examples_tpu.telemetry.hub import Telemetry  # noqa: F401
+from tensorflow_examples_tpu.telemetry.registry import (  # noqa: F401
+    MetricsRegistry,
+    default_registry,
+    reset_default_registry,
+)
+from tensorflow_examples_tpu.telemetry.schema import (  # noqa: F401
+    SCHEMA_VERSION,
+    validate_line,
+)
+from tensorflow_examples_tpu.telemetry.spans import (  # noqa: F401
+    Tracer,
+    active_span_names,
+    default_tracer,
+    reset_default_tracer,
+    span,
+)

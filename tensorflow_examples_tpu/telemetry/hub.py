@@ -1,0 +1,325 @@
+"""The Telemetry object the trainer owns (ISSUE 2 tentpole).
+
+One instance per ``Trainer.fit`` drives everything observable about the
+run: it snapshots the process-local registry (counters/gauges/
+histograms) into a schema-versioned line per log window, derives the
+accounting numbers (throughput, step-time percentiles, MFU, goodput),
+fans the line out to the configured sinks, and exports the span
+timeline as Chrome-trace JSON on close.
+
+Abnormal-exit contract (satellite): the JSONL sink flushes per line, so
+completed windows are always durable; ``final_window`` additionally
+emits the partial in-flight window with an ``exit_reason`` on
+preemption/abort, and ``emergency_flush`` is the watchdog-fatal hook —
+called from the watchdog thread right before ``os._exit(87)`` — that
+pushes sinks and the trace to disk while the main thread is wedged.
+
+Cross-host: most counters are incremented by every process for the SAME
+global event (the loop is SPMD — steps, checkpoint saves, bad steps are
+replicated), so their local value already IS the global truth and
+summing them would inflate by process_count. Only the counters in
+``HOST_LOCAL_COUNTERS`` — events each host observes independently — are
+summed over processes (a fixed name set, so the collective has
+identical shape on every host); every host then computes the identical
+line and process 0's JSONL is the run record.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Mapping
+
+from tensorflow_examples_tpu.telemetry import accounting
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry import sinks as sinks_mod
+from tensorflow_examples_tpu.telemetry import spans as spans_mod
+
+log = logging.getLogger(__name__)
+
+# Counters summed across hosts at each cadenced window: ONLY events each
+# host observes independently (its own flaky reads, its own poisoned
+# local batches). Everything else (train/steps_total, checkpoint/saves,
+# resilience/*) is SPMD-replicated — each host's value already equals
+# the global truth, so those pass through unreduced. Fixed set: the
+# collective must have identical shape on every process.
+HOST_LOCAL_COUNTERS = (
+    "io/retries",
+    "data/batches_skipped",
+)
+
+
+class Telemetry:
+    def __init__(
+        self,
+        sinks: list,
+        *,
+        registry=None,
+        tracer=None,
+        flops_per_step: float = 0.0,
+        peak_flops_total: float = 0.0,
+        peak_is_estimate: bool = True,
+        tokens_per_example: int = 1,
+        trace_file: str | None = None,
+        flush_every: int = 1,
+    ):
+        self.sinks = sinks
+        self.registry = (
+            registry
+            if registry is not None
+            else registry_mod.default_registry()
+        )
+        self.tracer = (
+            tracer if tracer is not None else spans_mod.default_tracer()
+        )
+        self.flops_per_step = float(flops_per_step)
+        self.peak_flops_total = float(peak_flops_total)
+        self.peak_is_estimate = bool(peak_is_estimate)
+        self.tokens_per_example = max(int(tokens_per_example), 1)
+        self.trace_file = trace_file
+        self.flush_every = max(int(flush_every), 1)
+        self._windows_since_flush = 0
+        self._last_step = 0  # most recent log_window step (fatal marker)
+        self._closed = False
+        # Counters are process-global and a process may run several
+        # fits; every line this Telemetry emits carries DELTAS from the
+        # fit-start snapshot, so each fit is a self-contained session
+        # and offline aggregation can simply sum sessions.
+        self._counter_base = dict(self.registry.counter_values())
+        self._session_start = time.time()  # session id in every line
+        if self.flops_per_step > 0:
+            self.registry.gauge("telemetry/flops_per_step").set(
+                self.flops_per_step
+            )
+        if self.peak_flops_total > 0:
+            self.registry.gauge("telemetry/peak_flops_total").set(
+                self.peak_flops_total
+            )
+            self.registry.gauge("telemetry/peak_is_estimate").set(
+                1.0 if self.peak_is_estimate else 0.0
+            )
+
+    @classmethod
+    def from_config(cls, cfg, *, n_params: int = 0) -> "Telemetry":
+        """Build from TrainConfig knobs (sink spec, trace toggle, flush
+        cadence, peak override) + the workload's size numbers."""
+        import jax
+
+        sinks = sinks_mod.make_sinks(
+            getattr(cfg, "telemetry_sinks", "console"), cfg.workdir
+        )
+        # Processed tokens per example: seq_len for token workloads
+        # (GPT-2 feeds tokens[:, :-1] — seq_len positions; BERT pads to
+        # seq_len), 1 for per-example workloads (images).
+        tokens = int(getattr(cfg, "seq_len", 0) or 0) or 1
+        flops = accounting.train_step_flops(
+            n_params, cfg.global_batch_size, tokens
+        )
+        peak_tflops = float(getattr(cfg, "telemetry_peak_tflops", 0.0) or 0.0)
+        if peak_tflops > 0:
+            peak, known = peak_tflops * 1e12, True
+        else:
+            peak, known = accounting.peak_flops_per_device(
+                getattr(jax.devices()[0], "device_kind", "")
+            )
+        trace_file = (
+            sinks_mod.trace_path(cfg.workdir)
+            if cfg.workdir
+            and getattr(cfg, "telemetry_trace", True)
+            and jax.process_index() == 0
+            else None
+        )
+        return cls(
+            sinks,
+            flops_per_step=flops,
+            peak_flops_total=peak * jax.device_count(),
+            peak_is_estimate=not known,
+            tokens_per_example=tokens,
+            trace_file=trace_file,
+            flush_every=getattr(cfg, "telemetry_flush_every", 1),
+        )
+
+    # ------------------------------------------------------------ intake
+
+    def span(self, name: str, **args):
+        return self.tracer.span(name, **args)
+
+    def note_steps(self, n: int) -> None:
+        """Count completed device steps (INCLUDING skipped bad steps and
+        rollback replays — goodput's denominator is total stepped work)."""
+        self.registry.counter("train/steps_total").inc(n)
+
+    def record_step_time(self, seconds: float, k: int = 1) -> None:
+        """One loop-iteration wall time; bundles amortize over k steps."""
+        self.registry.histogram("step_time").record(seconds / max(k, 1))
+
+    # ----------------------------------------------------------- windows
+
+    def _fit_counters(self) -> dict[str, int]:
+        """This fit's counters: deltas from the fit-start snapshot."""
+        base = self._counter_base
+        return {
+            k: max(v - base.get(k, 0), 0)
+            for k, v in self.registry.counter_values().items()
+        }
+
+    def _reduced_counters(self) -> dict[str, int]:
+        values = self._fit_counters()
+        import jax
+
+        if jax.process_count() == 1:
+            return values
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        vec = np.asarray(
+            [values.get(n, 0) for n in HOST_LOCAL_COUNTERS], np.int64
+        )
+        summed = multihost_utils.process_allgather(vec).sum(axis=0)
+        values.update(
+            {n: int(v) for n, v in zip(HOST_LOCAL_COUNTERS, summed)}
+        )
+        return values
+
+    def _derived(
+        self, window_metrics: Mapping[str, float], counters: Mapping[str, int]
+    ) -> dict:
+        steps_per_sec = window_metrics.get("steps_per_sec")
+        examples_per_sec = window_metrics.get("examples_per_sec")
+        # One summary() pass: a single lock acquisition + sort of the
+        # sample window, instead of one per percentile.
+        step_summary = self.registry.histogram("step_time").summary()
+        derived = {
+            "examples_per_sec": examples_per_sec,
+            "tokens_per_sec": (
+                examples_per_sec * self.tokens_per_example
+                if examples_per_sec is not None
+                and self.tokens_per_example > 1
+                else None
+            ),
+            "step_time_p50": step_summary["p50"],
+            "step_time_p95": step_summary["p95"],
+            "mfu": (
+                accounting.mfu(
+                    self.flops_per_step, steps_per_sec, self.peak_flops_total
+                )
+                if steps_per_sec is not None
+                else None
+            ),
+            "goodput": accounting.goodput(counters),
+        }
+        return derived
+
+    def log_window(
+        self,
+        step: int,
+        metrics: Mapping[str, float],
+        *,
+        prefix: str = "train",
+        kind: str = "window",
+        exit_reason: str | None = None,
+        reduce: bool = True,
+    ) -> dict:
+        """Emit one window line to every sink; returns the line.
+
+        ``reduce=False`` skips the cross-host counter reduction — REQUIRED
+        on abnormal-exit paths (preemption, abort), where peer processes
+        may never reach the matching collective and the reduction would
+        deadlock the dying process.
+        """
+        counters = (
+            self._reduced_counters() if reduce else self._fit_counters()
+        )
+        line = {
+            "schema_version": schema.SCHEMA_VERSION,
+            "kind": kind,
+            "step": int(step),
+            "time_unix": time.time(),
+            "session_start_unix": self._session_start,
+            "metrics": {
+                (f"{prefix}/{k}" if prefix else k): (
+                    float(v) if v is not None else None
+                )
+                for k, v in metrics.items()
+            },
+            "counters": counters,
+            "gauges": self.registry.gauge_values(),
+            "derived": self._derived(metrics, counters),
+        }
+        if kind == "final":
+            line["exit_reason"] = exit_reason or "complete"
+        self._last_step = int(step)
+        for sink in self.sinks:
+            try:
+                sink.write(line)
+            except Exception:
+                log.exception(
+                    "telemetry sink %s failed to write (continuing)",
+                    type(sink).__name__,
+                )
+        self._windows_since_flush += 1
+        if self._windows_since_flush >= self.flush_every:
+            self.flush()
+        return line
+
+    def final_window(
+        self,
+        step: int,
+        metrics: Mapping[str, float],
+        *,
+        prefix: str = "train",
+        exit_reason: str,
+    ) -> dict:
+        """The partial in-flight window on an exit path (no collective:
+        peers may already be gone)."""
+        return self.log_window(
+            step, metrics, prefix=prefix, kind="final",
+            exit_reason=exit_reason, reduce=False,
+        )
+
+    # ------------------------------------------------------------- flush
+
+    def flush(self) -> None:
+        self._windows_since_flush = 0
+        for sink in self.sinks:
+            try:
+                sink.flush()
+            except Exception:  # pragma: no cover - sink teardown races
+                log.exception("telemetry sink flush failed (continuing)")
+
+    def write_trace(self) -> None:
+        if self.trace_file:
+            try:
+                self.tracer.write_chrome_trace(self.trace_file)
+            except Exception:  # pragma: no cover - disk-full etc.
+                log.exception("chrome trace export failed (continuing)")
+
+    def emergency_flush(self) -> None:
+        """Watchdog-fatal path: called from the WATCHDOG thread right
+        before ``os._exit(87)`` while the main thread is wedged. Lands a
+        final marker line (local counters only — no collective, no loop
+        state: the partial window lives on the wedged thread), then
+        pushes the trace and sinks to disk. Must never block on the
+        main thread."""
+        try:
+            self.final_window(
+                self._last_step, {}, exit_reason="watchdog_fatal"
+            )
+        except Exception:  # pragma: no cover - dying anyway; best effort
+            log.exception("watchdog-fatal final line failed")
+        self.write_trace()
+        self.flush()
+
+    def close(self) -> None:
+        """Flush everything and write the trace; idempotent (the loop's
+        ``finally`` calls this after any earlier abnormal-exit flush)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.write_trace()
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # pragma: no cover - sink teardown races
+                log.exception("telemetry sink close failed (continuing)")

@@ -1,0 +1,116 @@
+"""Self-describing JSONL metrics schema (ISSUE 2 CI satellite).
+
+Every line the JSONL sink emits carries ``schema_version`` so offline
+consumers (tools/telemetry_report.py, future BENCH_* harvesters) can
+evolve without guessing. ``validate_line`` is the single source of truth
+for what a line must look like — the tier-1 test validates every emitted
+line through it, and the report CLI refuses lines it cannot validate
+rather than mis-aggregating them.
+
+Hand-rolled (no jsonschema dependency — the image is pip-install-free);
+the structure is small enough that explicit checks read better anyway.
+
+Line shape (version 1)::
+
+    {
+      "schema_version": 1,
+      "kind": "window" | "eval" | "final",
+      "step": <int >= 0>,            # loop step the line was emitted at
+      "time_unix": <float>,          # wall clock at emission
+      "session_start_unix": <float>, # constant per fit-session: the
+                                     #   boundary marker for resumed runs
+      "metrics": {"train/loss": 1.2, ...},      # window means
+      "counters": {"data/batches_fetched": 10, ...},  # cumulative
+                                     #   WITHIN the session (fit deltas)
+      "gauges": {...},                          # instantaneous values
+      "derived": {"examples_per_sec": ..., "step_time_p50": ...,
+                  "mfu": ..., "goodput": ...},  # may hold nulls
+      "exit_reason": "preempt" | ...  # kind == "final" only
+    }
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Any
+
+SCHEMA_VERSION = 1
+
+KINDS = ("window", "eval", "final")
+
+_REQUIRED = ("schema_version", "kind", "step", "time_unix",
+             "session_start_unix", "metrics", "counters", "gauges",
+             "derived")
+
+
+def _is_number(v: Any) -> bool:
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def validate_line(obj: Any) -> list[str]:
+    """Return the list of schema violations (empty = valid)."""
+    if not isinstance(obj, dict):
+        return [f"line is {type(obj).__name__}, not an object"]
+    problems = []
+    for key in _REQUIRED:
+        if key not in obj:
+            problems.append(f"missing required field {key!r}")
+    if problems:
+        return problems
+    if obj["schema_version"] != SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {obj['schema_version']!r} != {SCHEMA_VERSION}"
+        )
+    if obj["kind"] not in KINDS:
+        problems.append(f"kind {obj['kind']!r} not in {KINDS}")
+    if not isinstance(obj["step"], int) or isinstance(obj["step"], bool) \
+            or obj["step"] < 0:
+        problems.append(f"step {obj['step']!r} is not a non-negative int")
+    for key in ("time_unix", "session_start_unix"):
+        if not _is_number(obj[key]):
+            problems.append(f"{key} {obj[key]!r} is not a number")
+    for section in ("metrics", "gauges"):
+        sec = obj[section]
+        if not isinstance(sec, dict):
+            problems.append(f"{section} is not an object")
+            continue
+        for k, v in sec.items():
+            if not isinstance(k, str):
+                problems.append(f"{section} key {k!r} is not a string")
+            # NaN/Inf pass through json.dumps as bare tokens; numeric or
+            # null is the contract (a NaN loss window is still a number).
+            if v is not None and not _is_number(v):
+                problems.append(f"{section}[{k!r}] = {v!r} is not numeric")
+    counters = obj["counters"]
+    if not isinstance(counters, dict):
+        problems.append("counters is not an object")
+    else:
+        for k, v in counters.items():
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(
+                    f"counters[{k!r}] = {v!r} is not a non-negative int"
+                )
+    derived = obj["derived"]
+    if not isinstance(derived, dict):
+        problems.append("derived is not an object")
+    else:
+        for k, v in derived.items():
+            if v is not None and not _is_number(v):
+                problems.append(f"derived[{k!r}] = {v!r} is not numeric")
+    if obj["kind"] == "final" and not isinstance(
+        obj.get("exit_reason"), str
+    ):
+        problems.append("final line is missing a string exit_reason")
+    if obj["kind"] != "final" and "exit_reason" in obj:
+        problems.append("exit_reason on a non-final line")
+    return problems
+
+
+def validate(obj: Any) -> None:
+    """Raise ValueError listing every violation (empty = returns None)."""
+    problems = validate_line(obj)
+    if problems:
+        raise ValueError(
+            "telemetry line violates schema v%d:\n  %s"
+            % (SCHEMA_VERSION, "\n  ".join(problems))
+        )

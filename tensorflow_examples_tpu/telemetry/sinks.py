@@ -1,0 +1,171 @@
+"""Pluggable metric sinks (ISSUE 2 tentpole (c)).
+
+One ``Telemetry`` object fans each window line out to every configured
+sink (``TrainConfig.telemetry_sinks``):
+
+* ``jsonl``       — the always-on machine record: one schema-versioned
+                    line per log window appended to
+                    ``workdir/telemetry/metrics.jsonl``. Crash-safe by
+                    construction: append-only, flushed per write, so the
+                    file is valid up to the last completed line no
+                    matter how the process dies. Process 0 only.
+* ``tensorboard`` — the existing clu ``metric_writers`` path. Import or
+                    construction failure degrades to an explicit NULL
+                    writer with a ONE-TIME warning naming the failure
+                    (replacing train/loop.py's old silent
+                    ``except Exception: return None``).
+* ``console``     — the historical ``log.info("step N: {...}")`` line.
+
+Sinks receive the full schema line (telemetry/schema.py) and pick what
+they render; they must never raise into the training loop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+SINK_NAMES = ("jsonl", "tensorboard", "console")
+
+
+class Sink:
+    """Interface: write one schema line; flush/close are idempotent."""
+
+    def write(self, line: dict) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self.flush()
+
+
+class JsonlSink(Sink):
+    """Append-only JSONL, flushed per line (a crash loses at most the
+    line being written — never previously-written windows)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")  # noqa: SIM115 - outlives the call
+
+    def write(self, line: dict) -> None:
+        self._f.write(json.dumps(line) + "\n")
+        self._f.flush()
+
+    def flush(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:  # pragma: no cover - fs without fsync
+                pass
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self.flush()
+            self._f.close()
+
+
+class ConsoleSink(Sink):
+    """The historical human-readable log line, one per window."""
+
+    def write(self, line: dict) -> None:
+        shown = {k: round(v, 5) for k, v in line["metrics"].items()
+                 if v is not None}
+        log.info("step %d: %s", line["step"], shown)
+
+
+_tb_warned = False  # one-time per process: don't spam every window
+
+
+class TensorBoardSink(Sink):
+    """clu metric_writers, degrading to an explicit null writer.
+
+    The old ``Trainer._make_writer`` swallowed every exception silently
+    — a broken clu install meant a run with NO TensorBoard output and no
+    hint why. Here the failure is named once at WARNING and the sink
+    becomes an inert null writer, keeping the loop alive either way.
+    """
+
+    def __init__(self, workdir: str):
+        global _tb_warned
+        self._writer = None
+        try:
+            import jax
+            from clu import metric_writers
+
+            self._writer = metric_writers.create_default_writer(
+                workdir, just_logging=jax.process_index() != 0
+            )
+        except Exception as e:
+            if not _tb_warned:
+                _tb_warned = True
+                log.warning(
+                    "TensorBoard sink unavailable — falling back to a null "
+                    "writer (scalars will NOT reach TensorBoard). Cause: "
+                    "%s: %s",
+                    type(e).__name__,
+                    e,
+                )
+
+    def write(self, line: dict) -> None:
+        if self._writer is None:
+            return
+        scalars = {
+            k: v for k, v in line["metrics"].items() if v is not None
+        }
+        scalars.update(
+            {
+                f"telemetry/{k}": v
+                for k, v in line["derived"].items()
+                if v is not None
+            }
+        )
+        if scalars:
+            self._writer.write_scalars(line["step"], scalars)
+
+    def flush(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+
+
+def telemetry_dir(workdir: str) -> str:
+    return os.path.join(workdir, "telemetry")
+
+
+def metrics_path(workdir: str) -> str:
+    return os.path.join(telemetry_dir(workdir), "metrics.jsonl")
+
+
+def trace_path(workdir: str) -> str:
+    return os.path.join(telemetry_dir(workdir), "trace.json")
+
+
+def make_sinks(spec: str, workdir: str) -> list[Sink]:
+    """Build the sink list from the comma-separated config spec.
+
+    File-backed sinks need a workdir (and JSONL writes on process 0
+    only — every host logs the identical reduced window, so one file
+    is the record); without one, only ``console`` materializes.
+    """
+    import jax
+
+    sinks: list[Sink] = []
+    names = [s.strip() for s in (spec or "").split(",") if s.strip()]
+    for name in names:
+        if name not in SINK_NAMES:
+            raise ValueError(
+                f"unknown telemetry sink {name!r} (one of {SINK_NAMES})"
+            )
+        if name == "console":
+            sinks.append(ConsoleSink())
+        elif name == "jsonl" and workdir and jax.process_index() == 0:
+            sinks.append(JsonlSink(metrics_path(workdir)))
+        elif name == "tensorboard" and workdir:
+            sinks.append(TensorBoardSink(workdir))
+    return sinks

@@ -21,6 +21,9 @@ from typing import Any
 
 import orbax.checkpoint as ocp
 
+from tensorflow_examples_tpu.telemetry.registry import default_registry
+from tensorflow_examples_tpu.telemetry.spans import span as _trace_span
+
 log = logging.getLogger(__name__)
 
 
@@ -54,7 +57,12 @@ class CheckpointManager:
         return self._mngr.latest_step()
 
     def save(self, step: int, state: Any) -> None:
-        self._mngr.save(step, args=ocp.args.StandardSave(_as_dict(state)))
+        # The span covers the ENQUEUE only under async_save (orbax copies
+        # device->host then commits in the background); the commit wait
+        # shows up in whichever span wraps wait()/close().
+        with _trace_span("checkpoint_save", step=step):
+            self._mngr.save(step, args=ocp.args.StandardSave(_as_dict(state)))
+        default_registry().counter("checkpoint/saves").inc()
 
     def restore_latest(
         self, state: Any, *, validate: bool = True
@@ -63,11 +71,15 @@ class CheckpointManager:
         step = self._mngr.latest_step()
         if step is None:
             return None
-        target = _as_dict(state)
-        if validate:
-            self._validate_structure(step, target)
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(target))
-        merged = _merge_arrays(state, restored)
+        with _trace_span("checkpoint_restore", step=step):
+            target = _as_dict(state)
+            if validate:
+                self._validate_structure(step, target)
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(target)
+            )
+            merged = _merge_arrays(state, restored)
+        default_registry().counter("checkpoint/restores").inc()
         log.info("restored checkpoint at step %d", step)
         return merged, step
 

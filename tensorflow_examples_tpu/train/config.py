@@ -83,6 +83,21 @@ class TrainConfig:
     #   prefetch pipeline: corrupt host batches are skipped (and counted)
     #   up to this many times before the run errors out; 0 = fail fast
 
+    # Telemetry (tensorflow_examples_tpu/telemetry/; docs/observability.md)
+    telemetry_sinks: str = "jsonl,tensorboard,console"  # comma list of
+    #   metric sinks per log window: "jsonl" (schema-versioned
+    #   workdir/telemetry/metrics.jsonl, crash-safe append, process 0),
+    #   "tensorboard" (clu writer with explicit null-writer fallback),
+    #   "console" (the classic step log line). File sinks need --workdir.
+    telemetry_trace: bool = True  # export the host span timeline as
+    #   Chrome-trace JSON (workdir/telemetry/trace.json) on exit — load
+    #   in chrome://tracing or ui.perfetto.dev
+    telemetry_flush_every: int = 1  # flush sinks every N log windows
+    #   (1 = per window; the JSONL sink additionally flushes per line)
+    telemetry_peak_tflops: float = 0.0  # per-device peak TFLOP/s for the
+    #   MFU estimate; 0 = auto from the PJRT device kind (unknown kinds
+    #   fall back to a labeled 1 TFLOP/s so the pipeline stays live)
+
     def mesh_config(self) -> MeshConfig:
         return MeshConfig(
             data=self.mesh_data,

@@ -11,16 +11,27 @@ TPU-native re-design of the reference's per-example loop (SURVEY.md §3(1)):
   strategy.run(train_step) + NCCL    | ONE jax.jit program: fwd + bwd +
     all-reduce + optimizer.apply     |   XLA collectives + update, with
                                      |   donated state (no HBM copies)
-  tf.summary / CheckpointManager     | clu metric_writers / orbax async
+  tf.summary / CheckpointManager     | Telemetry sinks (JSONL + clu/
+                                     |   TensorBoard + console) / orbax
 
 The whole step — including the gradient all-reduce and optimizer — is a
 single XLA executable, so there is no per-op dispatch overhead and XLA
 overlaps the collectives with backward compute.
+
+Telemetry (ISSUE 2, docs/observability.md): each ``fit`` owns a
+``Telemetry`` object — span-traced loop phases (data_fetch /
+device_step / metric_flush / eval + checkpoint save/restore from the
+manager), a per-window schema-versioned JSONL line carrying the metrics
+registry's counters (resilience events, IO retries, batch skips) and
+derived accounting (examples/sec, step-time percentiles, 6ND MFU,
+goodput), flushed on EVERY exit path including preemption, bad-step
+abort, and the watchdog's fatal exit.
 """
 
 from __future__ import annotations
 
 import logging
+import sys
 import time
 from typing import Any, Callable, Iterable, Iterator, Mapping
 
@@ -44,6 +55,7 @@ from tensorflow_examples_tpu.data.prefetch import (
     device_prefetch,
     put_batch,
 )
+from tensorflow_examples_tpu.telemetry import Telemetry
 from tensorflow_examples_tpu.train import resilience
 from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
 from tensorflow_examples_tpu.train.config import TrainConfig
@@ -81,7 +93,7 @@ class Trainer:
         self.policy = PrecisionPolicy.create(config.precision)
         self._batch_sharding = batch_sharding(self.mesh)
         self._ckpt: CheckpointManager | None = None
-        self._writer = None
+        self._telemetry: Telemetry | None = None  # built per fit()
         self._guard: resilience.BadStepGuard | None = None
         self.state = self._init_state()
         self._train_step = self._build_train_step()
@@ -103,6 +115,7 @@ class Trainer:
             state = jax.jit(make_state, out_shardings=shardings)(rng)
         state = state.replace(apply_fn=None, tx=tx)
         n_params = sum(x.size for x in jax.tree.leaves(state.params))
+        self._n_params = n_params  # telemetry's 6ND MFU numerator
         log.info(
             "initialized %s: %.2fM params on mesh %s",
             self.task.name,
@@ -366,15 +379,25 @@ class Trainer:
         guard = resilience.BadStepGuard.from_config(cfg)
         self._guard = guard  # introspectable by tests/tools
 
+        # Telemetry next (an unknown sink name must also fail before any
+        # thread/handler exists); one object per fit — sinks may be
+        # workdir-backed and multiple fits on one Trainer are legal.
+        telemetry = Telemetry.from_config(cfg, n_params=self._n_params)
+        self._telemetry = telemetry
+        emit_final: Callable[..., None] | None = None  # bound in the try
+
         watchdog = None
         if cfg.watchdog_secs > 0 or cfg.watchdog_fatal_secs > 0:
             from tensorflow_examples_tpu.utils.diagnostics import Watchdog
 
             # Start paused: restore + first-step compile are legitimately
             # slow. Detection arms at the first completed step's ping.
+            # flush_fn: the fatal exit-87 path pushes sinks + trace to
+            # disk from the watchdog thread before os._exit.
             watchdog = Watchdog(
                 cfg.watchdog_secs or cfg.watchdog_fatal_secs,
                 fatal_timeout_s=cfg.watchdog_fatal_secs,
+                flush_fn=telemetry.emergency_flush,
             ).start()
             watchdog.pause()
 
@@ -391,7 +414,6 @@ class Trainer:
                     restored = self._ckpt.restore_latest(self.state)
                     if restored is not None:
                         self.state, start_step = restored[0], int(restored[1])
-                self._writer = _make_writer(cfg.workdir)
 
             k = max(int(getattr(cfg, "steps_per_launch", 1) or 1), 1)
             if k > 1:
@@ -447,7 +469,50 @@ class Trainer:
             window: list[Mapping[str, jax.Array]] = []
             last: dict[str, float] = {}
             t_window = time.perf_counter()
+            t_iter = t_window  # per-chunk wall clock -> step_time hist
             chunk = start_step
+
+            def window_means() -> dict[str, float]:
+                """Window-mean each metric. Bundled metrics are
+                [k]-vectors per key; scalars and vectors average
+                identically through ravel+concat. With the guard active,
+                means are over FINITE values only (a skipped bad step's
+                NaN loss must not poison the window); with the guard
+                OFF, a NaN window mean is the divergence signal — don't
+                mask it."""
+                if not window:
+                    return {}
+                mean_fn = (
+                    _finite_mean
+                    if guard is not None
+                    else lambda v: float(np.mean(v))
+                )
+                return {
+                    key: mean_fn(
+                        np.concatenate(
+                            [
+                                np.ravel(np.asarray(m[key], np.float32))
+                                for m in window
+                            ]
+                        )
+                    )
+                    for key in window[0]
+                }
+
+            def emit_final(reason: str, done_step: int | None = None) -> None:
+                """Exit marker + the partial in-flight window: every exit
+                path (normal, preempt, abort) lands a ``kind="final"``
+                JSONL line so the run's tail is never silently lost."""
+                if window:
+                    telemetry.note_steps(len(window) * k)
+                means = window_means()
+                window.clear()
+                telemetry.final_window(
+                    chunk if done_step is None else done_step,
+                    means,
+                    exit_reason=reason,
+                )
+                telemetry.flush()
             while True:
                 if guard is not None:
                     # Non-blocking: consumes only already-finished step
@@ -459,8 +524,13 @@ class Trainer:
                         chunk, train_iter = self._rollback_to_checkpoint(
                             guard, build_iter if resumable else None, train_iter
                         )
+                        # The discarded window's executions were real
+                        # work: they belong in goodput's denominator
+                        # (steps_lost carries the replay cost).
+                        telemetry.note_steps(len(window) * k)
                         window.clear()
                         t_window = time.perf_counter()
+                        t_iter = t_window
                         continue
                 if chunk >= num_steps:
                     break
@@ -495,14 +565,23 @@ class Trainer:
                         # legitimately compile-slow.
                         watchdog.enter("input_fetch")
                         watchdog.resume()
-                    batch = next(train_iter)
+                    with telemetry.span("data_fetch"):
+                        batch = next(train_iter)
                     if faults_engine is not None:
                         batch = faults_engine.nan_hook(chunk, k, batch)
                     if watchdog is not None:
                         watchdog.enter("device_step")
                         if not stepped_once:
                             watchdog.pause()  # first step pays jit compile
-                    self.state, metrics = step_fn(self.state, batch)
+                    with telemetry.span("device_step"):
+                        self.state, metrics = step_fn(self.state, batch)
+                # Host-observed chunk time into the step_time histogram
+                # (p50/p95 in every window). Steady state is accurate —
+                # the prefetch queue back-pressures the host to device
+                # speed; the first chunk (jit compile) is excluded.
+                now = time.perf_counter()
+                if stepped_once:
+                    telemetry.record_step_time(now - t_iter, k)
                 stepped_once = True
                 if watchdog is not None:
                     # Dispatch is async; sync points (log flushes) bound
@@ -529,38 +608,21 @@ class Trainer:
                         # here. Size watchdog(_fatal)_secs above the
                         # worst-case log window.
                         watchdog.enter("log_flush")
-                    jax.block_until_ready(metrics)
-                    dt = time.perf_counter() - t_window
-                    # Bundled metrics are [k]-vectors per key; scalars and
-                    # vectors average identically through ravel+concat.
-                    # With the guard active, means are over FINITE values
-                    # only (a skipped bad step's NaN loss must not poison
-                    # the window); with the guard OFF, a NaN window mean
-                    # is the divergence signal — don't mask it.
-                    mean_fn = (
-                        _finite_mean
-                        if guard is not None
-                        else lambda v: float(np.mean(v))
-                    )
-                    last = {
-                        key: mean_fn(
-                            np.concatenate(
-                                [
-                                    np.ravel(np.asarray(m[key], np.float32))
-                                    for m in window
-                                ]
-                            )
+                    # The span covers the device-work wait AND the sink
+                    # writes: both are "time not spent stepping".
+                    with telemetry.span("metric_flush"):
+                        jax.block_until_ready(metrics)
+                        dt = time.perf_counter() - t_window
+                        last = window_means()
+                        steps_done = len(window) * k
+                        last["steps_per_sec"] = steps_done / dt
+                        last["examples_per_sec"] = (
+                            steps_done * cfg.global_batch_size / dt
                         )
-                        for key in window[0]
-                    }
-                    steps_done = len(window) * k
-                    last["steps_per_sec"] = steps_done / dt
-                    last["examples_per_sec"] = (
-                        steps_done * cfg.global_batch_size / dt
-                    )
-                    window.clear()
-                    t_window = time.perf_counter()
-                    _log_metrics(self._writer, step + 1, last, prefix="train")
+                        window.clear()
+                        t_window = time.perf_counter()
+                        telemetry.note_steps(steps_done)
+                        telemetry.log_window(step + 1, last, prefix="train")
 
                 if preempt is not None and preempt.requested:
                     # Checked BEFORE the periodic eval: a pending SIGTERM
@@ -568,7 +630,7 @@ class Trainer:
                     # a full evaluation before the checkpoint lands.
                     if profiling:
                         jax.profiler.stop_trace()
-                    self._preempt_exit(step + 1, preempt, watchdog)
+                    self._preempt_exit(step + 1, preempt, watchdog, emit_final)
 
                 evaluated_now = False
                 if (
@@ -578,13 +640,14 @@ class Trainer:
                 ):
                     if watchdog is not None:
                         watchdog.pause()  # eval length ≠ step cadence
-                    eval_metrics = self.evaluate(
-                        eval_iter_fn(), per_host=eval_per_host
-                    )
+                    with telemetry.span("eval"):
+                        eval_metrics = self.evaluate(
+                            eval_iter_fn(), per_host=eval_per_host
+                        )
                     if watchdog is not None:
                         watchdog.resume()
-                    _log_metrics(
-                        self._writer, step + 1, eval_metrics, prefix="eval"
+                    telemetry.log_window(
+                        step + 1, eval_metrics, prefix="eval", kind="eval"
                     )
                     evaluated_now = step + 1 == num_steps
                     if evaluated_now:
@@ -609,8 +672,11 @@ class Trainer:
                 if preempt is not None and preempt.requested:
                     if profiling:
                         jax.profiler.stop_trace()
-                    self._preempt_exit(step + 1, preempt, watchdog)
+                    self._preempt_exit(step + 1, preempt, watchdog, emit_final)
                 chunk += k
+                # Step-time clock excludes this chunk's cadence work
+                # (flush/eval/checkpoint have their own spans).
+                t_iter = time.perf_counter()
 
             if profiling:
                 jax.profiler.stop_trace()
@@ -620,20 +686,18 @@ class Trainer:
                 # Signal arrived between the last chunk's check and here:
                 # skip the final eval (the scheduler's grace window is
                 # ticking), checkpoint, and exit cleanly.
-                self._preempt_exit(num_steps, preempt, watchdog)
+                self._preempt_exit(num_steps, preempt, watchdog, emit_final)
             if eval_iter_fn is not None and not evaluated_now:
-                last.update(
-                    {
-                        f"eval_{k}": v
-                        for k, v in self.evaluate(
-                            eval_iter_fn(), per_host=eval_per_host
-                        ).items()
-                    }
-                )
+                with telemetry.span("eval"):
+                    final_eval = self.evaluate(
+                        eval_iter_fn(), per_host=eval_per_host
+                    )
+                last.update({f"eval_{k}": v for k, v in final_eval.items()})
             if self._ckpt and self._ckpt.latest_step() != num_steps:
                 self._ckpt.save(num_steps, self.state)
-            if self._writer:
-                self._writer.flush()
+            # Normal-completion exit marker: the JSONL tail says the run
+            # ENDED (vs. died between windows) and carries final counters.
+            emit_final("complete", num_steps)
             return last
         finally:
             # Crash-safe teardown (ISSUE 1 satellite): the checkpoint
@@ -648,14 +712,38 @@ class Trainer:
                 watchdog.stop()
             if preempt is not None:
                 preempt.uninstall()
+            # Telemetry teardown (ISSUE 2 satellite): an exception that
+            # is not the (already-emitted) preemption still lands a
+            # final JSONL line — bad-step aborts included — then sinks
+            # close and the span timeline is written. ``emit_final`` is
+            # None if the failure happened before the loop was set up.
+            try:
+                exc = sys.exc_info()[1]
+                if (
+                    exc is not None
+                    and not isinstance(exc, resilience.Preempted)
+                    and emit_final is not None
+                ):
+                    emit_final(f"error:{type(exc).__name__}")
+            except Exception:  # pragma: no cover - telemetry best effort
+                log.exception("final telemetry window failed")
+            telemetry.close()
             if self._ckpt is not None:
                 try:
                     self._ckpt.close()
                 finally:
                     self._ckpt = None
 
-    def _preempt_exit(self, done_step: int, preempt, watchdog) -> None:
-        """Synchronous checkpoint + clean exit at a step boundary."""
+    def _preempt_exit(
+        self, done_step: int, preempt, watchdog, final_emit=None
+    ) -> None:
+        """Synchronous checkpoint + clean exit at a step boundary.
+
+        The checkpoint lands FIRST (the scheduler's kill grace window is
+        ticking and the checkpoint is the thing that must survive), then
+        telemetry emits the partial window as a ``kind="final"`` line
+        with ``exit_reason="preempt"`` and flushes every sink.
+        """
         if watchdog is not None:
             watchdog.pause()
         if self._ckpt is not None:
@@ -676,8 +764,12 @@ class Trainer:
                 "checkpoint; exiting cleanly",
                 done_step,
             )
-        if self._writer:
-            self._writer.flush()
+        if self._telemetry is not None:
+            # Counted here, NOT in the signal handler (a locked counter
+            # inside a handler can deadlock the interrupted main thread).
+            self._telemetry.registry.counter("resilience/preemptions").inc()
+        if final_emit is not None:
+            final_emit("preempt", done_step)
         raise resilience.Preempted(done_step, preempt.signum)
 
     def _rollback_to_checkpoint(self, guard, build_iter, train_iter):
@@ -837,19 +929,3 @@ def _finite_mean(vals: np.ndarray) -> float:
     return float(np.mean(finite)) if finite.size else float("nan")
 
 
-def _make_writer(workdir: str):
-    try:
-        from clu import metric_writers
-
-        return metric_writers.create_default_writer(
-            workdir, just_logging=jax.process_index() != 0
-        )
-    except Exception:  # pragma: no cover - clu is installed, but stay safe
-        return None
-
-
-def _log_metrics(writer, step: int, metrics: Mapping[str, float], prefix=""):
-    scalars = {f"{prefix}/{k}" if prefix else k: v for k, v in metrics.items()}
-    if writer is not None:
-        writer.write_scalars(step, scalars)
-    log.info("step %d: %s", step, {k: round(v, 5) for k, v in scalars.items()})

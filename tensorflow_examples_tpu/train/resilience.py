@@ -35,6 +35,15 @@ The failure modes this handles are the pod-scale routine ones:
 
 Watchdog / hung-step handling lives in utils/diagnostics.py; IO retry
 and fault injection in utils/faults.py.
+
+Telemetry (ISSUE 2): the guard publishes its formerly write-only
+counts into the default metrics registry — ``resilience/bad_steps``,
+``resilience/rollbacks``, and ``resilience/steps_lost`` (replayed work,
+goodput's loss term) — so they appear in every JSONL window and the run
+report. ``resilience/preemptions`` is counted by the training loop's
+preempt-exit path, NOT in the signal handler: incrementing a locked
+counter from a handler could deadlock against a main thread interrupted
+while holding the registry lock.
 """
 
 from __future__ import annotations
@@ -46,6 +55,8 @@ import threading
 from typing import Any
 
 import numpy as np
+
+from tensorflow_examples_tpu.telemetry.registry import default_registry
 
 log = logging.getLogger(__name__)
 
@@ -230,6 +241,17 @@ class BadStepGuard:
             )
         self._last_rollback_step = restored_step
         self.rollbacks += 1
+        default_registry().counter("resilience/rollbacks").inc()
+        # Replayed work = steps past the restored checkpoint that now run
+        # twice; the last observed bad step bounds how far we had gotten.
+        # The consecutive bad steps inside that span are already debited
+        # via resilience/bad_steps — subtract them so goodput's loss
+        # terms don't overlap (earlier non-consecutive bad steps in the
+        # span are a tolerated approximation).
+        if self._last_bad is not None:
+            lost = self._last_bad[0] - restored_step - self._consecutive
+            if lost > 0:
+                default_registry().counter("resilience/steps_lost").inc(lost)
         self.reset()
 
     def status(self) -> str:
@@ -262,6 +284,7 @@ class BadStepGuard:
                 is_bad = lv > self.spike_factor * max(abs(self._ema), 1e-8)
             if is_bad:
                 self.bad_steps_seen += 1
+                default_registry().counter("resilience/bad_steps").inc()
                 self._consecutive += 1
                 self._last_bad = (step, float(lv))
                 if self.policy == "abort":

@@ -97,12 +97,18 @@ class Watchdog:
         fatal_timeout_s: float = 0.0,
         on_hang: Callable[[int, float], None] | None = None,
         on_fatal: Callable[[int, float], None] | None = None,
+        flush_fn: Callable[[], None] | None = None,
         poll_s: float | None = None,
     ):
         self.timeout_s = timeout_s
         self.fatal_timeout_s = fatal_timeout_s
         self._on_hang = on_hang
         self._on_fatal = on_fatal
+        # Best-effort pre-exit flush (telemetry sinks + trace): runs on
+        # the fatal path BEFORE on_fatal/os._exit, from the watchdog
+        # thread, so the run's metrics survive the hard exit (ISSUE 2
+        # abnormal-exit satellite).
+        self._flush_fn = flush_fn
         self._poll_s = poll_s if poll_s is not None else min(timeout_s / 4, 30.0)
         if fatal_timeout_s > 0:
             self._poll_s = min(self._poll_s, max(fatal_timeout_s / 4, 0.05))
@@ -153,14 +159,27 @@ class Watchdog:
             self._thread.join(timeout=5)
 
     def _dump(self, stalled: float, *, fatal: bool) -> None:
+        # Name the innermost open telemetry span(s), not just the coarse
+        # phase marker: "phase 'input_fetch', open spans ['data_fetch']"
+        # tells you which instrumented region actually wedged.
+        try:
+            from tensorflow_examples_tpu.telemetry.spans import (
+                active_span_names,
+            )
+
+            open_spans = active_span_names()
+        except Exception:  # pragma: no cover - telemetry unavailable
+            open_spans = []
         log.error(
             "WATCHDOG%s: no training progress for %.1fs (last step %d, "
-            "phase %r for %.1fs) — dumping all thread stacks",
+            "phase %r for %.1fs, open spans %s) — dumping all thread "
+            "stacks",
             " FATAL" if fatal else "",
             stalled,
             self._last_step,
             self._phase,
             time.monotonic() - self._phase_since,
+            open_spans,
         )
         faulthandler.dump_traceback(file=sys.stderr)
         if _fault_file is not None:
@@ -192,6 +211,11 @@ class Watchdog:
             if fatal_now:
                 fatal_fired = True
                 self._dump(stalled, fatal=True)
+                if self._flush_fn is not None:
+                    try:
+                        self._flush_fn()
+                    except Exception:  # pragma: no cover - best effort
+                        log.exception("pre-exit telemetry flush failed")
                 if self._on_fatal is not None:
                     self._on_fatal(self._last_step, stalled)
                 else:

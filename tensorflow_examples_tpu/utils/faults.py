@@ -280,6 +280,14 @@ def retry_io(
                 raise  # corrupt data, not a transient store fault
             if attempt >= attempts:
                 raise
+            # Surface the formerly write-only retry in the metrics
+            # registry (ISSUE 2): flaky-store churn belongs in the run
+            # report, not just interleaved WARNING lines.
+            from tensorflow_examples_tpu.telemetry.registry import (
+                default_registry,
+            )
+
+            default_registry().counter("io/retries").inc()
             delay = backoff * (2**attempt)
             log.warning(
                 "io error on %s (attempt %d/%d), retrying in %.2fs: %s",

@@ -260,6 +260,9 @@ def test_preempt_without_workdir_still_exits_cleanly(faults, devices):
 def test_nan_skip_policy(faults, devices):
     """An injected NaN batch is skipped ON DEVICE: params stay finite,
     training continues, and the bad step is counted."""
+    from tensorflow_examples_tpu.telemetry.registry import default_registry
+
+    before = default_registry().counter_values().get("resilience/bad_steps", 0)
     cfg = tiny_cfg(train_steps=10, bad_step_policy="skip")
     trainer = Trainer(mnist.make_task(cfg), cfg)
     faults("nan@3")
@@ -270,6 +273,10 @@ def test_nan_skip_policy(faults, devices):
     assert np.isfinite(metrics["loss"])  # finite-mean excludes the NaN step
     assert trainer._guard.bad_steps_seen == 1
     assert metrics["bad_step"] > 0
+    # ISSUE 2: the skip is no longer write-only — it reaches the
+    # telemetry registry (cumulative across the process, hence delta).
+    after = default_registry().counter_values()["resilience/bad_steps"]
+    assert after - before == 1
 
 
 @pytest.mark.timeout(300)

@@ -512,6 +512,135 @@ class TestKernelSourceHash:
         assert kernel_source_hash() == kernel_source_hash()
 
 
+class TestTelemetryReport:
+    """tools/telemetry_report.py smoke (ISSUE 2 satellite): a run dir's
+    JSONL + trace turn into the human summary and the machine record."""
+
+    def _run_dir(self, tmp_path):
+        """Handcraft a schema-valid run dir (no training needed)."""
+        tdir = tmp_path / "telemetry"
+        tdir.mkdir()
+        base = {
+            "schema_version": 1, "session_start_unix": 99.0, "gauges": {
+                "telemetry/flops_per_step": 1e9,
+                "telemetry/peak_flops_total": 1e12,
+                "telemetry/peak_is_estimate": 0.0,
+            },
+        }
+        lines = [
+            dict(base, kind="window", step=10, time_unix=100.0,
+                 metrics={"train/loss": 2.0},
+                 counters={"train/steps_total": 10,
+                           "data/batches_fetched": 10},
+                 derived={"examples_per_sec": 640.0,
+                          "tokens_per_sec": None,
+                          "step_time_p50": 0.010, "step_time_p95": 0.020,
+                          "mfu": 0.01, "goodput": 1.0}),
+            dict(base, kind="window", step=20, time_unix=101.0,
+                 metrics={"train/loss": 1.0},
+                 counters={"train/steps_total": 20,
+                           "data/batches_fetched": 20,
+                           "resilience/bad_steps": 2},
+                 derived={"examples_per_sec": 660.0,
+                          "tokens_per_sec": None,
+                          "step_time_p50": 0.011, "step_time_p95": 0.021,
+                          "mfu": 0.011, "goodput": 0.9}),
+            dict(base, kind="final", step=20, time_unix=101.5, metrics={},
+                 counters={"train/steps_total": 20,
+                           "data/batches_fetched": 20,
+                           "resilience/bad_steps": 2,
+                           "checkpoint/saves": 1},
+                 derived={"examples_per_sec": None, "tokens_per_sec": None,
+                          "step_time_p50": 0.011, "step_time_p95": 0.021,
+                          "mfu": None, "goodput": 0.9},
+                 exit_reason="complete"),
+        ]
+        with open(tdir / "metrics.jsonl", "w") as f:
+            f.write("\n".join(json.dumps(l) for l in lines) + "\n")
+            f.write("{torn tail never valid json\n")  # must be skipped
+        with open(tdir / "trace.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "device_step", "ph": "X", "ts": 0.0, "dur": 9000.0,
+                 "pid": 0, "tid": 0},
+                {"name": "data_fetch", "ph": "X", "ts": 0.0, "dur": 1000.0,
+                 "pid": 0, "tid": 0},
+            ]}, f)
+        return tmp_path
+
+    def test_summary_and_json_record(self, tmp_path, capsys):
+        import telemetry_report
+
+        wd = self._run_dir(tmp_path)
+        out_json = tmp_path / "report.json"
+        rc = telemetry_report.main([str(wd), "--json", str(out_json)])
+        stdout = capsys.readouterr().out
+        assert rc == 0, stdout
+        # The acceptance quartet, human-readable:
+        assert "examples/sec" in stdout
+        assert "p50" in stdout and "p95" in stdout
+        assert "mfu estimate" in stdout
+        assert "goodput: 90.00%" in stdout
+        assert "ended: complete" in stdout
+        assert "skipped 1 line" in stdout  # torn tail counted loudly
+        assert "device_step" in stdout  # trace phase breakdown
+        rec = json.load(open(out_json))
+        assert rec["examples_per_sec_last"] == 660.0
+        assert rec["examples_per_sec_mean"] == 650.0
+        assert rec["step_time_p50"] == 0.011
+        assert rec["mfu"] == 0.011
+        assert rec["mfu_peak_is_estimate"] is False
+        assert rec["goodput"] == 0.9
+        assert rec["exit_reason"] == "complete"
+        assert rec["trace_phases"]["device_step"]["total_ms"] == 9.0
+
+    def test_missing_run_dir_exits_1(self, tmp_path, capsys):
+        import telemetry_report
+
+        assert telemetry_report.main([str(tmp_path / "nope")]) == 1
+        assert "no telemetry found" in capsys.readouterr().err
+
+    def test_preempt_resume_sessions_aggregated(self, tmp_path, capsys):
+        """Counters are cumulative PER PROCESS: a preempted-then-resumed
+        run's report must sum the sessions, not read only the last
+        line (which would hide session 1's preemption entirely)."""
+        import telemetry_report
+
+        tdir = tmp_path / "telemetry"
+        tdir.mkdir()
+        base = {"schema_version": 1, "gauges": {}, "metrics": {},
+                "derived": {"examples_per_sec": None,
+                            "tokens_per_sec": None, "step_time_p50": 0.01,
+                            "step_time_p95": 0.02, "mfu": None,
+                            "goodput": None}}
+        lines = [
+            # session 1: preempted at step 50, 2 bad steps
+            dict(base, kind="final", step=50, time_unix=100.0,
+                 session_start_unix=90.0,
+                 counters={"train/steps_total": 50,
+                           "resilience/bad_steps": 2,
+                           "resilience/preemptions": 1},
+                 exit_reason="preempt"),
+            # session 2: fresh process, counters restart, completes
+            dict(base, kind="final", step=100, time_unix=200.0,
+                 session_start_unix=190.0,
+                 counters={"train/steps_total": 50,
+                           "checkpoint/restores": 1},
+                 exit_reason="complete"),
+        ]
+        with open(tdir / "metrics.jsonl", "w") as f:
+            f.write("\n".join(json.dumps(l) for l in lines) + "\n")
+        assert telemetry_report.main([str(tmp_path), "--json", "-"]) == 0
+        out = capsys.readouterr().out
+        rec = json.loads(out[out.index("{"):])  # summary carries no braces
+        assert rec["sessions"] == 2
+        assert rec["counters"]["train/steps_total"] == 100
+        assert rec["counters"]["resilience/preemptions"] == 1
+        assert rec["counters"]["resilience/bad_steps"] == 2
+        assert rec["goodput"] == pytest.approx(0.98)  # 98/100 across both
+        assert "in 2 session(s)" in out
+        assert "preemptions=1" in out
+
+
 def test_readme_test_count_is_current():
     """README's `tests/` line states the suite size; keep it honest
     mechanically (VERDICT r4 weak #6) by comparing against pytest's own

@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Turn a run dir's telemetry into a human summary + machine JSON.
+
+    python tools/telemetry_report.py /path/to/workdir
+    python tools/telemetry_report.py /path/to/workdir --json report.json
+
+Reads ``<workdir>/telemetry/metrics.jsonl`` (the schema-versioned JSONL
+the trainer's Telemetry writes every log window — see
+docs/observability.md) and, when present, ``trace.json`` (the Chrome
+span timeline), and prints:
+
+* run shape: steps covered, windows, wall span, how the run ended
+  (the ``kind="final"`` line's exit_reason — "complete" vs. "preempt"
+  vs. "error:...")
+* throughput: examples/sec (mean of windows + last window), tokens/sec
+  for token workloads
+* step time: p50 / p95 (+ mean) from the step_time histogram
+* MFU estimate: 6ND model FLOPs over the device peak (flagged when the
+  peak was a fallback guess, e.g. CPU smoke runs)
+* goodput + the resilience/IO counters behind it (bad steps, rollbacks,
+  steps lost, preemptions, batch skips, IO retries)
+* per-phase host time from the trace (where the loop's wall time went)
+
+``--json`` additionally writes one machine-readable record with the
+same numbers — shaped for dropping into future BENCH_*.json entries.
+
+Lines that fail schema validation are skipped LOUDLY (counted +
+reported): a half-written crash tail must not silently skew the
+aggregates. Exit code 1 if no valid telemetry is found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflow_examples_tpu.telemetry import accounting, schema  # noqa: E402
+
+
+def load_lines(path: str) -> tuple[list[dict], int]:
+    """(valid schema lines, invalid-line count) from a metrics JSONL."""
+    valid, bad = [], 0
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if schema.validate_line(obj):
+                bad += 1
+                continue
+            valid.append(obj)
+    return valid, bad
+
+
+def _mean(vals: list[float]) -> float | None:
+    vals = [v for v in vals if v is not None]
+    return sum(vals) / len(vals) if vals else None
+
+
+def _is_session_boundary(prev: dict, line: dict) -> bool:
+    """Did a new fit-session start between these adjacent lines?
+
+    Primary signal: ``session_start_unix`` changing — every line carries
+    its session's id, exact even across SIGKILLs. Fallbacks for lines
+    predating the field: a ``kind="final"`` line ends its session, and
+    any per-key counter decrease means a fresh process restarted at 0.
+    """
+    a = prev.get("session_start_unix")
+    b = line.get("session_start_unix")
+    if a is not None and b is not None:
+        return a != b
+    if prev["kind"] == "final":
+        return True
+    return any(
+        line["counters"].get(k, 0) < v for k, v in prev["counters"].items()
+    )
+
+
+def _split_sessions(lines: list[dict]) -> list[list[dict]]:
+    """Split the JSONL into fit sessions (counters restart per session;
+    a preempted-then-resumed run appends several to one file)."""
+    sessions: list[list[dict]] = []
+    cur: list[dict] = []
+    for line in lines:
+        if cur and _is_session_boundary(cur[-1], line):
+            sessions.append(cur)
+            cur = []
+        cur.append(line)
+    if cur:
+        sessions.append(cur)
+    return sessions
+
+
+def _aggregate_counters(sessions: list[list[dict]]) -> dict[str, int]:
+    """Whole-run counters: sum each session's last (= highest) values —
+    the per-session counters are cumulative, so the last line carries
+    the session total."""
+    totals: dict[str, int] = {}
+    for sess in sessions:
+        for k, v in sess[-1]["counters"].items():
+            totals[k] = totals.get(k, 0) + v
+    return totals
+
+
+def summarize(lines: list[dict], trace: dict | None) -> dict:
+    """Aggregate validated lines (+ optional trace) into one record."""
+    windows = [l for l in lines if l["kind"] == "window"]
+    evals = [l for l in lines if l["kind"] == "eval"]
+    finals = [l for l in lines if l["kind"] == "final"]
+    last = lines[-1]
+    sessions = _split_sessions(lines)
+    counters = _aggregate_counters(sessions)
+    gauges = last["gauges"]
+    # The freshest derived block that actually has throughput: final
+    # lines often carry an empty partial window (derived nulls).
+    derived = {}
+    for l in reversed(lines):
+        if l["derived"].get("examples_per_sec") is not None:
+            derived = l["derived"]
+            break
+    else:
+        derived = last["derived"]
+
+    record = {
+        "schema_version": schema.SCHEMA_VERSION,
+        "windows": len(windows),
+        "eval_windows": len(evals),
+        "sessions": len(sessions),
+        "first_step": lines[0]["step"],
+        "last_step": last["step"],
+        "wall_span_secs": last["time_unix"] - lines[0]["time_unix"],
+        "exit_reason": finals[-1]["exit_reason"] if finals else None,
+        "examples_per_sec_mean": _mean(
+            [w["derived"].get("examples_per_sec") for w in windows]
+        ),
+        "examples_per_sec_last": derived.get("examples_per_sec"),
+        "tokens_per_sec_last": derived.get("tokens_per_sec"),
+        "step_time_p50": last["derived"].get("step_time_p50"),
+        "step_time_p95": last["derived"].get("step_time_p95"),
+        "mfu": derived.get("mfu"),
+        "mfu_peak_is_estimate": bool(
+            gauges.get("telemetry/peak_is_estimate", 1.0)
+        ),
+        # Whole-run goodput from the cross-session counter totals (a
+        # single line's goodput only covers its own process session).
+        "goodput": accounting.goodput(counters),
+        "counters": counters,
+        "flops_per_step": gauges.get("telemetry/flops_per_step"),
+        "peak_flops_total": gauges.get("telemetry/peak_flops_total"),
+    }
+    if trace is not None:
+        phases: dict[str, dict] = {}
+        for ev in trace.get("traceEvents", []):
+            p = phases.setdefault(ev["name"], {"count": 0, "total_ms": 0.0})
+            p["count"] += 1
+            p["total_ms"] += ev.get("dur", 0.0) / 1e3
+        record["trace_phases"] = {
+            name: {"count": p["count"], "total_ms": round(p["total_ms"], 3)}
+            for name, p in sorted(
+                phases.items(), key=lambda kv: -kv[1]["total_ms"]
+            )
+        }
+        if trace.get("droppedEventCount"):
+            record["trace_dropped_events"] = trace["droppedEventCount"]
+    return record
+
+
+def _fmt(v, unit="", nd=2) -> str:
+    if v is None:
+        return "n/a"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}{unit}"
+    return f"{v}{unit}"
+
+
+def render(record: dict, skipped: int) -> str:
+    out = []
+    out.append("== telemetry report ==")
+    out.append(
+        f"run: steps {record['first_step']}..{record['last_step']} over "
+        f"{record['windows']} window(s) + {record['eval_windows']} eval "
+        f"in {record['sessions']} session(s), "
+        f"{_fmt(record['wall_span_secs'], 's')} wall; "
+        f"ended: {record['exit_reason'] or 'UNKNOWN (no final line)'}"
+    )
+    out.append(
+        f"throughput: {_fmt(record['examples_per_sec_mean'])} examples/sec "
+        f"mean ({_fmt(record['examples_per_sec_last'])} last window)"
+        + (
+            f", {_fmt(record['tokens_per_sec_last'])} tokens/sec"
+            if record["tokens_per_sec_last"] is not None
+            else ""
+        )
+    )
+    p50, p95 = record["step_time_p50"], record["step_time_p95"]
+    out.append(
+        "step time: p50 "
+        + _fmt(p50 * 1e3 if p50 is not None else None, "ms")
+        + " / p95 "
+        + _fmt(p95 * 1e3 if p95 is not None else None, "ms")
+    )
+    mfu = record["mfu"]
+    out.append(
+        "mfu estimate: "
+        + (_fmt(mfu * 100, "%", nd=4) if mfu is not None else "n/a")
+        + (
+            " (peak FLOPs GUESSED — unknown device kind; set "
+            "--telemetry_peak_tflops for a real estimate)"
+            if record["mfu_peak_is_estimate"]
+            else ""
+        )
+    )
+    gp = record["goodput"]
+    c = record["counters"]
+    out.append(
+        "goodput: "
+        + (_fmt(gp * 100, "%", nd=2) if gp is not None else "n/a")
+        + f" of {c.get('train/steps_total', 0)} stepped "
+        + f"(bad={c.get('resilience/bad_steps', 0)} "
+        + f"lost={c.get('resilience/steps_lost', 0)} "
+        + f"rollbacks={c.get('resilience/rollbacks', 0)} "
+        + f"preemptions={c.get('resilience/preemptions', 0)})"
+    )
+    out.append(
+        f"input: {c.get('data/batches_fetched', 0)} batches fetched, "
+        f"{c.get('data/batches_skipped', 0)} skipped poisoned, "
+        f"{c.get('io/retries', 0)} io retries; checkpoints: "
+        f"{c.get('checkpoint/saves', 0)} saved / "
+        f"{c.get('checkpoint/restores', 0)} restored"
+    )
+    if "trace_phases" in record:
+        out.append("host time by span (from trace.json):")
+        for name, p in record["trace_phases"].items():
+            out.append(
+                f"  {name:<20} {p['total_ms']:>12,.1f}ms  x{p['count']}"
+            )
+    if skipped:
+        out.append(
+            f"WARNING: skipped {skipped} line(s) that failed schema "
+            "validation (torn tail or version drift)"
+        )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "workdir",
+        help="run dir (containing telemetry/metrics.jsonl), the telemetry "
+        "dir itself, or a metrics.jsonl path",
+    )
+    ap.add_argument(
+        "--json",
+        metavar="PATH",
+        help="also write the machine-readable record here ('-' = stdout)",
+    )
+    args = ap.parse_args(argv)
+
+    cand = [
+        args.workdir,
+        os.path.join(args.workdir, "metrics.jsonl"),
+        os.path.join(args.workdir, "telemetry", "metrics.jsonl"),
+    ]
+    path = next((p for p in cand if os.path.isfile(p)), None)
+    if path is None:
+        print(
+            f"no telemetry found under {args.workdir!r} (looked for "
+            "telemetry/metrics.jsonl — was the run started with "
+            "--workdir and the jsonl sink enabled?)",
+            file=sys.stderr,
+        )
+        return 1
+    lines, skipped = load_lines(path)
+    if not lines:
+        print(
+            f"{path}: no valid schema-v{schema.SCHEMA_VERSION} lines "
+            f"({skipped} invalid)",
+            file=sys.stderr,
+        )
+        return 1
+    trace_file = os.path.join(os.path.dirname(path), "trace.json")
+    trace = None
+    if os.path.isfile(trace_file):
+        try:
+            with open(trace_file) as f:
+                trace = json.load(f)
+        except json.JSONDecodeError:
+            print(f"WARNING: unreadable trace {trace_file}", file=sys.stderr)
+    record = summarize(lines, trace)
+    print(render(record, skipped))
+    if args.json:
+        payload = json.dumps(record, indent=2) + "\n"
+        if args.json == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

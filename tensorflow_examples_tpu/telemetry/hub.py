@@ -63,6 +63,7 @@ class Telemetry:
         tokens_per_example: int = 1,
         trace_file: str | None = None,
         flush_every: int = 1,
+        memory=None,
     ):
         self.sinks = sinks
         self.registry = (
@@ -79,6 +80,17 @@ class Telemetry:
         self.tokens_per_example = max(int(tokens_per_example), 1)
         self.trace_file = trace_file
         self.flush_every = max(int(flush_every), 1)
+        # Device-side observability (ISSUE 3): the per-fit memory
+        # monitor (None = no memory fields on lines) and the profiler-
+        # window cross-link carried on the final line.
+        self.memory = memory
+        self.profile_info: dict | None = None
+        # Observed duty cycle is PER FIT (set by this fit's profiler
+        # window, never read from the process-global gauge: a later fit
+        # in the same process must not inherit an earlier fit's
+        # measurement as its own).
+        self.observed_duty_cycle: float | None = None
+        self._emergency = False  # watchdog-fatal: cached-only sampling
         self._windows_since_flush = 0
         self._last_step = 0  # most recent log_window step (fatal marker)
         self._closed = False
@@ -130,6 +142,8 @@ class Telemetry:
             and jax.process_index() == 0
             else None
         )
+        from tensorflow_examples_tpu.telemetry import memory as memory_mod
+
         return cls(
             sinks,
             flops_per_step=flops,
@@ -138,6 +152,7 @@ class Telemetry:
             tokens_per_example=tokens,
             trace_file=trace_file,
             flush_every=getattr(cfg, "telemetry_flush_every", 1),
+            memory=memory_mod.MemoryMonitor(),
         )
 
     # ------------------------------------------------------------ intake
@@ -200,15 +215,18 @@ class Telemetry:
             ),
             "step_time_p50": step_summary["p50"],
             "step_time_p95": step_summary["p95"],
-            "mfu": (
-                accounting.mfu(
-                    self.flops_per_step, steps_per_sec, self.peak_flops_total
-                )
-                if steps_per_sec is not None
-                else None
-            ),
             "goodput": accounting.goodput(counters),
         }
+        # Analytic 6ND MFU + the observed device duty cycle when THIS
+        # fit's profiler window measured one (telemetry/profiling.py).
+        derived.update(
+            accounting.mfu_fields(
+                self.flops_per_step,
+                steps_per_sec,
+                self.peak_flops_total,
+                duty_cycle=self.observed_duty_cycle,
+            )
+        )
         return derived
 
     def log_window(
@@ -220,6 +238,7 @@ class Telemetry:
         kind: str = "window",
         exit_reason: str | None = None,
         reduce: bool = True,
+        extra: Mapping | None = None,
     ) -> dict:
         """Emit one window line to every sink; returns the line.
 
@@ -227,6 +246,10 @@ class Telemetry:
         on abnormal-exit paths (preemption, abort), where peer processes
         may never reach the matching collective and the reduction would
         deadlock the dying process.
+
+        ``extra`` merges additional schema-v2 objects into the line
+        (the ``"compile"`` payload of a compile_warning, the
+        ``"memory"`` breakdown of a memory snapshot line).
         """
         counters = (
             self._reduced_counters() if reduce else self._fit_counters()
@@ -249,6 +272,22 @@ class Telemetry:
         }
         if kind == "final":
             line["exit_reason"] = exit_reason or "complete"
+            if self.profile_info is not None:
+                line["profile"] = dict(self.profile_info)
+        # Memory watermark fields ride every cadenced/final line (the
+        # kind="memory" init snapshot carries its own via ``extra``).
+        # On the watchdog-fatal path only CACHED values are used: a
+        # fresh live-array/PJRT poll from the watchdog thread could
+        # block behind the wedged main thread.
+        if self.memory is not None and kind in ("window", "final"):
+            try:
+                if not self._emergency:
+                    self.memory.sample()
+                line["memory"] = self.memory.window_fields()
+            except Exception:  # pragma: no cover - accounting best effort
+                log.exception("memory sampling failed (continuing)")
+        if extra:
+            line.update(extra)
         self._last_step = int(step)
         for sink in self.sinks:
             try:
@@ -278,6 +317,40 @@ class Telemetry:
             exit_reason=exit_reason, reduce=False,
         )
 
+    # ------------------------------------- device-side lines (ISSUE 3)
+
+    def note_memory_init(self, state, step: int = 0) -> dict | None:
+        """The fit-start memory snapshot: params/opt/model-state/other
+        breakdown as a ``kind="memory"`` line (telemetry/memory.py)."""
+        if self.memory is None:
+            return None
+        try:
+            breakdown = self.memory.init_breakdown(state)
+        except Exception:  # pragma: no cover - accounting best effort
+            log.exception("memory init snapshot failed (continuing)")
+            return None
+        return self.log_window(
+            step, {}, kind="memory", reduce=False,
+            extra={"memory": breakdown},
+        )
+
+    def compile_warning(self, event: Mapping) -> dict:
+        """A post-warmup recompilation (telemetry/compilation.py):
+        lands as a ``kind="compile_warning"`` line naming the shape/
+        dtype delta. No collective — every SPMD process sees the same
+        recompile, and a mid-step collective outside the program is a
+        deadlock risk."""
+        event = dict(event)
+        step = int(event.pop("step", self._last_step))
+        return self.log_window(
+            step, {}, kind="compile_warning", reduce=False,
+            extra={"compile": event},
+        )
+
+    def note_profile(self, info: Mapping) -> None:
+        """Cross-link a completed profiler window from the final line."""
+        self.profile_info = dict(info)
+
     # ------------------------------------------------------------- flush
 
     def flush(self) -> None:
@@ -302,6 +375,7 @@ class Telemetry:
         state: the partial window lives on the wedged thread), then
         pushes the trace and sinks to disk. Must never block on the
         main thread."""
+        self._emergency = True  # memory fields come from cache only
         try:
             self.final_window(
                 self._last_step, {}, exit_reason="watchdog_fatal"

@@ -1,0 +1,266 @@
+"""HBM / host memory accounting (ISSUE 3 tentpole (2)).
+
+The second device-side blind spot after recompilation: an HBM blow-up
+surfaces as an opaque ``RESOURCE_EXHAUSTED`` with no record of *what*
+was resident. This module makes every run account for its memory:
+
+* ``MemoryMonitor.init_breakdown(state)`` — at fit start (post-restore,
+  post-init) snapshot ``jax.live_arrays()`` + per-device allocator
+  stats and attribute live bytes to **params vs. optimizer state vs.
+  non-trainable model state vs. other** (prefetch buffers, RNG keys,
+  eval copies). Emitted as a ``kind="memory"`` schema-v2 JSONL line.
+* ``MemoryMonitor.sample()`` — cheap live-byte poll at every log
+  window; maintains the run's **peak watermark** gauge
+  (``memory/peak_live_bytes``) and the per-window fields every
+  window/final line carries under ``"memory"``.
+* ``oom_report()`` — allocation forensics on the way down: the top live
+  arrays by size, the component breakdown, and allocator stats, logged
+  BEFORE the OOM re-raises so the evidence lands even when the process
+  dies (``train/loop.py`` fit's teardown calls it via ``is_oom``).
+
+Byte accounting uses array ``nbytes`` over ``jax.live_arrays()`` — the
+process-local view, exact on single-host runs and a per-host lower
+bound on multi-host ones. Device allocator stats
+(``Device.memory_stats()``) are included when the backend reports them
+(TPU/GPU; CPU returns None, which is why the live-array path is the
+portable backbone and the CPU tests still see a nonzero watermark).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping
+
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+
+log = logging.getLogger(__name__)
+
+# Patterns that identify an out-of-device-memory failure across
+# backends (XLA's RESOURCE_EXHAUSTED, PJRT OOM messages, allocator
+# text) — matched case-insensitively against the exception repr.
+# "oom" needs word boundaries (it is a substring of ordinary words).
+_OOM_PATTERNS = (
+    "resource_exhausted",
+    "out of memory",
+    r"\boom\b",
+    "memory_limit",
+    "allocation failure",
+)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total array bytes of a pytree (0 for empty/None leaves)."""
+    import jax
+
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            # Abstract leaves (ShapeDtypeStruct) carry shape/dtype only.
+            shape = getattr(leaf, "shape", None)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 0)
+            if shape is None or not itemsize:
+                continue
+            nbytes = int(np.prod(shape, dtype=np.int64)) * int(itemsize)
+        total += int(nbytes)
+    return total
+
+
+def live_array_bytes() -> int:
+    """Bytes of every live jax array in this process."""
+    import jax
+
+    return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+
+
+def device_memory_stats() -> dict[str, int] | None:
+    """Allocator stats of local device 0 (None on backends without
+    them — CPU). Keys pass through from PJRT (``bytes_in_use``,
+    ``peak_bytes_in_use``, ``bytes_limit``, ...)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # pragma: no cover - backend-specific failures
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items() if isinstance(v, int)}
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does this exception look like a device/host OOM?"""
+    import re
+
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(re.search(pat, text) for pat in _OOM_PATTERNS)
+
+
+class MemoryMonitor:
+    """Per-fit memory bookkeeping: breakdown at init, watermark per
+    window, forensics on OOM."""
+
+    def __init__(self, registry=None):
+        self._registry = registry
+        self._peak_live = 0
+        self._last_live = 0
+        self._last_device_stats: dict[str, int] | None = None
+        self._breakdown: dict[str, int] = {}
+
+    def _reg(self):
+        return (
+            self._registry
+            if self._registry is not None
+            else registry_mod.default_registry()
+        )
+
+    # ------------------------------------------------------------ intake
+
+    def sample(self) -> int:
+        """Poll live bytes; update the last/peak gauges. Called per log
+        window (and anywhere a fresh reading is wanted)."""
+        live = live_array_bytes()
+        self._last_live = live
+        self._peak_live = max(self._peak_live, live)
+        reg = self._reg()
+        reg.gauge("memory/live_bytes").set(live)
+        reg.gauge("memory/peak_live_bytes").set(self._peak_live)
+        stats = device_memory_stats()
+        self._last_device_stats = stats
+        if stats:
+            if "bytes_in_use" in stats:
+                reg.gauge("memory/device_bytes_in_use").set(
+                    stats["bytes_in_use"]
+                )
+            if "peak_bytes_in_use" in stats:
+                reg.gauge("memory/device_peak_bytes_in_use").set(
+                    stats["peak_bytes_in_use"]
+                )
+        return live
+
+    def init_breakdown(self, state) -> dict[str, int]:
+        """Attribute live bytes at fit start: model vs. optimizer vs.
+        non-trainable state vs. everything else."""
+        sizes = (
+            state.byte_breakdown()
+            if hasattr(state, "byte_breakdown")
+            else {
+                "params": tree_bytes(getattr(state, "params", None)),
+                "opt_state": tree_bytes(getattr(state, "opt_state", None)),
+                "model_state": tree_bytes(
+                    getattr(state, "model_state", None)
+                ),
+            }
+        )
+        live = self.sample()
+        accounted = sum(sizes.values())
+        breakdown = {
+            "params_bytes": sizes.get("params", 0),
+            "opt_bytes": sizes.get("opt_state", 0),
+            "model_state_bytes": sizes.get("model_state", 0),
+            "other_bytes": max(live - accounted, 0),
+            "live_bytes": live,
+        }
+        stats = device_memory_stats()
+        if stats:
+            if "bytes_in_use" in stats:
+                breakdown["device_bytes_in_use"] = stats["bytes_in_use"]
+            if "bytes_limit" in stats:
+                breakdown["device_bytes_limit"] = stats["bytes_limit"]
+        self._breakdown = breakdown
+        reg = self._reg()
+        for key in ("params_bytes", "opt_bytes", "model_state_bytes"):
+            reg.gauge(f"memory/{key}").set(breakdown[key])
+        log.info(
+            "memory at fit start: %.1f MiB live (params %.1f, opt %.1f, "
+            "model_state %.1f, other %.1f)",
+            live / 2**20,
+            breakdown["params_bytes"] / 2**20,
+            breakdown["opt_bytes"] / 2**20,
+            breakdown["model_state_bytes"] / 2**20,
+            breakdown["other_bytes"] / 2**20,
+        )
+        return breakdown
+
+    # ----------------------------------------------------------- outputs
+
+    @property
+    def peak_live_bytes(self) -> int:
+        return self._peak_live
+
+    def window_fields(self) -> dict[str, int]:
+        """The ``"memory"`` object for a window/final JSONL line.
+
+        Purely cached (last ``sample()``): safe to call from the
+        watchdog thread on the emergency-flush path, where a fresh
+        ``jax.live_arrays()``/PJRT call could block behind the wedged
+        main thread."""
+        fields = {
+            "live_bytes": self._last_live,
+            "peak_live_bytes": self._peak_live,
+        }
+        stats = self._last_device_stats
+        if stats and "bytes_in_use" in stats:
+            fields["device_bytes_in_use"] = stats["bytes_in_use"]
+        if stats and "peak_bytes_in_use" in stats:
+            fields["device_peak_bytes_in_use"] = stats["peak_bytes_in_use"]
+        return fields
+
+    def oom_report(self, top: int = 15) -> str:
+        """Allocation forensics: who holds the memory, right now."""
+        import jax
+
+        lines = ["== OOM allocation forensics =="]
+        live = sorted(
+            jax.live_arrays(),
+            key=lambda a: -int(getattr(a, "nbytes", 0)),
+        )
+        total = sum(int(getattr(a, "nbytes", 0)) for a in live)
+        lines.append(
+            f"live arrays: {len(live)} holding {total / 2**20:,.1f} MiB "
+            f"(run peak watermark {self._peak_live / 2**20:,.1f} MiB)"
+        )
+        if self._breakdown:
+            b = self._breakdown
+            lines.append(
+                "fit-start breakdown: params %.1f MiB / opt %.1f MiB / "
+                "model_state %.1f MiB / other %.1f MiB"
+                % (
+                    b.get("params_bytes", 0) / 2**20,
+                    b.get("opt_bytes", 0) / 2**20,
+                    b.get("model_state_bytes", 0) / 2**20,
+                    b.get("other_bytes", 0) / 2**20,
+                )
+            )
+        stats = device_memory_stats()
+        if stats:
+            lines.append(
+                "device allocator: "
+                + ", ".join(f"{k}={v:,}" for k, v in sorted(stats.items()))
+            )
+        lines.append(f"top {min(top, len(live))} live arrays by size:")
+        for a in live[:top]:
+            nbytes = int(getattr(a, "nbytes", 0))
+            lines.append(
+                f"  {nbytes / 2**20:>10,.2f} MiB  "
+                f"{str(getattr(a, 'dtype', '?')):>10}  "
+                f"shape {tuple(getattr(a, 'shape', ()))}"
+            )
+        return "\n".join(lines)
+
+
+def maybe_log_oom_report(
+    exc: BaseException | None, monitor: "MemoryMonitor | None"
+) -> bool:
+    """Fit-teardown hook: if ``exc`` is an OOM, log the forensics report
+    (the exception re-raises naturally afterwards). Returns whether a
+    report was logged."""
+    if exc is None or monitor is None or not is_oom(exc):
+        return False
+    try:
+        log.error("%s", monitor.oom_report())
+    except Exception:  # pragma: no cover - dying anyway; best effort
+        log.exception("OOM forensics report failed")
+    return True

@@ -1,20 +1,21 @@
-"""Self-describing JSONL metrics schema (ISSUE 2 CI satellite).
+"""Self-describing JSONL metrics schema (ISSUE 2 CI satellite; v2 in
+ISSUE 3).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
-consumers (tools/telemetry_report.py, future BENCH_* harvesters) can
-evolve without guessing. ``validate_line`` is the single source of truth
-for what a line must look like — the tier-1 test validates every emitted
-line through it, and the report CLI refuses lines it cannot validate
-rather than mis-aggregating them.
+consumers (tools/telemetry_report.py, tools/bench_gate.py, future
+BENCH_* harvesters) can evolve without guessing. ``validate_line`` is
+the single source of truth for what a line must look like — the tier-1
+test validates every emitted line through it, and the report CLI
+refuses lines it cannot validate rather than mis-aggregating them.
 
 Hand-rolled (no jsonschema dependency — the image is pip-install-free);
 the structure is small enough that explicit checks read better anyway.
 
-Line shape (version 1)::
+Line shape (version 2; version-1 lines remain valid input)::
 
     {
-      "schema_version": 1,
-      "kind": "window" | "eval" | "final",
+      "schema_version": 2,
+      "kind": "window" | "eval" | "final" | "memory" | "compile_warning",
       "step": <int >= 0>,            # loop step the line was emitted at
       "time_unix": <float>,          # wall clock at emission
       "session_start_unix": <float>, # constant per fit-session: the
@@ -26,7 +27,25 @@ Line shape (version 1)::
       "derived": {"examples_per_sec": ..., "step_time_p50": ...,
                   "mfu": ..., "goodput": ...},  # may hold nulls
       "exit_reason": "preempt" | ...  # kind == "final" only
+
+      # --- version 2 additions (telemetry/memory.py, compilation.py,
+      #     profiling.py) ---
+      "memory": {"live_bytes": ..., "peak_live_bytes": ...,
+                 "params_bytes": ..., ...},  # numeric|null; REQUIRED on
+                                     #   kind == "memory" (the init
+                                     #   breakdown snapshot), optional
+                                     #   on window/final lines
+      "compile": {"fn": "train_step", "delta": "...axis 0: 64->32...",
+                  "count": 2, "wall_secs": 0.4},  # REQUIRED on (and
+                                     #   exclusive to) compile_warning
+      "profile": {"dir": "...", "start_step": 10, "num_steps": 10,
+                  "wall_secs": 1.2}  # final lines only: cross-link to
+                                     #   the in-loop profiler window
     }
+
+Version-1 lines (the pre-ISSUE-3 stream) carry none of the v2 fields
+and only the v1 kinds; they still validate, so old run dirs keep
+reporting.
 """
 
 from __future__ import annotations
@@ -34,35 +53,59 @@ from __future__ import annotations
 import numbers
 from typing import Any
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
-KINDS = ("window", "eval", "final")
+SUPPORTED_VERSIONS = (1, 2)
+
+KINDS_V1 = ("window", "eval", "final")
+KINDS = KINDS_V1 + ("memory", "compile_warning")
 
 _REQUIRED = ("schema_version", "kind", "step", "time_unix",
              "session_start_unix", "metrics", "counters", "gauges",
              "derived")
+
+# v2-only top-level objects: forbidden on v1 lines (a "v1" line carrying
+# them is a mislabeled v2 line — flag it instead of half-validating).
+_V2_FIELDS = ("memory", "compile", "profile")
 
 
 def _is_number(v: Any) -> bool:
     return isinstance(v, numbers.Real) and not isinstance(v, bool)
 
 
+def _check_numeric_map(obj: dict, section: str, problems: list[str]) -> None:
+    sec = obj.get(section)
+    if not isinstance(sec, dict):
+        problems.append(f"{section} is not an object")
+        return
+    for k, v in sec.items():
+        if not isinstance(k, str):
+            problems.append(f"{section} key {k!r} is not a string")
+        # NaN/Inf pass through json.dumps as bare tokens; numeric or
+        # null is the contract (a NaN loss window is still a number).
+        if v is not None and not _is_number(v):
+            problems.append(f"{section}[{k!r}] = {v!r} is not numeric")
+
+
 def validate_line(obj: Any) -> list[str]:
     """Return the list of schema violations (empty = valid)."""
     if not isinstance(obj, dict):
         return [f"line is {type(obj).__name__}, not an object"]
-    problems = []
+    problems: list[str] = []
     for key in _REQUIRED:
         if key not in obj:
             problems.append(f"missing required field {key!r}")
     if problems:
         return problems
-    if obj["schema_version"] != SCHEMA_VERSION:
+    version = obj["schema_version"]
+    if version not in SUPPORTED_VERSIONS:
         problems.append(
-            f"schema_version {obj['schema_version']!r} != {SCHEMA_VERSION}"
+            f"schema_version {version!r} not in {SUPPORTED_VERSIONS}"
         )
-    if obj["kind"] not in KINDS:
-        problems.append(f"kind {obj['kind']!r} not in {KINDS}")
+        return problems
+    kinds = KINDS_V1 if version == 1 else KINDS
+    if obj["kind"] not in kinds:
+        problems.append(f"kind {obj['kind']!r} not in {kinds}")
     if not isinstance(obj["step"], int) or isinstance(obj["step"], bool) \
             or obj["step"] < 0:
         problems.append(f"step {obj['step']!r} is not a non-negative int")
@@ -70,17 +113,7 @@ def validate_line(obj: Any) -> list[str]:
         if not _is_number(obj[key]):
             problems.append(f"{key} {obj[key]!r} is not a number")
     for section in ("metrics", "gauges"):
-        sec = obj[section]
-        if not isinstance(sec, dict):
-            problems.append(f"{section} is not an object")
-            continue
-        for k, v in sec.items():
-            if not isinstance(k, str):
-                problems.append(f"{section} key {k!r} is not a string")
-            # NaN/Inf pass through json.dumps as bare tokens; numeric or
-            # null is the contract (a NaN loss window is still a number).
-            if v is not None and not _is_number(v):
-                problems.append(f"{section}[{k!r}] = {v!r} is not numeric")
+        _check_numeric_map(obj, section, problems)
     counters = obj["counters"]
     if not isinstance(counters, dict):
         problems.append("counters is not an object")
@@ -103,6 +136,65 @@ def validate_line(obj: Any) -> list[str]:
         problems.append("final line is missing a string exit_reason")
     if obj["kind"] != "final" and "exit_reason" in obj:
         problems.append("exit_reason on a non-final line")
+
+    if version == 1:
+        for key in _V2_FIELDS:
+            if key in obj:
+                problems.append(f"v2 field {key!r} on a schema-v1 line")
+        return problems
+
+    # ------------------------------------------------- v2 additions
+    if "memory" in obj:
+        _check_numeric_map(obj, "memory", problems)
+    if obj["kind"] == "memory" and "memory" not in obj:
+        problems.append("memory line is missing the memory object")
+
+    if obj["kind"] == "compile_warning":
+        comp = obj.get("compile")
+        if not isinstance(comp, dict):
+            problems.append(
+                "compile_warning line is missing the compile object"
+            )
+        else:
+            for key in ("fn", "delta"):
+                if not isinstance(comp.get(key), str):
+                    problems.append(
+                        f"compile[{key!r}] = {comp.get(key)!r} is not a "
+                        "string"
+                    )
+            if "count" in comp and (
+                not isinstance(comp["count"], int)
+                or isinstance(comp["count"], bool)
+                or comp["count"] < 0
+            ):
+                problems.append(
+                    f"compile['count'] = {comp['count']!r} is not a "
+                    "non-negative int"
+                )
+            if "wall_secs" in comp and not _is_number(comp["wall_secs"]):
+                problems.append(
+                    f"compile['wall_secs'] = {comp['wall_secs']!r} is not "
+                    "a number"
+                )
+    elif "compile" in obj:
+        problems.append("compile object on a non-compile_warning line")
+
+    if "profile" in obj:
+        if obj["kind"] != "final":
+            problems.append("profile object on a non-final line")
+        elif not isinstance(obj["profile"], dict):
+            problems.append("profile is not an object")
+        else:
+            prof = obj["profile"]
+            if not isinstance(prof.get("dir"), str):
+                problems.append("profile['dir'] is not a string")
+            for key in ("start_step", "num_steps"):
+                v = prof.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    problems.append(
+                        f"profile[{key!r}] = {v!r} is not a non-negative "
+                        "int"
+                    )
     return problems
 
 

@@ -71,10 +71,18 @@ class JsonlSink(Sink):
             self._f.close()
 
 
+# Line kinds the scalar-oriented sinks (console, tensorboard) render;
+# device-side snapshot kinds (memory, compile_warning — schema v2) are
+# JSONL-record material and already logged by their producers.
+_SCALAR_KINDS = ("window", "eval", "final")
+
+
 class ConsoleSink(Sink):
     """The historical human-readable log line, one per window."""
 
     def write(self, line: dict) -> None:
+        if line.get("kind", "window") not in _SCALAR_KINDS:
+            return
         shown = {k: round(v, 5) for k, v in line["metrics"].items()
                  if v is not None}
         log.info("step %d: %s", line["step"], shown)
@@ -115,6 +123,11 @@ class TensorBoardSink(Sink):
 
     def write(self, line: dict) -> None:
         if self._writer is None:
+            return
+        if line.get("kind", "window") not in _SCALAR_KINDS:
+            # A mid-run memory/compile_warning line would re-write the
+            # whole derived scalar set at its step, duplicating (or
+            # reordering against) the adjacent window line.
             return
         scalars = {
             k: v for k, v in line["metrics"].items() if v is not None

@@ -53,7 +53,17 @@ class TrainConfig:
     resume: bool = True  # restore latest checkpoint from workdir
 
     # Profiling / sanitizers
-    profile: bool = False  # capture a profiler trace around steps 10-20
+    profile: bool = False  # legacy sugar: capture a profiler trace
+    #   around run-relative steps 10-20 (= profile_start_step=10,
+    #   profile_num_steps=10)
+    profile_start_step: int = 0  # with profile_num_steps > 0: first
+    #   run-relative step of the windowed jax.profiler device trace
+    #   (telemetry/profiling.py); the window is one-shot per fit
+    profile_num_steps: int = 0  # steps the profiler window covers;
+    #   0 disables (unless legacy --profile is set)
+    profile_dir: str = ""  # trace output dir; "" → <workdir>/profile
+    #   (or /tmp/tpu_profile without a workdir). The final JSONL line
+    #   cross-links the captured window under "profile".
     debug_nans: bool = False  # jax_debug_nans: fail fast at the op that
     #   produced a NaN (SURVEY.md §5b — the functional model removes data
     #   races by construction; NaN tracing is the remaining sanitizer)
@@ -97,6 +107,11 @@ class TrainConfig:
     telemetry_peak_tflops: float = 0.0  # per-device peak TFLOP/s for the
     #   MFU estimate; 0 = auto from the PJRT device kind (unknown kinds
     #   fall back to a labeled 1 TFLOP/s so the pipeline stays live)
+    compile_warmup: int = 1  # expected compilations per jitted step fn
+    #   (telemetry/compilation.py): the first N distinct input
+    #   signatures are normal jit warmup; any compile beyond that is a
+    #   RECOMPILATION — logged at WARNING naming the shape/dtype delta
+    #   and emitted as a kind="compile_warning" JSONL line
 
     def mesh_config(self) -> MeshConfig:
         return MeshConfig(

@@ -26,6 +26,15 @@ registry's counters (resilience events, IO retries, batch skips) and
 derived accounting (examples/sec, step-time percentiles, 6ND MFU,
 goodput), flushed on EVERY exit path including preemption, bad-step
 abort, and the watchdog's fatal exit.
+
+Device-side observability (ISSUE 3): the jitted step fns run under a
+recompilation sentinel (post-warmup aval changes warn, naming the
+changed axis, and land as ``compile_warning`` JSONL lines); a fit-start
+memory snapshot attributes live bytes to params/optimizer/other and a
+peak watermark rides every window line; ``profile_start_step`` /
+``profile_num_steps`` / ``profile_dir`` capture a programmable one-shot
+``jax.profiler`` window cross-linked from the final line; and an OOM
+dumps allocation forensics before re-raising.
 """
 
 from __future__ import annotations
@@ -56,6 +65,9 @@ from tensorflow_examples_tpu.data.prefetch import (
     put_batch,
 )
 from tensorflow_examples_tpu.telemetry import Telemetry
+from tensorflow_examples_tpu.telemetry import compilation as compilation_mod
+from tensorflow_examples_tpu.telemetry import memory as memory_mod
+from tensorflow_examples_tpu.telemetry import profiling as profiling_mod
 from tensorflow_examples_tpu.train import resilience
 from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
 from tensorflow_examples_tpu.train.config import TrainConfig
@@ -95,10 +107,22 @@ class Trainer:
         self._ckpt: CheckpointManager | None = None
         self._telemetry: Telemetry | None = None  # built per fit()
         self._guard: resilience.BadStepGuard | None = None
+        # Recompilation sentinel (telemetry/compilation.py): every
+        # jitted step fn this trainer builds is wrapped, so a post-
+        # warmup aval change surfaces as a named warning instead of a
+        # silent step-time cliff. Transparent to AOT consumers
+        # (``trainer._train_step.lower(...)`` still works).
+        self.sentinel = compilation_mod.CompilationSentinel.from_config(
+            config
+        )
         self.state = self._init_state()
-        self._train_step = self._build_train_step()
+        self._train_step = self.sentinel.wrap(
+            self._build_train_step(), "train_step"
+        )
         self._bundled_steps: dict[int, object] = {}
-        self._eval_step = self._build_eval_step()
+        self._eval_step = self.sentinel.wrap(
+            self._build_eval_step(), "eval_step"
+        )
 
     # ------------------------------------------------------------- init
 
@@ -299,11 +323,14 @@ class Trainer:
             return jax.lax.scan(train_step, state, batches)
 
         state_sh = self._state_shardings(jax.eval_shape(lambda s: s, self.state))
-        step = jax.jit(
-            bundled,
-            in_shardings=(state_sh, bundle_sharding(self.mesh)),
-            out_shardings=(state_sh, NamedSharding(self.mesh, P())),
-            donate_argnums=(0,),
+        step = self.sentinel.wrap(
+            jax.jit(
+                bundled,
+                in_shardings=(state_sh, bundle_sharding(self.mesh)),
+                out_shardings=(state_sh, NamedSharding(self.mesh, P())),
+                donate_argnums=(0,),
+            ),
+            f"train_step[k={k}]",
         )
         self._bundled_steps[k] = step
         return step
@@ -384,7 +411,10 @@ class Trainer:
         # workdir-backed and multiple fits on one Trainer are legal.
         telemetry = Telemetry.from_config(cfg, n_params=self._n_params)
         self._telemetry = telemetry
+        # Post-warmup recompiles now land as JSONL warning lines.
+        self.sentinel.bind(telemetry)
         emit_final: Callable[..., None] | None = None  # bound in the try
+        prof: profiling_mod.ProfilerWindow | None = None
 
         watchdog = None
         if cfg.watchdog_secs > 0 or cfg.watchdog_fatal_secs > 0:
@@ -414,6 +444,12 @@ class Trainer:
                     restored = self._ckpt.restore_latest(self.state)
                     if restored is not None:
                         self.state, start_step = restored[0], int(restored[1])
+
+            # Fit-start memory snapshot (post-restore: the restored
+            # state is what actually occupies the device): params vs.
+            # optimizer vs. other breakdown as a kind="memory" line,
+            # and the watermark gauge starts ticking.
+            telemetry.note_memory_init(self.state, step=start_step)
 
             k = max(int(getattr(cfg, "steps_per_launch", 1) or 1), 1)
             if k > 1:
@@ -462,8 +498,10 @@ class Trainer:
 
             train_iter = build_iter(start_step)
 
-            profiling = False
-            profiled = False  # one-shot: the trace covers steps ~10-20 once
+            # Programmable one-shot device-trace window (ISSUE 3):
+            # cfg.profile_start_step/num_steps/dir, with the legacy
+            # --profile flag mapping to the historical steps-10..20.
+            prof = profiling_mod.ProfilerWindow.from_config(cfg, telemetry)
             evaluated_now = False
             stepped_once = False  # first step_fn call pays jit compile
             window: list[Mapping[str, jax.Array]] = []
@@ -537,16 +575,11 @@ class Trainer:
                 # step = index of the chunk's LAST train step; with k == 1
                 # this loop is exactly the historical per-step loop.
                 step = chunk + k - 1
+                self.sentinel.step = step  # labels recompile warnings
                 if faults_engine is not None:
                     faults_engine.step_hook(chunk, k)
-                if (
-                    cfg.profile
-                    and not profiling
-                    and not profiled
-                    and chunk - start_step >= 10
-                ):
-                    jax.profiler.start_trace(cfg.workdir or "/tmp/tpu_profile")
-                    profiling = True
+                if prof is not None:
+                    prof.maybe_start(chunk - start_step)
                 # StepTraceAnnotation marks step boundaries in the
                 # profiler timeline (SURVEY §5a); next() sits INSIDE it
                 # so host input-wait shows up in the per-step
@@ -591,11 +624,10 @@ class Trainer:
                 window.append(metrics)
                 if guard is not None:
                     guard.observe(step, metrics)
-                if profiling and step - start_step >= 20:
-                    jax.block_until_ready(self.state.params)
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    profiled = True
+                if prof is not None:
+                    prof.maybe_stop(
+                        chunk + k - start_step, block_on=self.state.params
+                    )
 
                 if (cfg.log_every and (step + 1) % cfg.log_every == 0) or (
                     step + 1 == num_steps
@@ -628,8 +660,8 @@ class Trainer:
                     # Checked BEFORE the periodic eval: a pending SIGTERM
                     # must not burn the scheduler's kill grace window on
                     # a full evaluation before the checkpoint lands.
-                    if profiling:
-                        jax.profiler.stop_trace()
+                    if prof is not None:
+                        prof.finish()
                     self._preempt_exit(step + 1, preempt, watchdog, emit_final)
 
                 evaluated_now = False
@@ -670,16 +702,16 @@ class Trainer:
                         watchdog.resume()
 
                 if preempt is not None and preempt.requested:
-                    if profiling:
-                        jax.profiler.stop_trace()
+                    if prof is not None:
+                        prof.finish()
                     self._preempt_exit(step + 1, preempt, watchdog, emit_final)
                 chunk += k
                 # Step-time clock excludes this chunk's cadence work
                 # (flush/eval/checkpoint have their own spans).
                 t_iter = time.perf_counter()
 
-            if profiling:
-                jax.profiler.stop_trace()
+            if prof is not None:
+                prof.finish(block_on=self.state.params)
             if watchdog is not None:
                 watchdog.pause()  # final eval + checkpoint close
             if preempt is not None and preempt.requested:
@@ -717,8 +749,19 @@ class Trainer:
             # final JSONL line — bad-step aborts included — then sinks
             # close and the span timeline is written. ``emit_final`` is
             # None if the failure happened before the loop was set up.
+            if prof is not None:
+                try:
+                    # An exception with an open window must not leave
+                    # the process-global profiler armed (the next fit's
+                    # start_trace would fail); no-op when already done.
+                    prof.finish()
+                except Exception:  # pragma: no cover - profiler races
+                    log.exception("profiler window teardown failed")
             try:
                 exc = sys.exc_info()[1]
+                # OOM allocation forensics (ISSUE 3): who held the
+                # memory, logged BEFORE the exception re-raises.
+                memory_mod.maybe_log_oom_report(exc, telemetry.memory)
                 if (
                     exc is not None
                     and not isinstance(exc, resilience.Preempted)
@@ -727,6 +770,7 @@ class Trainer:
                     emit_final(f"error:{type(exc).__name__}")
             except Exception:  # pragma: no cover - telemetry best effort
                 log.exception("final telemetry window failed")
+            self.sentinel.unbind()
             telemetry.close()
             if self._ckpt is not None:
                 try:

@@ -46,3 +46,16 @@ class TrainState:
         return self.replace(
             step=self.step + 1, params=new_params, opt_state=new_opt_state
         )
+
+    def byte_breakdown(self) -> dict[str, int]:
+        """Array bytes per state component — the memory-accounting
+        attribution (telemetry/memory.py): params vs. optimizer moments
+        vs. non-trainable collections. Works on concrete and abstract
+        (eval_shape) trees alike, since both carry size/dtype."""
+        from tensorflow_examples_tpu.telemetry.memory import tree_bytes
+
+        return {
+            "params": tree_bytes(self.params),
+            "opt_state": tree_bytes(self.opt_state),
+            "model_state": tree_bytes(self.model_state),
+        }

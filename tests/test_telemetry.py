@@ -17,6 +17,7 @@ import json
 import logging
 import os
 
+import jax
 import numpy as np
 import pytest
 
@@ -254,6 +255,79 @@ class TestSchema:
         assert schema.validate_line(self._line()) == []
         schema.validate(self._line())  # and the raising form passes
 
+    def test_golden_v1_line_still_parses(self):
+        """Pre-ISSUE-3 run dirs must keep validating: a frozen v1 line
+        (no memory/compile/profile fields, v1 kinds only)."""
+        v1 = {
+            "schema_version": 1,
+            "kind": "final",
+            "step": 400,
+            "time_unix": 1_760_000_000.0,
+            "session_start_unix": 1_759_999_000.0,
+            "metrics": {"train/loss": 2.31},
+            "counters": {"train/steps_total": 400, "io/retries": 1},
+            "gauges": {"telemetry/flops_per_step": 1.2e15},
+            "derived": {"examples_per_sec": 51234.0, "mfu": 0.42,
+                        "goodput": 1.0},
+            "exit_reason": "complete",
+        }
+        assert schema.validate_line(v1) == []
+
+    def test_v2_fields_rejected_on_v1_lines(self):
+        assert any(
+            "v2 field" in p
+            for p in schema.validate_line(
+                self._line(schema_version=1, memory={"live_bytes": 1})
+            )
+        )
+        assert schema.validate_line(self._line(kind="memory",
+                                               schema_version=1))
+
+    def test_memory_kind_and_fields(self):
+        # memory object optional on windows, required on memory lines.
+        assert schema.validate_line(
+            self._line(memory={"live_bytes": 100, "peak_live_bytes": 200})
+        ) == []
+        assert any(
+            "missing the memory object" in p
+            for p in schema.validate_line(self._line(kind="memory"))
+        )
+        assert schema.validate_line(
+            self._line(kind="memory", memory={"params_bytes": 10})
+        ) == []
+        assert schema.validate_line(self._line(memory={"x": "big"}))
+
+    def test_compile_warning_contract(self):
+        good = self._line(
+            kind="compile_warning",
+            compile={"fn": "train_step", "delta": "axis 0: 64->32",
+                     "count": 2, "wall_secs": 0.5},
+        )
+        assert schema.validate_line(good) == []
+        assert any(
+            "missing the compile object" in p
+            for p in schema.validate_line(self._line(kind="compile_warning"))
+        )
+        assert schema.validate_line(
+            self._line(kind="compile_warning", compile={"fn": "x"})
+        )  # delta required
+        # and the compile object is exclusive to compile_warning lines
+        assert schema.validate_line(
+            self._line(compile={"fn": "x", "delta": "y"})
+        )
+
+    def test_profile_object_final_only(self):
+        prof = {"dir": "/tmp/p", "start_step": 10, "num_steps": 10,
+                "wall_secs": 1.0}
+        assert schema.validate_line(
+            self._line(kind="final", exit_reason="complete", profile=prof)
+        ) == []
+        assert schema.validate_line(self._line(profile=prof))
+        assert schema.validate_line(
+            self._line(kind="final", exit_reason="complete",
+                       profile={"dir": 3})
+        )
+
     def test_violations_detected(self):
         assert schema.validate_line("not a dict")
         assert any(
@@ -363,6 +437,40 @@ class TestSmokeRun:
         assert evals and any(
             k.startswith("eval/") for k in evals[-1]["metrics"]
         )
+
+    def test_schema_v2_memory_watermark(self, smoke_run):
+        """ISSUE 3 acceptance: the run emits schema_version=2 lines with
+        a nonzero peak-memory watermark, plus the fit-start breakdown
+        snapshot attributing bytes to params vs. optimizer."""
+        wd, _, _, _ = smoke_run
+        lines = self._lines(wd)
+        assert all(l["schema_version"] == 2 for l in lines)
+        mems = [l for l in lines if l["kind"] == "memory"]
+        assert len(mems) == 1  # the fit-start snapshot
+        bd = mems[0]["memory"]
+        assert bd["params_bytes"] > 0
+        assert bd["opt_bytes"] > 0  # adam moments embed the param tree
+        assert bd["live_bytes"] >= bd["params_bytes"] + bd["opt_bytes"]
+        windows = [l for l in lines if l["kind"] == "window"]
+        assert windows[-1]["memory"]["peak_live_bytes"] > 0
+        assert (
+            lines[-1]["memory"]["peak_live_bytes"]
+            >= lines[-1]["memory"]["live_bytes"]
+        )
+
+    def test_compile_counters_and_no_recompiles(self, smoke_run):
+        """Fixed-shape training compiles each step fn exactly once
+        (train + eval): the sentinel counts them, and no recompile
+        warning fires."""
+        wd, _, _, _ = smoke_run
+        lines = self._lines(wd)
+        c = lines[-1]["counters"]
+        assert c["compile/count"] >= 2  # train_step + eval_step
+        assert c.get("compile/recompiles", 0) == 0
+        assert not [l for l in lines if l["kind"] == "compile_warning"]
+        with open(sinks_mod.trace_path(wd)) as f:
+            names = {e["name"] for e in json.load(f)["traceEvents"]}
+        assert "compile" in names  # compile wall time is span-traced
 
     def test_report_cli_on_real_run(self, smoke_run, capsys):
         """The full acceptance loop: the run dir feeds the report CLI,
@@ -563,6 +671,236 @@ def test_watchdog_dump_names_open_span(caplog, fresh_telemetry):
         r.getMessage() for r in caplog.records if "WATCHDOG" in r.getMessage()
     ]
     assert dumps and "data_fetch" in dumps[0]
+
+
+# ----------------------------------------- recompilation sentinel
+
+
+class TestCompilationSentinel:
+    def test_signature_and_delta_name_changed_axis(self):
+        from tensorflow_examples_tpu.telemetry import compilation
+
+        a = compilation.abstract_signature(
+            ({"x": np.zeros((64, 28), np.float32)},), {}
+        )
+        b = compilation.abstract_signature(
+            ({"x": np.zeros((32, 28), np.float32)},), {}
+        )
+        assert a != b
+        delta = compilation.describe_delta(a, b)
+        assert "axis 0: 64->32" in delta and "'x'" in delta
+        # dtype changes are named too
+        c = compilation.abstract_signature(
+            ({"x": np.zeros((32, 28), np.float16)},), {}
+        )
+        assert "dtype float32->float16" in compilation.describe_delta(b, c)
+        assert compilation.describe_delta(None, a) == "first compilation"
+
+    def test_wrapper_counts_and_warns_after_warmup(self, fresh_telemetry):
+        from tensorflow_examples_tpu.telemetry import compilation
+
+        reg, _ = fresh_telemetry
+        sentinel = compilation.CompilationSentinel(warmup=1)
+        calls = []
+        wrapped = sentinel.wrap(lambda x: calls.append(1) or x, "f")
+        events = []
+        sentinel.on_recompile = events.append
+        x64, x32 = np.zeros((64,)), np.zeros((32,))
+        wrapped(x64)
+        wrapped(x64)  # cached signature: no new compile
+        assert reg.counter("compile/count").value == 1
+        assert not events
+        sentinel.step = 7
+        wrapped(x32)  # post-warmup recompile
+        assert reg.counter("compile/count").value == 2
+        assert reg.counter("compile/recompiles").value == 1
+        assert len(events) == 1
+        assert events[0]["step"] == 7 and events[0]["fn"] == "f"
+        assert "axis 0: 64->32" in events[0]["delta"]
+        wrapped(x32)  # now-known signature: quiet
+        assert len(events) == 1
+        assert len(calls) == 4  # every call reached the wrapped fn
+
+    def test_wrapper_forwards_attributes(self):
+        from tensorflow_examples_tpu.telemetry import compilation
+
+        sentinel = compilation.CompilationSentinel()
+        jitted = jax.jit(lambda x: x * 2)
+        wrapped = sentinel.wrap(jitted, "g")
+        # The AOT surface bench.py and the diag tools rely on:
+        lowered = wrapped.lower(np.ones((4,), np.float32))
+        assert lowered.compile() is not None
+        assert sentinel.wrap(None, "absent") is None
+
+    @pytest.mark.timeout(300)
+    def test_post_warmup_shape_change_emits_one_warning_line(
+        self, tmp_path, devices, fresh_telemetry
+    ):
+        """ISSUE 3 acceptance, one fit covering both device-side paths:
+        a post-warmup batch-shape change triggers EXACTLY ONE
+        compile_warning JSONL line naming the changed axis (the
+        repeated new shape is then a known signature), while an in-loop
+        profiler window ([2, 5)) captures a real device trace
+        cross-linked from the final line."""
+        import glob
+
+        wd = str(tmp_path)
+        cfg = tiny_cfg(
+            workdir=wd, train_steps=8, log_every=4, checkpoint_every=0,
+            eval_every=0, profile_start_step=2, profile_num_steps=3,
+        )
+        ds = _data()
+
+        def data(start):
+            base = train_iterator(ds, 64, seed=7, start_step=start)
+            for i, batch in enumerate(base):
+                if i + start >= 5:  # ragged from step 5 on
+                    batch = {k: v[:32] for k, v in batch.items()}
+                yield batch
+
+        trainer = Trainer(mnist.make_task(cfg), cfg)
+        trainer.fit(data)
+        with open(sinks_mod.metrics_path(wd)) as f:
+            lines = [json.loads(line) for line in f]
+        warnings = [l for l in lines if l["kind"] == "compile_warning"]
+        assert len(warnings) == 1, [l["kind"] for l in lines]
+        line = warnings[0]
+        assert schema.validate_line(line) == []
+        comp = line["compile"]
+        assert comp["fn"] == "train_step"
+        assert "axis 0: 64->32" in comp["delta"]
+        assert "'image'" in comp["delta"]
+        final = lines[-1]
+        assert final["counters"]["compile/count"] == 2
+        assert final["counters"]["compile/recompiles"] == 1
+
+        # ---- the profiler window, from the same run ----
+        assert schema.validate_line(final) == []
+        prof = final["profile"]
+        assert prof["start_step"] == 2
+        assert prof["num_steps"] == 3
+        assert prof["dir"] == os.path.join(wd, "profile")
+        assert prof["wall_secs"] > 0
+        assert final["gauges"]["profile/steps"] == 3
+        assert glob.glob(
+            os.path.join(wd, "profile", "**", "*.xplane.pb"),
+            recursive=True,
+        ), "profiler window captured no device trace"
+        with open(sinks_mod.trace_path(wd)) as f:
+            names = {e["name"] for e in json.load(f)["traceEvents"]}
+        assert "profile" in names  # the bracketing span
+
+
+# ------------------------------------------------ memory accounting
+
+
+class TestMemoryAccounting:
+    def test_tree_bytes_concrete_and_abstract(self):
+        import jax.numpy as jnp
+
+        from tensorflow_examples_tpu.telemetry import memory as memory_mod
+
+        tree = {
+            "a": jnp.ones((4, 4), jnp.float32),
+            "b": jnp.ones((2,), jnp.int32),
+        }
+        assert memory_mod.tree_bytes(tree) == 64 + 8
+        abstract = jax.eval_shape(lambda: tree)
+        assert memory_mod.tree_bytes(abstract) == 64 + 8
+
+    def test_state_byte_breakdown(self):
+        import jax.numpy as jnp
+        import optax
+
+        from tensorflow_examples_tpu.train.state import TrainState
+
+        state = TrainState.create(
+            apply_fn=None,
+            params={"w": jnp.ones((10,), jnp.float32)},
+            tx=optax.adam(1e-3),
+        )
+        sizes = state.byte_breakdown()
+        assert sizes["params"] == 40
+        assert sizes["opt_state"] >= 80  # adam mu + nu embed the params
+        assert sizes["model_state"] == 0
+
+    def test_is_oom_classification(self):
+        from tensorflow_examples_tpu.telemetry import memory as memory_mod
+
+        assert memory_mod.is_oom(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                         "1073741824 bytes")
+        )
+        assert memory_mod.is_oom(ValueError("allocation failure"))
+        assert memory_mod.is_oom(RuntimeError("OOM when allocating"))
+        assert not memory_mod.is_oom(ValueError("shape mismatch"))
+        assert not memory_mod.is_oom(RuntimeError("in the classroom"))
+
+    def test_monitor_watermark_and_forensics(self, fresh_telemetry):
+        import jax.numpy as jnp
+
+        from tensorflow_examples_tpu.telemetry import memory as memory_mod
+
+        reg, _ = fresh_telemetry
+        mon = memory_mod.MemoryMonitor(registry=reg)
+        big = jnp.ones((256, 256), jnp.float32)  # 256 KiB resident
+        live = mon.sample()
+        assert live >= big.nbytes
+        assert reg.gauge("memory/peak_live_bytes").value == live
+        fields = mon.window_fields()
+        assert fields["peak_live_bytes"] >= fields["live_bytes"] - 1
+        report = mon.oom_report(top=3)
+        assert "live arrays" in report and "MiB" in report
+        assert "(256, 256)" in report  # the big array is named
+        del big
+
+    def test_oom_teardown_hook_logs_report(self, caplog, fresh_telemetry):
+        from tensorflow_examples_tpu.telemetry import memory as memory_mod
+
+        mon = memory_mod.MemoryMonitor()
+        with caplog.at_level(
+            logging.ERROR, logger="tensorflow_examples_tpu"
+        ):
+            assert memory_mod.maybe_log_oom_report(
+                RuntimeError("RESOURCE_EXHAUSTED: out of memory"), mon
+            )
+            assert not memory_mod.maybe_log_oom_report(
+                ValueError("unrelated"), mon
+            )
+            assert not memory_mod.maybe_log_oom_report(None, mon)
+        dumps = [
+            r.getMessage()
+            for r in caplog.records
+            if "OOM allocation forensics" in r.getMessage()
+        ]
+        assert len(dumps) == 1
+
+
+# ------------------------------------------------- profiler windows
+
+
+class TestProfilerWindow:
+    def test_from_config_mappings(self):
+        from tensorflow_examples_tpu.telemetry import profiling
+
+        assert profiling.ProfilerWindow.from_config(tiny_cfg()) is None
+        legacy = profiling.ProfilerWindow.from_config(
+            tiny_cfg(profile=True)
+        )
+        assert (legacy.start_step, legacy.num_steps) == (10, 10)
+        explicit = profiling.ProfilerWindow.from_config(
+            tiny_cfg(profile_start_step=3, profile_num_steps=5,
+                     workdir="/w")
+        )
+        assert (explicit.start_step, explicit.num_steps) == (3, 5)
+        assert explicit.out_dir == os.path.join("/w", "profile")
+        override = profiling.ProfilerWindow.from_config(
+            tiny_cfg(profile_num_steps=2, profile_dir="/elsewhere")
+        )
+        assert override.out_dir == "/elsewhere"
+        # The wired capture (real trace + final-line cross-link) is
+        # asserted on the sentinel acceptance fit above — one shared
+        # training run keeps the tier-1 budget flat.
 
 
 if __name__ == "__main__":

@@ -1,0 +1,353 @@
+#!/usr/bin/env python
+"""CI perf gate: fail loudly when the trajectory or a run regresses.
+
+Two gating modes, both exit 0 on pass / 1 on regression / 2 on unusable
+input (an empty gate must read as an error, never as green):
+
+**Trajectory mode** (default) — gate banked ``BENCH_*.json`` rounds
+against the stamped floors in ``bench.py``::
+
+    python tools/bench_gate.py BENCH_r0*.json
+    python tools/bench_gate.py --threshold 0.1 BENCH_r0*.json
+
+Each file contributes per-metric records: the driver wrapper's
+``parsed`` record (head + extras) when present, else metric/value
+fragments recovered from the ``tail`` text (the driver truncates long
+JSON lines, so the regex sweep is the honest fallback — anything it
+cannot recover is reported as skipped, not silently dropped). The
+LATEST observation per (backend, metric) is compared against
+``bench.FLOORS`` under the repo's floors policy: a verdict only counts
+when the record's rig fingerprint is within 2x of the floor's
+(``FLOORS POLICY``, bench.py docstring) — off-rig records are listed as
+"not comparable", because calling them regressions would just punish
+rig drift. ``*step_time*`` metrics gate lower-is-better; everything
+else higher-is-better.
+
+**Record mode** — gate one run's telemetry record (the
+``tools/telemetry_report.py --json`` output) against a stamped floors
+file::
+
+    python tools/bench_gate.py --record report.json --floors floors.json
+    python tools/bench_gate.py --stamp report.json --floors floors.json
+
+``--stamp`` writes the floors file from a known-good record (step-time
+p50/p95 and peak memory as maxima; MFU, goodput, and mean throughput as
+minima). Gating tolerates ``--threshold`` (default 10%) slack around
+each floor, and keys absent from the record (e.g. ``peak_live_bytes``
+on a schema-v1 run) are skipped gracefully — reported, never failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as glob_mod
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_THRESHOLD = 0.10
+
+# Floors policy (bench.py docstring): a vs-floor comparison is only a
+# regression verdict when the record's rig fingerprint is within this
+# factor of the floor's.
+FINGERPRINT_COMPARABLE_FACTOR = 2.0
+
+# Telemetry-record gate keys: direction of the stamped bound.
+RECORD_KEYS: dict[str, str] = {
+    "step_time_p50": "max",
+    "step_time_p95": "max",
+    "peak_live_bytes": "max",
+    "mfu": "min",
+    "goodput": "min",
+    "examples_per_sec_mean": "min",
+}
+
+
+def _lower_is_better(metric: str) -> bool:
+    return "step_time" in metric
+
+
+# ---------------------------------------------------------- extraction
+
+
+def _flatten_bench_record(rec: dict) -> list[dict]:
+    """A driver head record + its extras -> flat per-metric records."""
+    backend = rec.get("backend", "")
+    out = []
+    for r in [rec] + list(rec.get("extras") or []):
+        if not isinstance(r, dict) or "metric" not in r:
+            continue
+        if "value" not in r or r.get("error"):
+            continue
+        fp = (
+            r.get("fingerprint_tflops_pre")
+            or r.get("fingerprint_tflops")
+            or rec.get("fingerprint_tflops_pre")
+            or rec.get("fingerprint_tflops")
+            or rec.get("probe_tflops_at_bench")
+        )
+        out.append(
+            {
+                "metric": r["metric"],
+                "value": float(r["value"]),
+                "backend": r.get("backend", backend),
+                "fingerprint": float(fp) if fp else None,
+            }
+        )
+    return out
+
+
+def _records_from_tail(tail: str) -> list[dict]:
+    """Recover per-metric records from a truncated driver tail.
+
+    The driver keeps only the last N chars of the bench output, so the
+    one JSON line is usually torn at the front; individual
+    ``{"metric": ..., "value": ...}`` fragments survive whole (dict
+    insertion order pins the key order). Each fragment's fingerprint is
+    the first ``fingerprint_tflops_pre`` that FOLLOWS it — per-record
+    fingerprints trail their record in the serialized form.
+    """
+    metrics = [
+        (m.start(), m.group(1), float(m.group(2)))
+        for m in re.finditer(
+            r'\{"metric": "([A-Za-z0-9_]+)", "value": ([-0-9.eE+]+)', tail
+        )
+    ]
+    fps = [
+        (m.start(), float(m.group(1)))
+        for m in re.finditer(r'"fingerprint_tflops_pre": ([0-9.]+)', tail)
+    ]
+    backends = re.findall(r'"backend": "(\w+)"', tail)
+    backend = backends[-1] if backends else "tpu"
+    out = []
+    for pos, metric, value in metrics:
+        # No fingerprint following the record means ITS fingerprint was
+        # lost to truncation — None (→ skipped as not comparable), never
+        # a neighbor's.
+        fp = next((v for p, v in fps if p > pos), None)
+        out.append(
+            {
+                "metric": metric,
+                "value": value,
+                "backend": backend,
+                "fingerprint": fp,
+            }
+        )
+    return out
+
+
+def extract_records(path: str) -> list[dict]:
+    """Per-metric records from one trajectory file (or a bare record)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        return []
+    if isinstance(doc.get("parsed"), dict):
+        return _flatten_bench_record(doc["parsed"])
+    if "metric" in doc:  # a bare bench record (synthetic gate inputs)
+        return _flatten_bench_record(doc)
+    return _records_from_tail(doc.get("tail", "") or "")
+
+
+# ---------------------------------------------------- trajectory gate
+
+
+def gate_trajectory(paths: list[str], threshold: float) -> int:
+    import bench  # floors + policy live with the bench driver
+
+    latest: dict[tuple[str, str], tuple[str, dict]] = {}
+    for path in sorted(paths):
+        for rec in extract_records(path):
+            latest[(rec["backend"], rec["metric"])] = (
+                os.path.basename(path), rec,
+            )
+    if not latest:
+        print(
+            "bench_gate: no per-metric records recovered from "
+            f"{len(paths)} file(s) — refusing to report green on an "
+            "empty gate",
+            file=sys.stderr,
+        )
+        return 2
+
+    failures, passed, skipped = [], [], []
+    for (backend, metric), (src, rec) in sorted(latest.items()):
+        floor = bench.FLOORS.get(backend, {}).get(metric)
+        if floor is None:
+            skipped.append(f"{metric} [{backend}] ({src}): no stamped floor")
+            continue
+        floor_value, floor_fp = floor
+        fp = rec["fingerprint"]
+        if not fp and floor_fp:
+            # A record whose fingerprint was lost (tail truncation)
+            # cannot satisfy the comparability precondition — skipping
+            # it is the floors policy, gating it would punish rig drift.
+            skipped.append(
+                f"{metric} [{backend}] ({src}): no rig fingerprint "
+                "recovered for the record — comparability unknown "
+                "(floors policy), not gated"
+            )
+            continue
+        if fp and floor_fp:
+            ratio = fp / floor_fp
+            if not (
+                1.0 / FINGERPRINT_COMPARABLE_FACTOR
+                <= ratio
+                <= FINGERPRINT_COMPARABLE_FACTOR
+            ):
+                skipped.append(
+                    f"{metric} [{backend}] ({src}): rig fingerprint "
+                    f"{fp:,.0f} vs floor's {floor_fp:,.0f} is outside the "
+                    f"{FINGERPRINT_COMPARABLE_FACTOR:g}x comparability "
+                    "window (floors policy) — read rel_mfu instead"
+                )
+                continue
+        value = rec["value"]
+        if _lower_is_better(metric):
+            bad = value > floor_value * (1.0 + threshold)
+            rel = value / floor_value if floor_value else float("inf")
+        else:
+            bad = value < floor_value * (1.0 - threshold)
+            rel = value / floor_value if floor_value else 0.0
+        line = (
+            f"{metric} [{backend}] ({src}): {value:,.4f} vs floor "
+            f"{floor_value:,.4f} ({rel:,.3f}x, "
+            f"{'lower' if _lower_is_better(metric) else 'higher'}-is-better)"
+        )
+        (failures if bad else passed).append(line)
+
+    for name, rows in (("PASS", passed), ("SKIP", skipped),
+                       ("FAIL", failures)):
+        for row in rows:
+            print(f"[{name}] {row}")
+    print(
+        f"bench_gate trajectory: {len(passed)} passed, {len(skipped)} "
+        f"skipped, {len(failures)} regressed (threshold "
+        f"{threshold:.0%})"
+    )
+    return 1 if failures else 0
+
+
+# -------------------------------------------------------- record gate
+
+
+def gate_record(record_path: str, floors_path: str, threshold: float) -> int:
+    with open(record_path) as f:
+        record = json.load(f)
+    with open(floors_path) as f:
+        floors = json.load(f)
+
+    failures, passed, skipped = [], [], []
+    for key, spec in sorted(floors.items()):
+        if not isinstance(spec, dict) or not ({"max", "min"} & spec.keys()):
+            skipped.append(f"{key}: malformed floor spec {spec!r}")
+            continue
+        value = record.get(key)
+        if value is None:
+            # Graceful v1 degrade: a record predating the field (e.g.
+            # peak_live_bytes before schema v2) skips, never fails.
+            skipped.append(f"{key}: absent from record")
+            continue
+        if "max" in spec:
+            bound = float(spec["max"])
+            bad = value > bound * (1.0 + threshold)
+            line = f"{key}: {value:,.6g} vs max {bound:,.6g}"
+        else:
+            bound = float(spec["min"])
+            bad = value < bound * (1.0 - threshold)
+            line = f"{key}: {value:,.6g} vs min {bound:,.6g}"
+        (failures if bad else passed).append(line)
+
+    if not passed and not failures:
+        print(
+            "bench_gate: floors file gated nothing (every key absent or "
+            "malformed) — refusing to report green",
+            file=sys.stderr,
+        )
+        return 2
+    for name, rows in (("PASS", passed), ("SKIP", skipped),
+                       ("FAIL", failures)):
+        for row in rows:
+            print(f"[{name}] {row}")
+    print(
+        f"bench_gate record: {len(passed)} passed, {len(skipped)} "
+        f"skipped, {len(failures)} regressed (threshold {threshold:.0%})"
+    )
+    return 1 if failures else 0
+
+
+def stamp_floors(record_path: str, floors_path: str) -> int:
+    with open(record_path) as f:
+        record = json.load(f)
+    floors = {}
+    for key, direction in RECORD_KEYS.items():
+        value = record.get(key)
+        if value is not None:
+            floors[key] = {direction: value}
+    if not floors:
+        print(
+            f"bench_gate: nothing stampable in {record_path} (keys "
+            f"{sorted(RECORD_KEYS)})",
+            file=sys.stderr,
+        )
+        return 2
+    with open(floors_path, "w") as f:
+        json.dump(floors, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"stamped {len(floors)} floor(s) -> {floors_path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "trajectory", nargs="*",
+        help="BENCH_*.json files (or bare bench records) to gate against "
+        "bench.py FLOORS; globs accepted",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=DEFAULT_THRESHOLD,
+        help="allowed relative slack around each floor (default 0.10)",
+    )
+    ap.add_argument(
+        "--record", metavar="REPORT_JSON",
+        help="gate one telemetry_report --json record instead",
+    )
+    ap.add_argument(
+        "--floors", metavar="FLOORS_JSON",
+        help="stamped floors file for --record / --stamp",
+    )
+    ap.add_argument(
+        "--stamp", metavar="REPORT_JSON",
+        help="write --floors from this known-good record, then exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.stamp:
+        if not args.floors:
+            ap.error("--stamp requires --floors")
+        return stamp_floors(args.stamp, args.floors)
+    if args.record:
+        if not args.floors:
+            ap.error("--record requires --floors")
+        return gate_record(args.record, args.floors, args.threshold)
+
+    paths: list[str] = []
+    for pat in args.trajectory:
+        hits = sorted(glob_mod.glob(pat))
+        paths.extend(hits if hits else [pat])
+    if not paths:
+        ap.error("no trajectory files given (and no --record)")
+    missing = [p for p in paths if not os.path.isfile(p)]
+    if missing:
+        print(f"bench_gate: missing file(s): {missing}", file=sys.stderr)
+        return 2
+    return gate_trajectory(paths, args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

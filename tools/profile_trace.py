@@ -33,14 +33,16 @@ import glob
 import json
 import os
 import shutil
-import statistics
 import sys
-import time
 
 # Must be set before ANY google.protobuf import (TF's plugin protos are
 # stale vs the image's C++ protobuf): pure-python parsing is slower but
 # always compatible.
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+# NB: PROFILE_DUTY_CYCLE stays unset here — this tool's _convert()
+# already runs the (heavy) overview_page conversion on the same
+# xplanes for banking; duplicating it inside the in-loop window would
+# convert every trace twice.
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -60,10 +62,17 @@ BANK_DIR = os.path.join(
 
 
 def _trace_gpt2(steps: int = 10, warmup: int = 5) -> dict:
-    """Run the gpt2 bench shape; trace ``steps`` launches."""
-    import jax
+    """Run the gpt2 bench shape; trace ``steps`` launches.
 
+    Capture is delegated to the trainer's in-loop profiler window
+    (``profile_start_step``/``profile_num_steps``/``profile_dir``,
+    telemetry/profiling.py) — the same code path ``--profile`` uses in
+    production runs — so this tool keeps only the xplane-conversion and
+    banking protocol. The warmup steps run before the window opens, so
+    jit compilation never pollutes the trace.
+    """
     from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.telemetry import registry as registry_mod
     from tensorflow_examples_tpu.train.loop import Trainer
     from tensorflow_examples_tpu.workloads import gpt2
 
@@ -77,36 +86,38 @@ def _trace_gpt2(steps: int = 10, warmup: int = 5) -> dict:
         fused_ce=tpu,
         log_every=10**9,
         checkpoint_every=0,
-        train_steps=10**6,
+        train_steps=warmup + steps,
         watchdog_secs=0,
+        preempt_checkpoint=False,
+        telemetry_sinks="",
+        telemetry_trace=False,
+        profile_start_step=warmup,
+        profile_num_steps=steps,
+        profile_dir=TRACE_DIR,
         **({} if tpu else dict(num_layers=2, num_heads=2, d_model=64,
                                vocab_size=512)),
     )
+    shutil.rmtree(TRACE_DIR, ignore_errors=True)
     trainer = Trainer(gpt2.make_task(cfg), cfg, mesh=bench._chip_mesh())
     it = train_iterator(gpt2.datasets(cfg)[0], cfg.global_batch_size, seed=0)
-    batches = [trainer._put_batch(next(it)) for _ in range(4)]
-    state = trainer.state
-    for i in range(warmup):
-        state, _ = trainer._train_step(state, batches[i % 4])
-    jax.block_until_ready(state.params)
-
-    shutil.rmtree(TRACE_DIR, ignore_errors=True)
-    jax.profiler.start_trace(TRACE_DIR)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        with jax.profiler.StepTraceAnnotation("train", step_num=i):
-            state, _ = trainer._train_step(state, batches[i % 4])
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
-    jax.profiler.stop_trace()
-    tokens = cfg.global_batch_size * cfg.seq_len * steps
-    return {
+    trainer.fit(it, num_steps=cfg.train_steps)
+    gauges = registry_mod.default_registry().gauge_values()
+    traced = int(gauges.get("profile/steps", 0) or 0)
+    dt = float(gauges.get("profile/wall_secs", 0.0) or 0.0)
+    tokens = cfg.global_batch_size * cfg.seq_len * traced
+    out = {
         "batch": cfg.global_batch_size,
         "seq": cfg.seq_len,
-        "traced_steps": steps,
-        "step_ms_during_trace": round(dt / steps * 1e3, 3),
-        "tokens_per_sec_during_trace": round(tokens / dt, 1),
+        "traced_steps": traced,
+        "step_ms_during_trace": (
+            round(dt / traced * 1e3, 3) if traced and dt else None
+        ),
+        "tokens_per_sec_during_trace": round(tokens / dt, 1) if dt else None,
     }
+    duty = gauges.get("profile/device_duty_cycle")
+    if duty is not None:
+        out["device_duty_cycle_inloop"] = round(float(duty), 4)
+    return out
 
 
 def _convert(xplanes: list) -> dict:

@@ -20,6 +20,12 @@ span timeline), and prints:
 * goodput + the resilience/IO counters behind it (bad steps, rollbacks,
   steps lost, preemptions, batch skips, IO retries)
 * per-phase host time from the trace (where the loop's wall time went)
+* device-side facts when the run recorded them (schema v2, ISSUE 3):
+  peak live-memory watermark + the params/opt/other init breakdown,
+  compile count + post-warmup recompile warnings, the in-loop profiler
+  window cross-link, and the observed device duty cycle next to the
+  analytic MFU. v1 runs simply omit these lines — absent fields degrade
+  gracefully.
 
 ``--json`` additionally writes one machine-readable record with the
 same numbers — shaped for dropping into future BENCH_*.json entries.
@@ -116,6 +122,8 @@ def summarize(lines: list[dict], trace: dict | None) -> dict:
     windows = [l for l in lines if l["kind"] == "window"]
     evals = [l for l in lines if l["kind"] == "eval"]
     finals = [l for l in lines if l["kind"] == "final"]
+    memories = [l for l in lines if l["kind"] == "memory"]
+    compile_warnings = [l for l in lines if l["kind"] == "compile_warning"]
     last = lines[-1]
     sessions = _split_sessions(lines)
     counters = _aggregate_counters(sessions)
@@ -157,6 +165,30 @@ def summarize(lines: list[dict], trace: dict | None) -> dict:
         "flops_per_step": gauges.get("telemetry/flops_per_step"),
         "peak_flops_total": gauges.get("telemetry/peak_flops_total"),
     }
+    # ----- schema-v2 device-side fields (None/absent on v1 runs) -----
+    last_memory = next(
+        (l["memory"] for l in reversed(lines)
+         if isinstance(l.get("memory"), dict)),
+        None,
+    )
+    record["memory"] = last_memory
+    record["peak_live_bytes"] = (last_memory or {}).get("peak_live_bytes")
+    record["memory_breakdown"] = (
+        memories[-1]["memory"] if memories else None
+    )
+    record["compiles"] = counters.get("compile/count")
+    record["recompiles"] = counters.get("compile/recompiles")
+    record["compile_warnings"] = [
+        {"step": l["step"], **l.get("compile", {})}
+        for l in compile_warnings
+    ]
+    record["profile"] = next(
+        (l["profile"] for l in reversed(finals) if "profile" in l), None
+    )
+    # From derived ONLY: the hub publishes it per fit, while the gauge
+    # is process-global and would attribute an earlier fit's
+    # measurement to this record.
+    record["device_duty_cycle"] = derived.get("device_duty_cycle")
     if trace is not None:
         phases: dict[str, dict] = {}
         for ev in trace.get("traceEvents", []):
@@ -209,9 +241,18 @@ def render(record: dict, skipped: int) -> str:
         + _fmt(p95 * 1e3 if p95 is not None else None, "ms")
     )
     mfu = record["mfu"]
+    duty = record.get("device_duty_cycle")
     out.append(
         "mfu estimate: "
         + (_fmt(mfu * 100, "%", nd=4) if mfu is not None else "n/a")
+        + " (6ND analytic"
+        + (
+            f"; observed device duty cycle {_fmt(duty * 100, '%', nd=1)} "
+            "from the profiler window"
+            if duty is not None
+            else ""
+        )
+        + ")"
         + (
             " (peak FLOPs GUESSED — unknown device kind; set "
             "--telemetry_peak_tflops for a real estimate)"
@@ -237,6 +278,43 @@ def render(record: dict, skipped: int) -> str:
         f"{c.get('checkpoint/saves', 0)} saved / "
         f"{c.get('checkpoint/restores', 0)} restored"
     )
+    # ----- schema-v2 device-side sections (omitted for v1 runs) -----
+    mem = record.get("memory")
+    if mem and mem.get("peak_live_bytes") is not None:
+        line = f"memory: peak live {mem['peak_live_bytes'] / 2**20:,.1f}MiB"
+        bd = record.get("memory_breakdown")
+        if bd:
+            line += (
+                f" (at init: params {bd.get('params_bytes', 0) / 2**20:,.1f}"
+                f" / opt {bd.get('opt_bytes', 0) / 2**20:,.1f}"
+                f" / other {bd.get('other_bytes', 0) / 2**20:,.1f} MiB)"
+            )
+        if mem.get("device_peak_bytes_in_use") is not None:
+            line += (
+                f"; device allocator peak "
+                f"{mem['device_peak_bytes_in_use'] / 2**20:,.1f}MiB"
+            )
+        out.append(line)
+    if record.get("compiles") is not None:
+        warns = record.get("compile_warnings") or []
+        line = (
+            f"compiles: {record['compiles']} "
+            f"({record.get('recompiles') or 0} post-warmup recompile(s), "
+            f"{len(warns)} warning line(s))"
+        )
+        out.append(line)
+        for w in warns[:5]:
+            out.append(
+                f"  RECOMPILE step {w.get('step')} {w.get('fn')}: "
+                f"{w.get('delta')}"
+            )
+    prof = record.get("profile")
+    if prof:
+        out.append(
+            f"profiler window: {prof.get('num_steps')} step(s) from "
+            f"run-relative step {prof.get('start_step')} in "
+            f"{_fmt(prof.get('wall_secs'), 's')} -> {prof.get('dir')}"
+        )
     if "trace_phases" in record:
         out.append("host time by span (from trace.json):")
         for name, p in record["trace_phases"].items():

@@ -26,6 +26,11 @@ Layer map:
 * ``profiling``   — programmable one-shot ``jax.profiler`` windows
                     (TrainConfig ``profile_start_step``/``num_steps``/
                     ``dir``) cross-linked from the run's final line.
+* ``fleet``       — per-host health-vector allgather, slowest-host /
+                    skew-ratio attribution, ``kind="fleet"`` lines and
+                    the straggler warning (ISSUE 4).
+* ``serve``       — the opt-in per-process /metrics (Prometheus text),
+                    /health, /window HTTP endpoints (ISSUE 4).
 * ``hub``         — the ``Telemetry`` object the trainer owns, tying the
                     above together per run.
 """
@@ -38,6 +43,9 @@ from tensorflow_examples_tpu.telemetry.accounting import (  # noqa: F401
 )
 from tensorflow_examples_tpu.telemetry.compilation import (  # noqa: F401
     CompilationSentinel,
+)
+from tensorflow_examples_tpu.telemetry.fleet import (  # noqa: F401
+    FleetMonitor,
 )
 from tensorflow_examples_tpu.telemetry.hub import Telemetry  # noqa: F401
 from tensorflow_examples_tpu.telemetry.memory import (  # noqa: F401
@@ -56,6 +64,10 @@ from tensorflow_examples_tpu.telemetry.registry import (  # noqa: F401
 from tensorflow_examples_tpu.telemetry.schema import (  # noqa: F401
     SCHEMA_VERSION,
     validate_line,
+)
+from tensorflow_examples_tpu.telemetry.serve import (  # noqa: F401
+    MetricsServer,
+    render_prometheus,
 )
 from tensorflow_examples_tpu.telemetry.spans import (  # noqa: F401
     Tracer,

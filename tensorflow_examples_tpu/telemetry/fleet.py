@@ -1,0 +1,291 @@
+"""Fleet observability: per-host skew, straggler attribution (ISSUE 4).
+
+At pod scale the failure mode that matters is *one slow host*, not a
+slow mean (arXiv:1909.09756: per-replica skew and input-pipeline
+stragglers dominate TPU-v3 pod scaling). The single-process telemetry
+stack (hub.py) can only see this host; everything cross-host it had was
+a counter sum. This module adds the fleet view:
+
+* Every log window, each host builds a SMALL FIXED VECTOR of its own
+  health numbers — step-time p50/p95, data-fetch p95, steps lost,
+  live-memory peak watermark — and the fleet allgathers them
+  (``multihost_utils.process_allgather``; fixed shape on every process,
+  so the collective can never diverge).
+* Every host then derives the identical fleet summary: per-host
+  breakdown, the slowest host (step-time p95 argmax), the **skew
+  ratio** (slowest p95 / fleet median p95), and — when the ratio
+  crosses ``TrainConfig.straggler_skew_factor`` — a straggler verdict
+  with **side attribution**: input-side if the host's data-fetch excess
+  explains its step-time excess (the prefetch queue back-pressures the
+  loop, so a starved input pipeline surfaces in ``data_fetch``),
+  compute-side otherwise (slow chip, thermal throttle, a host busy
+  elsewhere).
+* The summary lands as a ``kind="fleet"`` schema-v3 JSONL line (host
+  0's metrics.jsonl is the run record; every host's shard carries it
+  too), and the straggler verdict is logged at WARNING on host 0
+  naming the host and the side.
+
+Single-process runs emit the same line with a one-host fleet — the
+whole path (vector, summary, schema, report rendering) stays exercised
+in CPU CI, and the collective is skipped entirely.
+
+The watchdog-fatal path calls ``snapshot()`` instead of ``gather()``:
+the dying host must never enter a collective its peers may not reach,
+so the emergency fleet line replays the last gathered summary (marked
+``"emergency": true``) with no cross-host traffic.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Callable, Mapping
+
+import numpy as np
+
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+from tensorflow_examples_tpu.telemetry import schema
+
+log = logging.getLogger(__name__)
+
+# The allgathered per-host vector, in order. FIXED SET: the collective
+# must have identical shape on every process (same rule as
+# hub.HOST_LOCAL_COUNTERS). Absent values travel as NaN. Aliases the
+# schema's per-host key contract so writer and validator cannot drift.
+VECTOR_KEYS = schema.FLEET_HOST_KEYS
+
+# Side attribution: the straggler is input-side when its data-fetch
+# excess (vs the fleet median) covers at least this fraction of its
+# step-time excess — the fetch IS the stall; otherwise compute-side.
+INPUT_SIDE_FRACTION = 0.5
+
+
+def _finite_median(vals: np.ndarray) -> float:
+    finite = vals[np.isfinite(vals)]
+    return float(np.median(finite)) if finite.size else float("nan")
+
+
+def _num(v: float) -> float | int | None:
+    """NaN (the wire encoding of 'absent') -> None for the JSONL line."""
+    if not math.isfinite(v):
+        return None
+    return int(v) if float(v).is_integer() else float(v)
+
+
+class FleetMonitor:
+    """Per-fit fleet bookkeeping: one ``gather()`` per log window, a
+    collective-free cached ``snapshot()`` for emergency paths."""
+
+    def __init__(
+        self,
+        *,
+        skew_factor: float = 2.0,
+        registry=None,
+        allgather: Callable[[np.ndarray], np.ndarray] | None = None,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        self.skew_factor = float(skew_factor)
+        self._registry = registry
+        # Injectable for the mocked-allgather tests; None = the real
+        # multihost_utils collective (resolved lazily — single-process
+        # runs never import it).
+        self._allgather = allgather
+        self._process_index = process_index
+        self._process_count = process_count
+        self._last: dict | None = None  # cached summary (emergency path)
+        self._warned_hosts: set[int] = set()  # one warning per straggler
+
+    @classmethod
+    def from_config(cls, cfg) -> "FleetMonitor":
+        return cls(
+            skew_factor=float(
+                getattr(cfg, "straggler_skew_factor", 2.0) or 0.0
+            ),
+        )
+
+    # ------------------------------------------------------------- intake
+
+    def _reg(self):
+        return (
+            self._registry
+            if self._registry is not None
+            else registry_mod.default_registry()
+        )
+
+    def _topology(self) -> tuple[int, int]:
+        if self._process_index is not None and self._process_count is not None:
+            return self._process_index, self._process_count
+        import jax
+
+        return jax.process_index(), jax.process_count()
+
+    def local_vector(self, counters: Mapping[str, int]) -> np.ndarray:
+        """This host's health vector (``VECTOR_KEYS`` order, NaN =
+        absent). ``counters`` must be the LOCAL (pre-reduction)
+        fit-delta counters: io_retries and batches_skipped are exactly
+        the entries the cross-host reduction replaces with fleet sums,
+        and their per-host values are what localizes a flaky host."""
+        reg = self._reg()
+        step_p50, step_p95 = reg.histogram("step_time").percentiles(50, 95)
+        (fetch_p95,) = reg.histogram("span/data_fetch").percentiles(95)
+        peak = reg.gauge("memory/peak_live_bytes").value
+        nan = float("nan")
+        # float32: the collective goes through jnp, and the default JAX
+        # config silently downcasts f64 anyway — be explicit. Watermark
+        # bytes lose sub-KiB precision at GiB scale, which is noise at
+        # the granularity skew attribution works at.
+        return np.asarray(
+            [
+                step_p50 if step_p50 is not None else nan,
+                step_p95 if step_p95 is not None else nan,
+                fetch_p95 if fetch_p95 is not None else nan,
+                float(counters.get("resilience/steps_lost", 0)),
+                float(peak) if peak is not None else nan,
+                float(counters.get("io/retries", 0)),
+                float(counters.get("data/batches_skipped", 0)),
+            ],
+            np.float32,
+        )
+
+    # ------------------------------------------------------------ summary
+
+    def gather(self, counters: Mapping[str, int]) -> dict:
+        """Allgather every host's vector and derive the fleet summary.
+
+        COLLECTIVE (when process_count > 1): must be called at the same
+        point on every process — the cadenced window path only, never an
+        abnormal-exit path (use ``snapshot()`` there).
+        """
+        vec = self.local_vector(counters)
+        index, count = self._topology()
+        if count > 1:
+            gather = self._allgather
+            if gather is None:
+                from jax.experimental import multihost_utils
+
+                gather = multihost_utils.process_allgather
+            matrix = np.asarray(gather(vec), np.float64).reshape(
+                count, len(VECTOR_KEYS)
+            )
+        else:
+            matrix = vec[None, :]
+        summary = self._summarize(matrix)
+        self._last = summary
+        if summary["straggler"] and index == 0:
+            self._warn(summary)
+        return summary
+
+    def _summarize(self, matrix: np.ndarray) -> dict:
+        hosts = [
+            {"host": h, **{k: _num(row[i]) for i, k in enumerate(VECTOR_KEYS)}}
+            for h, row in enumerate(matrix)
+        ]
+        p95 = matrix[:, VECTOR_KEYS.index("step_time_p95")]
+        fetch = matrix[:, VECTOR_KEYS.index("data_fetch_p95")]
+        summary: dict = {
+            "hosts": hosts,
+            "slowest_host": None,
+            "skew": None,
+            "side": None,
+            "straggler": False,
+        }
+        if not np.isfinite(p95).any():
+            return summary  # pre-first-window: no step times yet
+        slowest = int(np.nanargmax(p95))
+        # The skew baseline EXCLUDES the slowest host: in a small fleet
+        # the straggler would otherwise dilute its own denominator (a
+        # 5x-slow host in a 2-host fleet reads as 1.7x against the
+        # all-host median). One-host fleets fall back to themselves.
+        others = np.delete(p95, slowest)
+        median_p95 = _finite_median(others if others.size else p95)
+        summary["slowest_host"] = slowest
+        if median_p95 > 0 and math.isfinite(p95[slowest]):
+            skew = float(p95[slowest] / median_p95)
+            summary["skew"] = skew
+            others_fetch = np.delete(fetch, slowest)
+            summary["side"] = self._attribute_side(
+                p95[slowest], median_p95, fetch[slowest],
+                _finite_median(others_fetch if others_fetch.size else fetch),
+            )
+            summary["straggler"] = (
+                self.skew_factor > 0
+                and len(hosts) > 1
+                and skew >= self.skew_factor
+            )
+        return summary
+
+    @staticmethod
+    def _attribute_side(
+        host_p95: float,
+        median_p95: float,
+        host_fetch: float,
+        median_fetch: float,
+    ) -> str:
+        """Compute- vs input-side: does the host's data-fetch excess
+        explain its step-time excess? The loop's step clock contains the
+        fetch (the prefetch deque back-pressures), so an input-starved
+        host inflates BOTH; a slow chip inflates only the step time."""
+        step_excess = max(host_p95 - median_p95, 0.0)
+        if not math.isfinite(host_fetch):
+            return "compute"  # no fetch evidence: blame the device side
+        base_fetch = median_fetch if math.isfinite(median_fetch) else 0.0
+        fetch_excess = max(host_fetch - base_fetch, 0.0)
+        if step_excess <= 0:
+            return "compute"
+        return (
+            "input"
+            if fetch_excess >= INPUT_SIDE_FRACTION * step_excess
+            else "compute"
+        )
+
+    def _warn(self, summary: dict) -> None:
+        host = summary["slowest_host"]
+        if host in self._warned_hosts:
+            return  # one warning per straggling host per fit
+        self._warned_hosts.add(host)
+        entry = summary["hosts"][host]
+        log.warning(
+            "FLEET STRAGGLER: host %d step-time p95 %.4fs is %.2fx the "
+            "fleet median (skew threshold %.2f) — %s-side (data-fetch "
+            "p95 %s)",
+            host,
+            entry["step_time_p95"] or float("nan"),
+            summary["skew"],
+            self.skew_factor,
+            summary["side"],
+            f"{entry['data_fetch_p95']:.4f}s"
+            if entry["data_fetch_p95"] is not None
+            else "n/a",
+        )
+
+    # ---------------------------------------------------------- emergency
+
+    def snapshot(self, counters: Mapping[str, int] | None = None) -> dict:
+        """A collective-free fleet payload for abnormal-exit paths: the
+        last gathered summary when one exists (peers' numbers as of the
+        last healthy window — exactly the forensics a hung run needs),
+        else this host alone (``counters`` = the caller's fit-delta
+        counters, so steps_lost is real even when the run wedged before
+        its first window). Never blocks, never enters a collective."""
+        if self._last is not None:
+            return dict(self._last, emergency=True)
+        try:
+            index, _ = self._topology()
+        except Exception:  # pragma: no cover - dying anyway; best effort
+            index = 0
+        vec = self.local_vector(counters or {})
+        hosts = [
+            {
+                "host": index,
+                **{k: _num(vec[i]) for i, k in enumerate(VECTOR_KEYS)},
+            }
+        ]
+        return {
+            "hosts": hosts,
+            "slowest_host": None,
+            "skew": None,
+            "side": None,
+            "straggler": False,
+            "emergency": True,
+        }

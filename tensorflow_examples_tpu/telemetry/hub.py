@@ -22,6 +22,14 @@ summing them would inflate by process_count. Only the counters in
 summed over processes (a fixed name set, so the collective has
 identical shape on every host); every host then computes the identical
 line and process 0's JSONL is the run record.
+
+Fleet layer (ISSUE 4): every line carries a ``host`` field (schema v3),
+every cadenced window the attached ``FleetMonitor`` allgathers the
+per-host health vector and the summary lands as a ``kind="fleet"`` line
+right after the window line, and an attached ``MetricsServer`` exposes
+/metrics, /health, and /window live (the hub keeps ``last_line`` for
+it). The emergency path additionally snapshots the fleet state
+(collective-free) and closes the server before exit 87.
 """
 
 from __future__ import annotations
@@ -64,6 +72,8 @@ class Telemetry:
         trace_file: str | None = None,
         flush_every: int = 1,
         memory=None,
+        fleet=None,
+        host: int | None = None,
     ):
         self.sinks = sinks
         self.registry = (
@@ -84,6 +94,26 @@ class Telemetry:
         # monitor (None = no memory fields on lines) and the profiler-
         # window cross-link carried on the final line.
         self.memory = memory
+        # Fleet observability (ISSUE 4): the per-host skew monitor (None
+        # = no fleet lines), the host index stamped on every line, the
+        # latest emitted line (for the /window endpoint), and the
+        # optional live-metrics server closed on the emergency path.
+        self.fleet = fleet
+        if host is None:
+            try:
+                import jax
+
+                host = jax.process_index()
+            except Exception:  # pragma: no cover - pre-init edge
+                host = 0
+        self.host = int(host)
+        # last_line carries the latest NON-fleet line (the /window
+        # endpoint's payload — a fleet line right after every window
+        # would otherwise hide the metrics a watcher wants); the fleet
+        # stream gets its own slot for /fleet.
+        self.last_line: dict | None = None
+        self.last_fleet_line: dict | None = None
+        self.server = None  # MetricsServer, attached by the trainer
         self.profile_info: dict | None = None
         # Observed duty cycle is PER FIT (set by this fit's profiler
         # window, never read from the process-global gauge: a later fit
@@ -142,6 +172,7 @@ class Telemetry:
             and jax.process_index() == 0
             else None
         )
+        from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
         from tensorflow_examples_tpu.telemetry import memory as memory_mod
 
         return cls(
@@ -153,6 +184,7 @@ class Telemetry:
             trace_file=trace_file,
             flush_every=getattr(cfg, "telemetry_flush_every", 1),
             memory=memory_mod.MemoryMonitor(),
+            fleet=fleet_mod.FleetMonitor.from_config(cfg),
         )
 
     # ------------------------------------------------------------ intake
@@ -179,8 +211,10 @@ class Telemetry:
             for k, v in self.registry.counter_values().items()
         }
 
-    def _reduced_counters(self) -> dict[str, int]:
-        values = self._fit_counters()
+    def _reduced_counters(self, values=None) -> dict[str, int]:
+        values = (
+            dict(values) if values is not None else self._fit_counters()
+        )
         import jax
 
         if jax.process_count() == 1:
@@ -251,12 +285,20 @@ class Telemetry:
         (the ``"compile"`` payload of a compile_warning, the
         ``"memory"`` breakdown of a memory snapshot line).
         """
+        # Local fit-delta counters are captured BEFORE the cross-host
+        # reduction: the fleet vector must carry each host's OWN
+        # io/batch-skip numbers (the reduction replaces them with fleet
+        # sums — identical on every host, useless for localization).
+        local_counters = self._fit_counters()
         counters = (
-            self._reduced_counters() if reduce else self._fit_counters()
+            self._reduced_counters(local_counters)
+            if reduce
+            else local_counters
         )
         line = {
             "schema_version": schema.SCHEMA_VERSION,
             "kind": kind,
+            "host": self.host,
             "step": int(step),
             "time_unix": time.time(),
             "session_start_unix": self._session_start,
@@ -297,10 +339,45 @@ class Telemetry:
                     "telemetry sink %s failed to write (continuing)",
                     type(sink).__name__,
                 )
-        self._windows_since_flush += 1
-        if self._windows_since_flush >= self.flush_every:
-            self.flush()
+        if kind == "fleet":
+            self.last_fleet_line = line
+        elif kind in ("window", "eval", "final"):
+            # /window's contract: the latest SCALAR line. Memory and
+            # compile_warning snapshots are JSONL-record material and
+            # must not displace the window a watcher reads loss from.
+            self.last_line = line
+        # Fleet summary rides every cadenced window (ISSUE 4): the
+        # gather is a collective, so it runs ONLY on the reduce=True
+        # window path — the same place the counter reduction already
+        # synchronizes every host. LOCAL counters: the vector's
+        # io/skip entries are per-host evidence, not the fleet sums.
+        if kind == "window" and reduce and self.fleet is not None:
+            self._emit_fleet(step, local_counters)
+        # Flush accounting AFTER the fleet emission, and never for the
+        # fleet line itself: it rides every window, so counting it
+        # would silently halve a configured telemetry_flush_every —
+        # instead the window's own flush (below) covers both lines.
+        if kind != "fleet":
+            self._windows_since_flush += 1
+            if self._windows_since_flush >= self.flush_every:
+                self.flush()
         return line
+
+    def _emit_fleet(self, step: int, counters: Mapping[str, int]) -> None:
+        try:
+            payload = self.fleet.gather(counters)
+        except Exception:  # pragma: no cover - collective teardown races
+            log.exception("fleet gather failed (continuing)")
+            return
+        self.log_window(
+            step, {}, kind="fleet", reduce=False, extra={"fleet": payload}
+        )
+
+    def last_window_age(self) -> float | None:
+        """Seconds since the last emitted line (the /health signal)."""
+        if self.last_line is None:
+            return None
+        return max(time.time() - self.last_line["time_unix"], 0.0)
 
     def final_window(
         self,
@@ -371,19 +448,44 @@ class Telemetry:
     def emergency_flush(self) -> None:
         """Watchdog-fatal path: called from the WATCHDOG thread right
         before ``os._exit(87)`` while the main thread is wedged. Lands a
-        final marker line (local counters only — no collective, no loop
-        state: the partial window lives on the wedged thread), then
-        pushes the trace and sinks to disk. Must never block on the
-        main thread."""
+        fleet snapshot (cached — NO collective: peers may be past their
+        own matching point) and a final marker line (local counters
+        only, no loop state: the partial window lives on the wedged
+        thread), then closes the metrics server and pushes the trace
+        and sinks to disk. Must never block on the main thread."""
         self._emergency = True  # memory fields come from cache only
+        if self.fleet is not None:
+            # The hung run's last known fleet state (ISSUE 4 satellite):
+            # which host was straggling when everything stopped is
+            # exactly the forensics the postmortem needs.
+            try:
+                self.log_window(
+                    self._last_step, {}, kind="fleet", reduce=False,
+                    extra={
+                        "fleet": self.fleet.snapshot(self._fit_counters())
+                    },
+                )
+            except Exception:  # pragma: no cover - dying anyway
+                log.exception("watchdog-fatal fleet snapshot failed")
         try:
             self.final_window(
                 self._last_step, {}, exit_reason="watchdog_fatal"
             )
         except Exception:  # pragma: no cover - dying anyway; best effort
             log.exception("watchdog-fatal final line failed")
+        self.close_server()
         self.write_trace()
         self.flush()
+
+    def close_server(self) -> None:
+        """Shut the /metrics endpoint down (idempotent; all exit paths —
+        a dead run must not keep answering scrapes as if live)."""
+        server, self.server = self.server, None
+        if server is not None:
+            try:
+                server.close()
+            except Exception:  # pragma: no cover - socket teardown races
+                log.exception("metrics server close failed (continuing)")
 
     def close(self) -> None:
         """Flush everything and write the trace; idempotent (the loop's
@@ -391,6 +493,7 @@ class Telemetry:
         if self._closed:
             return
         self._closed = True
+        self.close_server()
         self.write_trace()
         for sink in self.sinks:
             try:

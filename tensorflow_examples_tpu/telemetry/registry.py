@@ -121,6 +121,14 @@ class TimeHistogram:
             samples = sorted(self._samples)
         return _nearest_rank(samples, q)
 
+    def percentiles(self, *qs: float) -> tuple[float | None, ...]:
+        """Several percentiles in ONE lock acquisition + sort (the fleet
+        vector and the /metrics endpoint read p50+p95 together every
+        window — don't pay the sort twice)."""
+        with self._lock:
+            samples = sorted(self._samples)
+        return tuple(_nearest_rank(samples, q) for q in qs)
+
     def summary(self) -> dict:
         with self._lock:
             n, total = self.count, self.total

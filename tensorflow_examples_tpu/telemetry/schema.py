@@ -1,5 +1,5 @@
 """Self-describing JSONL metrics schema (ISSUE 2 CI satellite; v2 in
-ISSUE 3).
+ISSUE 3; v3 in ISSUE 4).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -11,11 +11,12 @@ refuses lines it cannot validate rather than mis-aggregating them.
 Hand-rolled (no jsonschema dependency — the image is pip-install-free);
 the structure is small enough that explicit checks read better anyway.
 
-Line shape (version 2; version-1 lines remain valid input)::
+Line shape (version 3; version-1/-2 lines remain valid input)::
 
     {
-      "schema_version": 2,
-      "kind": "window" | "eval" | "final" | "memory" | "compile_warning",
+      "schema_version": 3,
+      "kind": "window" | "eval" | "final" | "memory" | "compile_warning"
+              | "fleet",
       "step": <int >= 0>,            # loop step the line was emitted at
       "time_unix": <float>,          # wall clock at emission
       "session_start_unix": <float>, # constant per fit-session: the
@@ -41,11 +42,28 @@ Line shape (version 2; version-1 lines remain valid input)::
       "profile": {"dir": "...", "start_step": 10, "num_steps": 10,
                   "wall_secs": 1.2}  # final lines only: cross-link to
                                      #   the in-loop profiler window
+
+      # --- version 3 additions (telemetry/fleet.py) ---
+      "host": 0,                     # REQUIRED on every v3 line: the
+                                     #   jax.process_index() that wrote it
+      "fleet": {                     # REQUIRED on (and exclusive to)
+                                     #   kind == "fleet" lines
+        "hosts": [{"host": 0, "step_time_p50": 0.01,
+                   "step_time_p95": 0.02, "data_fetch_p95": 0.001,
+                   "steps_lost": 0, "peak_live_bytes": 1024}, ...],
+        "slowest_host": 1,           # int|null: p95 argmax
+        "skew": 3.2,                 # slowest p95 / fleet median p95
+        "side": "input",             # "compute"|"input"|null: where the
+                                     #   straggler's excess time sits
+        "straggler": true,           # skew crossed straggler_skew_factor
+        "emergency": true            # optional: cached snapshot from the
+                                     #   watchdog-fatal path (no collective)
+      }
     }
 
-Version-1 lines (the pre-ISSUE-3 stream) carry none of the v2 fields
-and only the v1 kinds; they still validate, so old run dirs keep
-reporting.
+Version-1/-2 lines (the pre-ISSUE-3/-4 streams) carry none of the later
+fields and only their own kinds; they still validate, so old run dirs
+keep reporting.
 """
 
 from __future__ import annotations
@@ -53,12 +71,13 @@ from __future__ import annotations
 import numbers
 from typing import Any
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
-SUPPORTED_VERSIONS = (1, 2)
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 KINDS_V1 = ("window", "eval", "final")
-KINDS = KINDS_V1 + ("memory", "compile_warning")
+KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
+KINDS = KINDS_V2 + ("fleet",)
 
 _REQUIRED = ("schema_version", "kind", "step", "time_unix",
              "session_start_unix", "metrics", "counters", "gauges",
@@ -67,6 +86,20 @@ _REQUIRED = ("schema_version", "kind", "step", "time_unix",
 # v2-only top-level objects: forbidden on v1 lines (a "v1" line carrying
 # them is a mislabeled v2 line — flag it instead of half-validating).
 _V2_FIELDS = ("memory", "compile", "profile")
+
+# v3-only top-level fields, same rule for v1/v2 lines.
+_V3_FIELDS = ("host", "fleet")
+
+# The per-host entry of a fleet line's "hosts" list: "host" is a
+# required int, and each of these is required numeric-or-null (the
+# writer side, fleet.VECTOR_KEYS, aliases this tuple — the allgathered
+# vector and the validated line cannot drift apart). io_retries and
+# batches_skipped are each host's OWN pre-reduction numbers — the
+# line-level counters carry the fleet sums, so these entries are the
+# only place a flaky host's IO churn stays localizable.
+FLEET_HOST_KEYS = ("step_time_p50", "step_time_p95", "data_fetch_p95",
+                   "steps_lost", "peak_live_bytes", "io_retries",
+                   "batches_skipped")
 
 
 def _is_number(v: Any) -> bool:
@@ -103,7 +136,7 @@ def validate_line(obj: Any) -> list[str]:
             f"schema_version {version!r} not in {SUPPORTED_VERSIONS}"
         )
         return problems
-    kinds = KINDS_V1 if version == 1 else KINDS
+    kinds = {1: KINDS_V1, 2: KINDS_V2}.get(version, KINDS)
     if obj["kind"] not in kinds:
         problems.append(f"kind {obj['kind']!r} not in {kinds}")
     if not isinstance(obj["step"], int) or isinstance(obj["step"], bool) \
@@ -141,6 +174,9 @@ def validate_line(obj: Any) -> list[str]:
         for key in _V2_FIELDS:
             if key in obj:
                 problems.append(f"v2 field {key!r} on a schema-v1 line")
+        for key in _V3_FIELDS:
+            if key in obj:
+                problems.append(f"v3 field {key!r} on a schema-v1 line")
         return problems
 
     # ------------------------------------------------- v2 additions
@@ -195,6 +231,81 @@ def validate_line(obj: Any) -> list[str]:
                         f"profile[{key!r}] = {v!r} is not a non-negative "
                         "int"
                     )
+
+    if version == 2:
+        for key in _V3_FIELDS:
+            if key in obj:
+                problems.append(f"v3 field {key!r} on a schema-v2 line")
+        return problems
+
+    # ------------------------------------------------- v3 additions
+    host = obj.get("host")
+    if not isinstance(host, int) or isinstance(host, bool) or host < 0:
+        problems.append(f"host {host!r} is not a non-negative int")
+
+    if obj["kind"] == "fleet":
+        fleet = obj.get("fleet")
+        if not isinstance(fleet, dict):
+            problems.append("fleet line is missing the fleet object")
+        else:
+            hosts = fleet.get("hosts")
+            if not isinstance(hosts, list) or not hosts:
+                problems.append(
+                    f"fleet['hosts'] = {hosts!r} is not a non-empty list"
+                )
+            else:
+                for i, entry in enumerate(hosts):
+                    if not isinstance(entry, dict):
+                        problems.append(
+                            f"fleet['hosts'][{i}] is not an object"
+                        )
+                        continue
+                    h = entry.get("host")
+                    if not isinstance(h, int) or isinstance(h, bool) \
+                            or h < 0:
+                        problems.append(
+                            f"fleet['hosts'][{i}]['host'] = {h!r} is not "
+                            "a non-negative int"
+                        )
+                    for key in FLEET_HOST_KEYS:
+                        if key not in entry:
+                            problems.append(
+                                f"fleet['hosts'][{i}] is missing {key!r}"
+                            )
+                    for k, v in entry.items():
+                        if k != "host" and v is not None \
+                                and not _is_number(v):
+                            problems.append(
+                                f"fleet['hosts'][{i}][{k!r}] = {v!r} is "
+                                "not numeric"
+                            )
+            slowest = fleet.get("slowest_host")
+            if slowest is not None and (
+                not isinstance(slowest, int) or isinstance(slowest, bool)
+                or slowest < 0
+            ):
+                problems.append(
+                    f"fleet['slowest_host'] = {slowest!r} is not a "
+                    "non-negative int or null"
+                )
+            skew = fleet.get("skew")
+            if skew is not None and not _is_number(skew):
+                problems.append(
+                    f"fleet['skew'] = {skew!r} is not numeric or null"
+                )
+            side = fleet.get("side")
+            if side not in (None, "compute", "input"):
+                problems.append(
+                    f"fleet['side'] = {side!r} is not 'compute'/'input'/"
+                    "null"
+                )
+            if not isinstance(fleet.get("straggler", False), bool):
+                problems.append(
+                    f"fleet['straggler'] = {fleet['straggler']!r} is not "
+                    "a bool"
+                )
+    elif "fleet" in obj:
+        problems.append("fleet object on a non-fleet line")
     return problems
 
 

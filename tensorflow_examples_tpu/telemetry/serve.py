@@ -1,0 +1,307 @@
+"""Live run endpoints: /metrics, /health, /window (ISSUE 4 tentpole).
+
+Until now a live run could only be observed by tailing its JSONL — fine
+for one process on one box, useless for a pod behind a scheduler. This
+module adds an opt-in (``TrainConfig.metrics_port > 0``) stdlib
+``http.server`` thread per process serving:
+
+* ``/metrics`` — the full registry (counters, gauges, time-histograms)
+  rendered as Prometheus text exposition format: counters as
+  ``counter``, gauges as ``gauge``, histograms as ``summary`` (p50/p95/
+  p99 quantiles + ``_sum``/``_count``). Metric names are sanitized
+  (``train/steps_total`` -> ``train_steps_total``) and every sample
+  carries a ``host`` label, so one Prometheus scrape config covers the
+  whole fleet.
+* ``/health`` — JSON: watchdog phase + stall age (when a watchdog is
+  attached), the age of the last telemetry window, host index. Status
+  200 while the loop is making progress; 503 once the watchdog reports
+  a stall older than its timeout (a scrape-friendly liveness signal).
+* ``/window`` — the latest window/eval/final line verbatim (the same
+  schema-v3 object the sinks got), 404 before the first window.
+* ``/fleet`` — the latest ``kind="fleet"`` line (per-host skew +
+  straggler verdict), 404 before the first fleet summary.
+
+Design constraints:
+
+* **Stdlib only** (the image is pip-install-free): ``ThreadingHTTPServer``
+  with daemon threads, so a wedged scraper can never wedge the trainer.
+* **Read-only and lock-light**: handlers read registry snapshots and the
+  hub's ``last_line`` reference; they never enter a collective and never
+  touch device state.
+* **Closed on every exit path**: ``Trainer.fit``'s finally closes it
+  (complete/preempt/error), and the watchdog-fatal hook
+  (``Telemetry.emergency_flush``) closes it right before ``os._exit(87)``
+  so the port is released even on a hard kill.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import math
+import re
+import threading
+import time
+from typing import Mapping
+
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+
+log = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+# A histogram rendered as a Prometheus summary exposes these quantiles.
+_QUANTILES = ((50, "0.5"), (95, "0.95"), (99, "0.99"))
+
+
+def json_safe(obj):
+    """Non-finite floats -> null, recursively. ``json.dumps`` would
+    happily emit literal ``NaN`` tokens (not RFC-8259 JSON) and break
+    strict consumers (jq, fetch().json(), Grafana) the first time a
+    diverged run puts a NaN loss on the window line."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Registry name -> Prometheus metric name (``a/b-c`` -> ``a_b_c``;
+    a leading digit gets an underscore prefix)."""
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out or "_"
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample value: floats repr-style, NaN/Inf spelled out."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def render_prometheus(registry, *, host: int = 0) -> str:
+    """The registry as Prometheus text exposition format (version 0.0.4:
+    ``# TYPE`` comments + ``name{labels} value`` samples)."""
+    label = f'{{host="{int(host)}"}}'
+    lines: list[str] = []
+    for name, value in sorted(registry.counter_values().items()):
+        n = sanitize_metric_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n}{label} {_fmt_value(value)}")
+    for name, value in sorted(registry.gauge_values().items()):
+        n = sanitize_metric_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n}{label} {_fmt_value(value)}")
+    for name, summary in sorted(registry.histogram_summaries().items()):
+        if not summary["count"]:
+            continue
+        n = sanitize_metric_name(name) + "_seconds"
+        lines.append(f"# TYPE {n} summary")
+        for q, q_label in _QUANTILES:
+            v = summary[f"p{q}"]
+            if v is not None:
+                lines.append(
+                    f'{n}{{host="{int(host)}",quantile="{q_label}"}} '
+                    f"{_fmt_value(v)}"
+                )
+        lines.append(f"{n}_sum{label} {_fmt_value(summary['total'])}")
+        lines.append(f"{n}_count{label} {_fmt_value(summary['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """The per-process observability endpoint. ``start()`` binds and
+    serves on a daemon thread; ``close()`` is idempotent and safe from
+    any thread (including the watchdog's fatal path)."""
+
+    def __init__(
+        self,
+        registry=None,
+        *,
+        port: int = 0,
+        bind_host: str = "",
+        telemetry=None,
+        watchdog=None,
+        process_index: int | None = None,
+    ):
+        self.registry = (
+            registry
+            if registry is not None
+            else registry_mod.default_registry()
+        )
+        self.requested_port = int(port)
+        self.bind_host = bind_host
+        self.telemetry = telemetry
+        self.watchdog = watchdog
+        self._process_index = process_index
+        self.port: int | None = None  # actual bound port after start()
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, cfg, *, telemetry=None, watchdog=None):
+        """None when ``metrics_port`` is unset — the caller wires the
+        server only when the config opts in."""
+        port = int(getattr(cfg, "metrics_port", 0) or 0)
+        if port <= 0:
+            return None
+        return cls(
+            telemetry.registry if telemetry is not None else None,
+            port=port,
+            telemetry=telemetry,
+            watchdog=watchdog,
+        )
+
+    # ------------------------------------------------------------ payloads
+
+    def _host_index(self) -> int:
+        if self._process_index is not None:
+            return self._process_index
+        if self.telemetry is not None and hasattr(self.telemetry, "host"):
+            return int(self.telemetry.host)
+        return 0
+
+    def metrics_payload(self) -> str:
+        return render_prometheus(self.registry, host=self._host_index())
+
+    def health_payload(self) -> tuple[int, dict]:
+        """(http status, body). 503 = the watchdog sees a stall past its
+        timeout; 200 otherwise (including watchdog-less runs, where the
+        endpoint can only attest the process is serving)."""
+        body: dict = {"host": self._host_index(), "ok": True}
+        tel = self.telemetry
+        if tel is not None:
+            age = tel.last_window_age()
+            body["last_window_age_secs"] = age
+            last = getattr(tel, "last_line", None)
+            if last is not None:
+                body["last_step"] = last.get("step")
+                body["last_kind"] = last.get("kind")
+        wd = self.watchdog
+        if wd is not None:
+            status = wd.status()
+            body.update(
+                phase=status["phase"],
+                phase_age_secs=status["phase_age_secs"],
+                stalled_secs=status["stalled_secs"],
+                watchdog_paused=status["paused"],
+            )
+            if (
+                not status["paused"]
+                and status["timeout_secs"] > 0
+                and status["stalled_secs"] >= status["timeout_secs"]
+            ):
+                body["ok"] = False
+        return (200 if body["ok"] else 503), body
+
+    def window_payload(self) -> Mapping | None:
+        return getattr(self.telemetry, "last_line", None)
+
+    def fleet_payload(self) -> Mapping | None:
+        return getattr(self.telemetry, "last_fleet_line", None)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "MetricsServer":
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, status, content_type, body: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - http.server contract
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            server.metrics_payload().encode(),
+                        )
+                    elif path == "/health":
+                        status, body = server.health_payload()
+                        self._send(
+                            status,
+                            "application/json",
+                            (json.dumps(json_safe(body)) + "\n").encode(),
+                        )
+                    elif path in ("/window", "/fleet"):
+                        line = (
+                            server.window_payload()
+                            if path == "/window"
+                            else server.fleet_payload()
+                        )
+                        if line is None:
+                            self._send(
+                                404,
+                                "application/json",
+                                b'{"error": "nothing emitted yet"}\n',
+                            )
+                        else:
+                            self._send(
+                                200,
+                                "application/json",
+                                (json.dumps(json_safe(line)) + "\n")
+                                .encode(),
+                            )
+                    else:
+                        self._send(
+                            404,
+                            "text/plain; charset=utf-8",
+                            b"endpoints: /metrics /health /window /fleet\n",
+                        )
+                except ConnectionError:  # scraper went away mid-write
+                    pass  # (broken pipe or reset — not worth a traceback)
+
+            def log_message(self, fmt, *args):  # quiet: scrapes per window
+                log.debug("metrics server: " + fmt, *args)
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.bind_host, self.requested_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="telemetry-metrics-server",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info(
+            "telemetry endpoints live on port %d "
+            "(/metrics /health /window /fleet)",
+            self.port,
+        )
+        return self
+
+    def url(self, path: str = "/metrics") -> str:
+        host = self.bind_host or "127.0.0.1"
+        return f"http://{host}:{self.port}{path}"
+
+    def close(self) -> None:
+        """Idempotent; callable from the watchdog thread on the fatal
+        path (shutdown() only flags the serve loop — it cannot block on
+        the wedged main thread)."""
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)

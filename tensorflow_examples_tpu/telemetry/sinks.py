@@ -155,6 +155,16 @@ def metrics_path(workdir: str) -> str:
     return os.path.join(telemetry_dir(workdir), "metrics.jsonl")
 
 
+def host_metrics_path(workdir: str, host: int) -> str:
+    """Host ``k``'s telemetry shard (ISSUE 4): the per-host JSONL each
+    NON-ZERO process of a multi-host run appends its own lines to.
+    Process 0 writes no shard — ``metrics.jsonl`` already IS its
+    stream, and duplicating it would double the run-record host's
+    per-line write+flush for identical bytes (the report CLI merges
+    metrics.jsonl in as host 0)."""
+    return os.path.join(telemetry_dir(workdir), f"telemetry.host{host}.jsonl")
+
+
 def trace_path(workdir: str) -> str:
     return os.path.join(telemetry_dir(workdir), "trace.json")
 
@@ -162,9 +172,14 @@ def trace_path(workdir: str) -> str:
 def make_sinks(spec: str, workdir: str) -> list[Sink]:
     """Build the sink list from the comma-separated config spec.
 
-    File-backed sinks need a workdir (and JSONL writes on process 0
-    only — every host logs the identical reduced window, so one file
-    is the record); without one, only ``console`` materializes.
+    File-backed sinks need a workdir; without one, only ``console``
+    materializes. The ``jsonl`` sink writes the run record
+    (``metrics.jsonl``) on process 0 — the fleet lines and reduced
+    counters make one file the record — while every OTHER host of a
+    multi-host run appends to its own ``telemetry.host{k}.jsonl``
+    shard, whose derived/memory/gauge sections are genuinely host-local
+    (the per-host stream straggler triage and the shard-merging report
+    read, ISSUE 4; process 0's stream is metrics.jsonl itself).
     """
     import jax
 
@@ -177,8 +192,15 @@ def make_sinks(spec: str, workdir: str) -> list[Sink]:
             )
         if name == "console":
             sinks.append(ConsoleSink())
-        elif name == "jsonl" and workdir and jax.process_index() == 0:
-            sinks.append(JsonlSink(metrics_path(workdir)))
+        elif name == "jsonl" and workdir:
+            if jax.process_index() == 0:
+                sinks.append(JsonlSink(metrics_path(workdir)))
+            elif jax.process_count() > 1:
+                sinks.append(
+                    JsonlSink(
+                        host_metrics_path(workdir, jax.process_index())
+                    )
+                )
         elif name == "tensorboard" and workdir:
             sinks.append(TensorBoardSink(workdir))
     return sinks

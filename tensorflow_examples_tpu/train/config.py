@@ -107,6 +107,17 @@ class TrainConfig:
     telemetry_peak_tflops: float = 0.0  # per-device peak TFLOP/s for the
     #   MFU estimate; 0 = auto from the PJRT device kind (unknown kinds
     #   fall back to a labeled 1 TFLOP/s so the pipeline stays live)
+    metrics_port: int = 0  # >0: serve live observability endpoints on
+    #   this port from every process (telemetry/serve.py): /metrics
+    #   (Prometheus text from the registry), /health (watchdog phase +
+    #   last-window age; 503 on a stall), /window (latest JSONL line).
+    #   Closed on every exit path including watchdog-fatal. 0 disables.
+    straggler_skew_factor: float = 2.0  # fleet straggler threshold
+    #   (telemetry/fleet.py): when the slowest host's step-time p95
+    #   exceeds this multiple of the fleet median, the kind="fleet"
+    #   line flags it and a WARNING names the host and whether the skew
+    #   is compute- or input-side. 0 disables the warning (fleet lines
+    #   still emit).
     compile_warmup: int = 1  # expected compilations per jitted step fn
     #   (telemetry/compilation.py): the first N distinct input
     #   signatures are normal jit warmup; any compile beyond that is a

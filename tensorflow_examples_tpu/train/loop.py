@@ -35,6 +35,12 @@ peak watermark rides every window line; ``profile_start_step`` /
 ``profile_num_steps`` / ``profile_dir`` capture a programmable one-shot
 ``jax.profiler`` window cross-linked from the final line; and an OOM
 dumps allocation forensics before re-raising.
+
+Fleet observability (ISSUE 4): every cadenced window the hub allgathers
+a per-host health vector and emits a ``kind="fleet"`` line with
+slowest-host/skew attribution (``straggler_skew_factor``); with
+``metrics_port`` set, each process serves live /metrics (Prometheus),
+/health, and /window endpoints, shut down on every exit path.
 """
 
 from __future__ import annotations
@@ -438,6 +444,34 @@ class Trainer:
         )
 
         try:
+            # Live observability endpoints (ISSUE 4): opt-in per-process
+            # /metrics + /health + /window server. Attached to the hub
+            # so BOTH teardown paths reach it: telemetry.close() in the
+            # finally below (complete/preempt/error) and the watchdog-
+            # fatal emergency flush (exit 87). Inside the try so a bind
+            # failure (port in use) still unwinds the watchdog/handlers.
+            if getattr(cfg, "metrics_port", 0):
+                from tensorflow_examples_tpu.telemetry import (
+                    serve as serve_mod,
+                )
+
+                server = serve_mod.MetricsServer.from_config(
+                    cfg, telemetry=telemetry, watchdog=watchdog
+                )
+                if server is not None:
+                    try:
+                        telemetry.server = server.start()
+                    except OSError as e:
+                        # A taken port (stale process, two runs on one
+                        # box) must not kill the training job over a
+                        # read-only diagnostics endpoint.
+                        log.warning(
+                            "metrics server failed to bind port %d (%s) "
+                            "— continuing without live endpoints",
+                            server.requested_port,
+                            e,
+                        )
+
             if cfg.workdir:
                 self._ckpt = CheckpointManager(cfg.workdir)
                 if cfg.resume:

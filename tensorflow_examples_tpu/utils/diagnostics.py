@@ -104,10 +104,14 @@ class Watchdog:
         self.fatal_timeout_s = fatal_timeout_s
         self._on_hang = on_hang
         self._on_fatal = on_fatal
-        # Best-effort pre-exit flush (telemetry sinks + trace): runs on
-        # the fatal path BEFORE on_fatal/os._exit, from the watchdog
-        # thread, so the run's metrics survive the hard exit (ISSUE 2
-        # abnormal-exit satellite).
+        # Best-effort pre-exit flush: runs on the fatal path BEFORE
+        # on_fatal/os._exit, from the watchdog thread, so the run's
+        # metrics survive the hard exit (ISSUE 2 abnormal-exit
+        # satellite). The trainer passes Telemetry.emergency_flush,
+        # which also snapshots the last fleet state and closes the
+        # /metrics server (ISSUE 4) — a hung run's last per-host skew
+        # picture is never lost, and the port stops answering scrapes
+        # as if the run were live.
         self._flush_fn = flush_fn
         self._poll_s = poll_s if poll_s is not None else min(timeout_s / 4, 30.0)
         if fatal_timeout_s > 0:
@@ -141,6 +145,23 @@ class Watchdog:
         self._phase = phase
         self._phase_since = now
         self._last_ping = now
+
+    def status(self) -> dict:
+        """Live state for the /health endpoint (telemetry/serve.py):
+        current phase + how long it has been the phase, the stall age,
+        and the configured timeouts. Readable from any thread — every
+        field is a single attribute read of values the loop thread
+        writes atomically."""
+        now = time.monotonic()
+        return {
+            "phase": self._phase,
+            "phase_age_secs": now - self._phase_since,
+            "stalled_secs": now - self._last_ping,
+            "last_step": self._last_step,
+            "paused": self._paused,
+            "timeout_secs": self.timeout_s,
+            "fatal_timeout_secs": self.fatal_timeout_s,
+        }
 
     def pause(self) -> None:
         """Suspend hang detection (long known-slow phase: eval, ckpt,

@@ -148,14 +148,14 @@ def test_two_process_eval_merges_host_shards():
     assert abs(got[0][1] - ref["loss"]) < 1e-5, (got[0], ref)
 
 
-def _run_workers(worker_src, env=None, timeout=150):
+def _run_workers(worker_src, env=None, timeout=150, extra=()):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     addr = f"127.0.0.1:{port}"
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", worker_src, str(r), addr],
+            [sys.executable, "-c", worker_src, str(r), addr, *extra],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -355,6 +355,138 @@ def test_two_process_local_batches_bundled_matches_global():
     )
     assert abs(got[0][0] - ref["loss"]) < 1e-5, (got[0], ref["loss"])
     assert abs(got[0][1] - ref["accuracy"]) < 1e-6, (got[0], ref["accuracy"])
+
+
+_FLEET_WORKER = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from tensorflow_examples_tpu.core import distributed
+
+    rank = int(sys.argv[1])
+    distributed.initialize(
+        coordinator_address=sys.argv[2], num_processes=2, process_id=rank
+    )
+
+    from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+    from tensorflow_examples_tpu.data.memory import train_iterator
+    from tensorflow_examples_tpu.data.sources import synthetic_images
+    from tensorflow_examples_tpu.train.loop import Trainer
+    from tensorflow_examples_tpu.utils import faults as faults_mod
+    from tensorflow_examples_tpu.workloads import mnist
+
+    workdir = sys.argv[3]
+    cfg = mnist.MnistConfig(
+        global_batch_size=16, train_steps=8, hidden=32, num_layers=1,
+        precision="f32", log_every=4, checkpoint_every=0, resume=False,
+        watchdog_secs=0, bad_step_policy="off", workdir=workdir,
+        telemetry_sinks="jsonl", telemetry_trace=False,
+        straggler_skew_factor=2.0,
+    )
+    if rank == 1:
+        # The injected straggler: two slow input fetches on host 1 only
+        # (utils/faults.py slow-host spec) — an INPUT-side skew.
+        faults_mod.install("slow@5:1.5,slow@6:1.5")
+    mesh = create_mesh(MeshConfig(data=2))
+    trainer = Trainer(mnist.make_task(cfg), cfg, mesh=mesh)
+    ds = synthetic_images(n=128, shape=(28, 28, 1), num_classes=10, seed=0)
+    m = trainer.fit(
+        lambda start: train_iterator(ds, 16, seed=0, start_step=start),
+        num_steps=cfg.train_steps,
+    )
+    print(f"FINAL {rank} {m['loss']:.6f}", flush=True)
+    """
+)
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.telemetry
+def test_two_process_fleet_line_names_injected_straggler(tmp_path):
+    """ISSUE 4 acceptance: a REAL 2-process run with a fault-injected
+    slow host must (a) write one telemetry shard per host, (b) emit
+    kind="fleet" lines whose last summary names host 1 as an input-side
+    straggler past the skew threshold, (c) log the straggler warning on
+    host 0, and (d) feed the shard-merging report CLI, which flags the
+    slowest host."""
+    import json
+
+    workdir = str(tmp_path)
+    try:
+        outs = _run_workers(_FLEET_WORKER, timeout=270, extra=(workdir,))
+    except AssertionError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            # This jax build can't run collectives across CPU processes
+            # (the same limitation fails every 2-process test here); the
+            # mocked-allgather acceptance path is pinned CPU-green in
+            # tests/test_telemetry.py.
+            pytest.skip("no multiprocess CPU collectives in this jax build")
+        raise
+    assert any("FINAL 0" in o for o in outs)
+
+    tdir = os.path.join(workdir, "telemetry")
+    shard1 = os.path.join(tdir, "telemetry.host1.jsonl")
+    assert os.path.isfile(shard1)
+    # Process 0 writes NO shard: metrics.jsonl already is its stream
+    # (the report merges it in as host 0).
+    assert not os.path.isfile(os.path.join(tdir, "telemetry.host0.jsonl"))
+
+    from tensorflow_examples_tpu.telemetry import schema
+
+    with open(os.path.join(tdir, "metrics.jsonl")) as f:
+        lines = [json.loads(line) for line in f]
+    for line in lines:
+        assert schema.validate_line(line) == [], line
+    assert all(line["host"] == 0 for line in lines)
+    with open(shard1) as f:
+        assert all(
+            json.loads(line)["host"] == 1 for line in f if line.strip()
+        )
+
+    fleets = [l for l in lines if l["kind"] == "fleet"]
+    assert fleets, [l["kind"] for l in lines]
+    fl = fleets[-1]["fleet"]
+    assert [h["host"] for h in fl["hosts"]] == [0, 1]
+    assert fl["slowest_host"] == 1
+    assert fl["straggler"] is True
+    assert fl["side"] == "input"
+    assert fl["skew"] >= 2.0
+    # host 1's own numbers carry the stall; host 0 stayed fast
+    assert fl["hosts"][1]["data_fetch_p95"] > 1.0
+    assert fl["hosts"][1]["step_time_p95"] > fl["hosts"][0]["step_time_p95"]
+
+    # The straggler warning names the host and the side (host 0 logs it).
+    rank0_out = [o for o in outs if "FINAL 0" in o][0]
+    assert "FLEET STRAGGLER" in rank0_out
+    assert "host 1" in rank0_out and "input-side" in rank0_out
+
+    # Shard-merging report satellite, on the real multi-host artifacts.
+    report = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools",
+                "telemetry_report.py",
+            ),
+            workdir,
+            "--json",
+            "-",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=_worker_env(),
+    )
+    assert report.returncode == 0, report.stderr + report.stdout
+    assert "2 host shard(s)" in report.stdout
+    assert "SLOWEST host 1" in report.stdout
+    rec = json.loads(report.stdout[report.stdout.index("{"):])
+    assert [h["host"] for h in rec["hosts"]] == [0, 1]
+    assert rec["slowest_host"] == 1
+    assert rec["fleet"]["slowest_host"] == 1
+    assert rec["fleet_straggler_windows"] >= 1
 
 
 @pytest.mark.timeout(180)

@@ -16,6 +16,7 @@ command always validates the observability layer it relies on.
 import json
 import logging
 import os
+import re
 
 import jax
 import numpy as np
@@ -240,6 +241,7 @@ class TestSchema:
         line = {
             "schema_version": schema.SCHEMA_VERSION,
             "kind": "window",
+            "host": 0,
             "step": 10,
             "time_unix": 1_700_000_000.0,
             "session_start_unix": 1_699_999_000.0,
@@ -326,6 +328,101 @@ class TestSchema:
         assert schema.validate_line(
             self._line(kind="final", exit_reason="complete",
                        profile={"dir": 3})
+        )
+
+    def test_v3_host_field_contract(self):
+        """ISSUE 4: every v3 line carries the writing host's index; v1/
+        v2 lines must not (a 'v2' line with one is mislabeled v3)."""
+        assert schema.validate_line(self._line()) == []
+        line = self._line()
+        del line["host"]
+        assert any("host" in p for p in schema.validate_line(line))
+        assert schema.validate_line(self._line(host=-1))
+        assert schema.validate_line(self._line(host=True))
+        v2 = self._line(schema_version=2)
+        assert any(
+            "v3 field 'host'" in p for p in schema.validate_line(v2)
+        )
+        del v2["host"]
+        assert schema.validate_line(v2) == []  # v2 without host: fine
+        assert any(
+            "v3 field 'fleet'" in p
+            for p in schema.validate_line(dict(v2, fleet={"hosts": []}))
+        )
+
+    def _fleet(self, **over):
+        fleet = {
+            "hosts": [
+                {"host": 0, "step_time_p50": 0.01, "step_time_p95": 0.011,
+                 "data_fetch_p95": 0.001, "steps_lost": 0,
+                 "peak_live_bytes": 1024, "io_retries": 0,
+                 "batches_skipped": 0},
+                {"host": 1, "step_time_p50": 0.01, "step_time_p95": 0.05,
+                 "data_fetch_p95": 0.04, "steps_lost": 0,
+                 "peak_live_bytes": 1024, "io_retries": 3,
+                 "batches_skipped": 0},
+            ],
+            "slowest_host": 1,
+            "skew": 4.5,
+            "side": "input",
+            "straggler": True,
+        }
+        fleet.update(over)
+        return fleet
+
+    def test_fleet_line_contract(self):
+        good = self._line(kind="fleet", fleet=self._fleet())
+        assert schema.validate_line(good) == []
+        # nulls where a host had no data yet are fine
+        assert schema.validate_line(
+            self._line(kind="fleet", fleet=self._fleet(
+                slowest_host=None, skew=None, side=None, straggler=False,
+            ))
+        ) == []
+        # the fleet object is required on (and exclusive to) fleet lines
+        assert any(
+            "missing the fleet object" in p
+            for p in schema.validate_line(self._line(kind="fleet"))
+        )
+        assert any(
+            "non-fleet line" in p
+            for p in schema.validate_line(self._line(fleet=self._fleet()))
+        )
+        # hosts must be a non-empty list of host-indexed objects
+        assert schema.validate_line(
+            self._line(kind="fleet", fleet=self._fleet(hosts=[]))
+        )
+        assert schema.validate_line(
+            self._line(kind="fleet",
+                       fleet=self._fleet(hosts=[{"step_time_p50": 1.0}]))
+        )
+        # every FLEET_HOST_KEYS entry is required (writer and validator
+        # share the tuple — fleet.VECTOR_KEYS aliases it)
+        from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
+
+        assert fleet_mod.VECTOR_KEYS is schema.FLEET_HOST_KEYS
+        incomplete = dict(self._fleet()["hosts"][0])
+        del incomplete["data_fetch_p95"]
+        assert any(
+            "missing 'data_fetch_p95'" in p
+            for p in schema.validate_line(
+                self._line(kind="fleet",
+                           fleet=self._fleet(hosts=[incomplete]))
+            )
+        )
+        assert schema.validate_line(
+            self._line(kind="fleet", fleet=self._fleet(side="network"))
+        )
+        assert schema.validate_line(
+            self._line(kind="fleet", fleet=self._fleet(skew="big"))
+        )
+        assert schema.validate_line(
+            self._line(kind="fleet", fleet=self._fleet(straggler="yes"))
+        )
+        # v2 lines don't know the fleet kind at all
+        assert schema.validate_line(
+            {**self._line(kind="fleet", fleet=self._fleet()),
+             "schema_version": 2}
         )
 
     def test_violations_detected(self):
@@ -438,13 +535,14 @@ class TestSmokeRun:
             k.startswith("eval/") for k in evals[-1]["metrics"]
         )
 
-    def test_schema_v2_memory_watermark(self, smoke_run):
-        """ISSUE 3 acceptance: the run emits schema_version=2 lines with
-        a nonzero peak-memory watermark, plus the fit-start breakdown
-        snapshot attributing bytes to params vs. optimizer."""
+    def test_schema_v3_memory_watermark(self, smoke_run):
+        """ISSUE 3 acceptance (schema bumped to v3 by ISSUE 4): the run
+        emits current-version lines with a nonzero peak-memory
+        watermark, plus the fit-start breakdown snapshot attributing
+        bytes to params vs. optimizer."""
         wd, _, _, _ = smoke_run
         lines = self._lines(wd)
-        assert all(l["schema_version"] == 2 for l in lines)
+        assert all(l["schema_version"] == 3 for l in lines)
         mems = [l for l in lines if l["kind"] == "memory"]
         assert len(mems) == 1  # the fit-start snapshot
         bd = mems[0]["memory"]
@@ -471,6 +569,26 @@ class TestSmokeRun:
         with open(sinks_mod.trace_path(wd)) as f:
             names = {e["name"] for e in json.load(f)["traceEvents"]}
         assert "compile" in names  # compile wall time is span-traced
+
+    def test_fleet_lines_on_single_host(self, smoke_run):
+        """ISSUE 4: even a one-host run emits a kind="fleet" line per
+        cadenced window (one-host fleet, no straggler), every line
+        carries the writing host index, and a window line precedes each
+        fleet line at the same step."""
+        wd, _, _, _ = smoke_run
+        lines = self._lines(wd)
+        assert all(l["host"] == 0 for l in lines)
+        fleets = [l for l in lines if l["kind"] == "fleet"]
+        windows = [l for l in lines if l["kind"] == "window"]
+        assert len(fleets) == len(windows) >= 2
+        assert [f["step"] for f in fleets] == [w["step"] for w in windows]
+        fl = fleets[-1]["fleet"]
+        assert [h["host"] for h in fl["hosts"]] == [0]
+        assert fl["hosts"][0]["step_time_p95"] > 0
+        assert fl["hosts"][0]["peak_live_bytes"] > 0
+        assert fl["slowest_host"] == 0
+        assert fl["skew"] == pytest.approx(1.0)
+        assert fl["straggler"] is False
 
     def test_report_cli_on_real_run(self, smoke_run, capsys):
         """The full acceptance loop: the run dir feeds the report CLI,
@@ -597,7 +715,451 @@ def test_emergency_flush_lands_fatal_marker(tmp_path, fresh_telemetry):
     }
 
 
-# ------------------------------------------------------- sink fallback
+# ------------------------------------------------------ fleet monitor
+
+
+class TestFleetMonitor:
+    """telemetry/fleet.py unit layer: the mocked-allgather path (the
+    real 2-process collective is pinned in tests/test_distributed.py)."""
+
+    def _monitor(self, reg, allgather, *, skew_factor=2.0, count=2):
+        from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
+
+        return fleet_mod.FleetMonitor(
+            skew_factor=skew_factor, registry=reg, allgather=allgather,
+            process_index=0, process_count=count,
+        )
+
+    def _feed(self, reg, *, step=0.01, fetch=0.001, n=10):
+        for _ in range(n):
+            reg.histogram("step_time").record(step)
+            reg.histogram("span/data_fetch").record(fetch)
+        reg.gauge("memory/peak_live_bytes").set(4096)
+
+    def test_input_side_straggler_named(self, fresh_telemetry, caplog):
+        """A host whose data-fetch excess explains its step-time excess
+        is an INPUT-side straggler; the warning names host and side."""
+        reg, _ = fresh_telemetry
+        self._feed(reg)
+
+        def allgather(vec):
+            slow = vec.copy()
+            slow[1] *= 5.0  # step_time_p95
+            slow[2] += slow[1]  # the fetch IS the stall
+            return np.stack([vec, slow])
+
+        mon = self._monitor(reg, allgather)
+        with caplog.at_level(
+            logging.WARNING, logger="tensorflow_examples_tpu"
+        ):
+            summary = mon.gather({"resilience/steps_lost": 0})
+        assert summary["slowest_host"] == 1
+        assert summary["skew"] == pytest.approx(5.0, rel=1e-3)
+        assert summary["side"] == "input"
+        assert summary["straggler"] is True
+        warned = [
+            r.getMessage()
+            for r in caplog.records
+            if "FLEET STRAGGLER" in r.getMessage()
+        ]
+        assert len(warned) == 1
+        assert "host 1" in warned[0] and "input-side" in warned[0]
+        # one warning per straggling host per fit — a second window with
+        # the same straggler stays quiet
+        caplog.clear()
+        with caplog.at_level(
+            logging.WARNING, logger="tensorflow_examples_tpu"
+        ):
+            mon.gather({"resilience/steps_lost": 0})
+        assert not [
+            r for r in caplog.records
+            if "FLEET STRAGGLER" in r.getMessage()
+        ]
+
+    def test_compute_side_straggler(self, fresh_telemetry):
+        """Skewed step time with flat data-fetch time = the device side
+        (slow chip, thermal, busy host) is to blame."""
+        reg, _ = fresh_telemetry
+        self._feed(reg)
+
+        def allgather(vec):
+            slow = vec.copy()
+            slow[1] *= 4.0  # step time skewed, fetch untouched
+            return np.stack([vec, slow])
+
+        summary = self._monitor(reg, allgather).gather({})
+        assert summary["slowest_host"] == 1
+        assert summary["side"] == "compute"
+        assert summary["straggler"] is True
+
+    def test_balanced_fleet_not_flagged(self, fresh_telemetry):
+        reg, _ = fresh_telemetry
+        self._feed(reg)
+
+        def allgather(vec):
+            other = vec.copy()
+            other[1] *= 1.1  # 10% wobble is not a straggler
+            return np.stack([vec, other])
+
+        summary = self._monitor(reg, allgather).gather({})
+        assert summary["straggler"] is False
+        assert summary["skew"] == pytest.approx(1.1, rel=1e-3)
+
+    def test_single_host_and_empty_registry(self, fresh_telemetry):
+        reg, _ = fresh_telemetry
+        mon = self._monitor(reg, None, count=1)
+        # No samples at all: a valid summary with null attribution.
+        empty = mon.gather({})
+        assert empty["slowest_host"] is None
+        assert empty["straggler"] is False
+        self._feed(reg)
+        summary = mon.gather({"resilience/steps_lost": 3})
+        assert summary["hosts"][0]["steps_lost"] == 3
+        assert summary["skew"] == pytest.approx(1.0)
+        assert summary["straggler"] is False  # 1-host fleet never flags
+
+    def test_emergency_snapshot_is_collective_free(self, fresh_telemetry):
+        """The watchdog-fatal path must never enter a collective: the
+        snapshot replays the cached summary (marked emergency), and
+        works even before any gather happened."""
+        reg, _ = fresh_telemetry
+        self._feed(reg)
+        calls = []
+
+        def allgather(vec):
+            calls.append(1)
+            slow = vec.copy()
+            slow[1] *= 5.0
+            return np.stack([vec, slow])
+
+        mon = self._monitor(reg, allgather)
+        mon.gather({})
+        assert len(calls) == 1
+        snap = mon.snapshot()
+        assert len(calls) == 1  # NO new collective
+        assert snap["emergency"] is True
+        assert snap["slowest_host"] == 1
+        # Never gathered: local-only snapshot, still collective-free.
+        cold = self._monitor(reg, allgather)
+        snap = cold.snapshot()
+        assert len(calls) == 1
+        assert snap["emergency"] is True
+        assert [h["host"] for h in snap["hosts"]] == [0]
+
+
+@pytest.mark.timeout(300)
+def test_fleet_line_names_fault_injected_straggler(
+    tmp_path, faults, monkeypatch, fresh_telemetry, caplog
+):
+    """ISSUE 4 acceptance on CPU (mocked allgather): a run whose input
+    pipeline is stalled by the ``slow`` fault spec must emit a fleet
+    line naming THIS host as an input-side straggler, and log the
+    warning naming host and side.
+
+    Two fits: a healthy one whose measured health vector becomes the
+    synthetic peer (host 1), then the fault-injected one as host 0 —
+    the allgather mock stacks [this host, healthy peer], so the skew
+    and side attribution come entirely from REAL measurements and the
+    REAL injected fault, not from hand-written numbers.
+    """
+    from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
+
+    cfg = tiny_cfg(
+        workdir=str(tmp_path), train_steps=8, log_every=4,
+        checkpoint_every=0, straggler_skew_factor=2.0,
+    )
+    ds = _data()
+
+    # ---- fit 1: healthy run; its vector is the synthetic fast peer ----
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    trainer.fit(lambda start: train_iterator(ds, 64, seed=7, start_step=start))
+    healthy_vec = fleet_mod.FleetMonitor().local_vector({})
+    assert np.isfinite(healthy_vec[:3]).all()
+
+    # ---- fit 2: same trainer, slow-host fault armed, mocked fleet ----
+    registry_mod.reset_default_registry()
+    spans_mod.reset_default_tracer()
+
+    def mock_allgather(vec):
+        return np.stack([vec, healthy_vec])
+
+    def from_config(cfg_):
+        return fleet_mod.FleetMonitor(
+            skew_factor=float(cfg_.straggler_skew_factor),
+            allgather=mock_allgather,
+            process_index=0,
+            process_count=2,
+        )
+
+    monkeypatch.setattr(
+        fleet_mod.FleetMonitor, "from_config", staticmethod(from_config)
+    )
+    faults("slow@5:1.0,slow@6:1.0")  # the injected slow host: host 0
+    wd2 = str(tmp_path / "faulted")
+    trainer.config = cfg.replace(workdir=wd2)
+    with caplog.at_level(logging.WARNING, logger="tensorflow_examples_tpu"):
+        # Fit 1 left the (checkpoint-less) state at step 8: continue to
+        # 16 so this fit really steps; fetch indices restart at 0.
+        trainer.fit(
+            lambda start: train_iterator(ds, 64, seed=7, start_step=start),
+            num_steps=16,
+        )
+
+    with open(sinks_mod.metrics_path(wd2)) as f:
+        lines = [json.loads(line) for line in f]
+    for line in lines:
+        assert schema.validate_line(line) == [], line
+    fleets = [l for l in lines if l["kind"] == "fleet"]
+    assert fleets, [l["kind"] for l in lines]
+    fl = fleets[-1]["fleet"]
+    assert [h["host"] for h in fl["hosts"]] == [0, 1]
+    assert fl["slowest_host"] == 0  # the fault-injected host, by name
+    assert fl["straggler"] is True
+    assert fl["side"] == "input"  # the stall sat in the data fetch
+    assert fl["skew"] >= 2.0
+    assert fl["hosts"][0]["data_fetch_p95"] >= 0.9  # the 1s stalls
+    warned = [
+        r.getMessage()
+        for r in caplog.records
+        if "FLEET STRAGGLER" in r.getMessage()
+    ]
+    assert warned and "host 0" in warned[0] and "input-side" in warned[0]
+
+
+# ------------------------------------------------------ metrics server
+
+
+def _get(url: str):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|NaN|[+-]Inf)$"
+)
+
+
+def _assert_valid_prometheus(text: str) -> list[str]:
+    """Every line is a comment or a well-formed sample; returns the
+    sample metric names."""
+    names = []
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith(("# TYPE ", "# HELP ")), line
+            continue
+        assert _PROM_SAMPLE.match(line), f"invalid prometheus line: {line}"
+        names.append(line.split("{")[0].split(" ")[0])
+    return names
+
+
+class TestMetricsServer:
+    def test_endpoints_serve_registry_and_window(self, fresh_telemetry):
+        import json as json_mod
+
+        from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
+        from tensorflow_examples_tpu.telemetry import serve as serve_mod
+        from tensorflow_examples_tpu.telemetry.hub import Telemetry
+
+        reg, tracer = fresh_telemetry
+        reg.counter("train/steps_total").inc(7)
+        reg.gauge("memory/peak_live_bytes").set(2048)
+        reg.histogram("step_time").record(0.01)
+        tel = Telemetry(
+            [], registry=reg, tracer=tracer, host=0,
+            fleet=fleet_mod.FleetMonitor(
+                registry=reg, process_index=0, process_count=1
+            ),
+        )
+        srv = serve_mod.MetricsServer(reg, port=0, telemetry=tel).start()
+        try:
+            # /window and /fleet 404 before any line exists
+            status, _ = _get(srv.url("/window"))
+            assert status == 404
+            status, _ = _get(srv.url("/fleet"))
+            assert status == 404
+            # the fit-start memory snapshot must NOT satisfy /window —
+            # its contract is the latest window/eval/final line
+            tel.log_window(
+                0, {}, kind="memory", reduce=False,
+                extra={"memory": {"live_bytes": 1, "params_bytes": 1}},
+            )
+            status, _ = _get(srv.url("/window"))
+            assert status == 404
+            tel.log_window(7, {"loss": 1.25})
+            status, text = _get(srv.url("/metrics"))
+            assert status == 200
+            names = _assert_valid_prometheus(text)
+            assert "train_steps_total" in names
+            assert "memory_peak_live_bytes" in names
+            assert "step_time_seconds_count" in names
+            assert 'host="0"' in text
+            status, body = _get(srv.url("/health"))
+            assert status == 200
+            health = json_mod.loads(body)
+            assert health["ok"] is True
+            assert health["last_step"] == 7
+            assert health["last_window_age_secs"] < 60
+            # /window serves the WINDOW line (metrics intact), even
+            # though the fleet line was emitted after it; /fleet serves
+            # the fleet summary.
+            status, body = _get(srv.url("/window"))
+            assert status == 200
+            line = json_mod.loads(body)
+            assert line["kind"] == "window"
+            assert line["step"] == 7
+            assert line["metrics"]["train/loss"] == 1.25
+            status, body = _get(srv.url("/fleet"))
+            assert status == 200
+            fleet_line = json_mod.loads(body)
+            assert fleet_line["kind"] == "fleet"
+            assert fleet_line["fleet"]["hosts"][0]["host"] == 0
+            status, _ = _get(srv.url("/bogus"))
+            assert status == 404
+        finally:
+            srv.close()
+        srv.close()  # idempotent
+
+    def test_health_503_on_watchdog_stall(self, fresh_telemetry):
+        import json as json_mod
+        import time as time_mod
+
+        from tensorflow_examples_tpu.telemetry import serve as serve_mod
+        from tensorflow_examples_tpu.utils.diagnostics import Watchdog
+
+        reg, _ = fresh_telemetry
+        wd = Watchdog(0.05, poll_s=10.0)  # not started: no dump thread
+        wd.enter("device_step")
+        srv = serve_mod.MetricsServer(reg, port=0, watchdog=wd).start()
+        try:
+            time_mod.sleep(0.1)  # stall past the timeout
+            status, body = _get(srv.url("/health"))
+            assert status == 503
+            health = json_mod.loads(body)
+            assert health["ok"] is False
+            assert health["phase"] == "device_step"
+            assert health["stalled_secs"] >= 0.05
+            wd.pause()  # paused phases (eval, ckpt) are not stalls
+            status, _ = _get(srv.url("/health"))
+            assert status == 200
+        finally:
+            srv.close()
+
+    def test_from_config_gating(self, fresh_telemetry):
+        from tensorflow_examples_tpu.telemetry import serve as serve_mod
+
+        assert serve_mod.MetricsServer.from_config(tiny_cfg()) is None
+        srv = serve_mod.MetricsServer.from_config(
+            tiny_cfg(metrics_port=18347)
+        )
+        assert srv is not None and srv.requested_port == 18347
+
+    def test_sanitize_and_render(self, fresh_telemetry):
+        from tensorflow_examples_tpu.telemetry import serve as serve_mod
+
+        assert serve_mod.sanitize_metric_name("a/b-c.d") == "a_b_c_d"
+        assert serve_mod.sanitize_metric_name("0weird") == "_0weird"
+        reg, _ = fresh_telemetry
+        reg.counter("io/retries").inc(2)
+        text = serve_mod.render_prometheus(reg, host=3)
+        assert "# TYPE io_retries counter" in text
+        assert 'io_retries{host="3"} 2.0' in text
+
+
+@pytest.mark.timeout(300)
+def test_metrics_served_during_live_fit(tmp_path, fresh_telemetry):
+    """ISSUE 4 acceptance: with metrics_port set, /metrics serves valid
+    Prometheus text and /health answers WHILE the run is live (queried
+    from inside the input pipeline, mid-fit), and the port is closed on
+    the fit exit path."""
+    import socket
+    import urllib.error
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = tiny_cfg(
+        workdir=str(tmp_path), metrics_port=port, train_steps=8,
+        log_every=4, checkpoint_every=0, watchdog_secs=30,
+    )
+    ds = _data()
+    captured = {}
+
+    def data(start):
+        for i, batch in enumerate(
+            train_iterator(ds, 64, seed=7, start_step=start)
+        ):
+            if i == 6 and not captured:  # after the step-4 window landed
+                captured["metrics"] = _get(f"http://127.0.0.1:{port}/metrics")
+                captured["health"] = _get(f"http://127.0.0.1:{port}/health")
+                captured["window"] = _get(f"http://127.0.0.1:{port}/window")
+            yield batch
+
+    trainer = Trainer(mnist.make_task(cfg), cfg)
+    trainer.fit(data)
+    assert captured, "input pipeline never reached the probe batch"
+    status, text = captured["metrics"]
+    assert status == 200
+    names = _assert_valid_prometheus(text)
+    assert "train_steps_total" in names
+    status, body = captured["health"]
+    assert status == 200
+    health = json.loads(body)
+    assert health["ok"] is True and health["phase"] is not None
+    status, body = captured["window"]
+    assert status == 200
+    assert json.loads(body)["step"] == 4
+    # Exit path closed the server: the port no longer answers.
+    assert trainer._telemetry.server is None
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=2)
+
+
+def test_emergency_flush_fleet_snapshot_and_server_close(
+    tmp_path, fresh_telemetry
+):
+    """ISSUE 4 satellite: the watchdog-fatal hook lands the cached
+    fleet state as an emergency kind="fleet" line and closes the
+    metrics server — before the final marker hits the disk is fine,
+    before exit 87 is the contract."""
+    import urllib.error
+    import urllib.request
+
+    from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
+    from tensorflow_examples_tpu.telemetry import serve as serve_mod
+    from tensorflow_examples_tpu.telemetry.hub import Telemetry
+
+    reg, tracer = fresh_telemetry
+    reg.histogram("step_time").record(0.01)
+    jsonl = str(tmp_path / "metrics.jsonl")
+    mon = fleet_mod.FleetMonitor(
+        skew_factor=2.0, registry=reg, process_index=0, process_count=1
+    )
+    tel = Telemetry(
+        [sinks_mod.JsonlSink(jsonl)], registry=reg, tracer=tracer,
+        fleet=mon, host=0,
+    )
+    srv = serve_mod.MetricsServer(reg, port=0, telemetry=tel).start()
+    tel.server = srv
+    port = srv.port
+    tel.emergency_flush()
+    lines = [json.loads(l) for l in open(jsonl)]
+    # window-less run: [fleet snapshot, final marker], both schema-valid
+    assert [l["kind"] for l in lines[-2:]] == ["fleet", "final"]
+    for line in lines:
+        assert schema.validate_line(line) == [], line
+    assert lines[-2]["fleet"]["emergency"] is True
+    assert lines[-1]["exit_reason"] == "watchdog_fatal"
+    assert tel.server is None
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=2)
 
 
 class TestTensorBoardSinkFallback:

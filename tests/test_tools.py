@@ -641,6 +641,326 @@ class TestTelemetryReport:
         assert "preemptions=1" in out
 
 
+class TestTelemetryReportShards:
+    """ISSUE 4 satellite: a run dir holding per-host telemetry shards
+    reports per-host figures and flags the slowest host; single-shard
+    dirs keep the exact pre-fleet behavior (pinned above)."""
+
+    def _line(self, host, step, *, p50, p95, kind="window", **over):
+        line = {
+            "schema_version": 3, "kind": kind, "host": host, "step": step,
+            "time_unix": 100.0 + step, "session_start_unix": 99.0,
+            "metrics": {"train/loss": 2.0}, "gauges": {},
+            "counters": {"train/steps_total": step},
+            "derived": {"examples_per_sec": 640.0, "tokens_per_sec": None,
+                        "step_time_p50": p50, "step_time_p95": p95,
+                        "mfu": 0.01, "goodput": 1.0},
+        }
+        line.update(over)
+        return line
+
+    def _fleet_dir(self, tmp_path):
+        """The REAL multi-host layout: process 0's stream is
+        metrics.jsonl (no host-0 shard — sinks.make_sinks writes none),
+        hosts k>0 each have telemetry.host{k}.jsonl."""
+        tdir = tmp_path / "telemetry"
+        tdir.mkdir()
+        fleet = {
+            "hosts": [
+                {"host": 0, "step_time_p50": 0.01, "step_time_p95": 0.011,
+                 "data_fetch_p95": 0.001, "steps_lost": 0,
+                 "peak_live_bytes": 1024, "io_retries": 0,
+                 "batches_skipped": 0},
+                {"host": 1, "step_time_p50": 0.04, "step_time_p95": 0.05,
+                 "data_fetch_p95": 0.045, "steps_lost": 0,
+                 "peak_live_bytes": 1024, "io_retries": 7,
+                 "batches_skipped": 0},
+            ],
+            "slowest_host": 1, "skew": 4.5, "side": "input",
+            "straggler": True,
+        }
+        main_lines = [
+            self._line(0, 10, p50=0.01, p95=0.011),
+            self._line(0, 10, p50=0.01, p95=0.011, kind="fleet",
+                       fleet=fleet),
+            self._line(0, 20, p50=0.01, p95=0.011, kind="final",
+                       metrics={}, exit_reason="complete"),
+        ]
+        shard1 = [
+            self._line(1, 10, p50=0.04, p95=0.05),
+            self._line(1, 20, p50=0.04, p95=0.05, kind="final",
+                       metrics={}, exit_reason="complete",
+                       counters={"train/steps_total": 20,
+                                 "resilience/steps_lost": 2}),
+        ]
+        with open(tdir / "metrics.jsonl", "w") as f:
+            f.write("\n".join(json.dumps(l) for l in main_lines) + "\n")
+        with open(tdir / "telemetry.host1.jsonl", "w") as f:
+            f.write("\n".join(json.dumps(l) for l in shard1) + "\n")
+        return tmp_path
+
+    def test_shards_merged_and_slowest_flagged(self, tmp_path, capsys):
+        import telemetry_report
+
+        wd = self._fleet_dir(tmp_path)
+        rc = telemetry_report.main([str(wd), "--json", "-"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "fleet: 2 host shard(s); SLOWEST host 1" in out
+        assert "host 0:" in out and "host 1:" in out
+        assert "<- SLOWEST" in out
+        assert "fleet skew (last fleet line): 4.50x" in out
+        assert "slowest host 1, input-side" in out
+        assert "STRAGGLER flagged in 1 window(s)" in out
+        rec = json.loads(out[out.index("{"):])
+        assert [h["host"] for h in rec["hosts"]] == [0, 1]
+        assert rec["slowest_host"] == 1
+        assert rec["hosts"][1]["step_time_p95"] == 0.05
+        assert rec["hosts"][1]["steps_lost"] == 2
+        assert rec["fleet"]["side"] == "input"
+        assert rec["fleet_straggler_windows"] == 1
+
+    def test_single_shard_dir_unchanged(self, tmp_path, capsys):
+        """No host shards -> no fleet table, hosts is null (the summary
+        and record shape of a pre-ISSUE-4 run dir)."""
+        import telemetry_report
+
+        tdir = tmp_path / "telemetry"
+        tdir.mkdir()
+        with open(tdir / "metrics.jsonl", "w") as f:
+            f.write(json.dumps(self._line(0, 10, p50=0.01, p95=0.02)) + "\n")
+        rc = telemetry_report.main([str(tmp_path), "--json", "-"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "host shard" not in out
+        rec = json.loads(out[out.index("{"):])
+        assert rec["hosts"] is None
+        assert rec["slowest_host"] is None
+
+    def test_shards_only_dir_still_reports(self, tmp_path, capsys):
+        """A dir with ONLY host shards (host 0's record lost) reports
+        from the lowest shard instead of erroring."""
+        import telemetry_report
+
+        tdir = tmp_path / "telemetry"
+        tdir.mkdir()
+        with open(tdir / "telemetry.host1.jsonl", "w") as f:
+            f.write(
+                json.dumps(self._line(1, 10, p50=0.01, p95=0.02)) + "\n"
+            )
+        rc = telemetry_report.main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet: 1 host shard(s)" in out
+
+
+class TestRunDiff:
+    """tools/run_diff.py (ISSUE 4 tentpole (3)): regression attribution
+    between two run dirs, ranked, machine-consumable by bench_gate."""
+
+    def _dir(self, root, name, *, p50=0.010, p95=0.020, mfu=0.010,
+             eps=640.0, device_ms=9000.0, fetch_ms=1000.0):
+        tdir = root / name / "telemetry"
+        tdir.mkdir(parents=True)
+        base = {
+            "schema_version": 1, "session_start_unix": 99.0, "gauges": {},
+        }
+        lines = [
+            dict(base, kind="window", step=10, time_unix=100.0,
+                 metrics={"train/loss": 2.0},
+                 counters={"train/steps_total": 10},
+                 derived={"examples_per_sec": eps, "tokens_per_sec": None,
+                          "step_time_p50": p50, "step_time_p95": p95,
+                          "mfu": mfu, "goodput": 1.0}),
+            dict(base, kind="final", step=10, time_unix=101.0, metrics={},
+                 counters={"train/steps_total": 10},
+                 derived={"examples_per_sec": None, "tokens_per_sec": None,
+                          "step_time_p50": p50, "step_time_p95": p95,
+                          "mfu": None, "goodput": 1.0},
+                 exit_reason="complete"),
+        ]
+        with open(tdir / "metrics.jsonl", "w") as f:
+            f.write("\n".join(json.dumps(l) for l in lines) + "\n")
+        with open(tdir / "trace.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "device_step", "ph": "X", "ts": 0.0,
+                 "dur": device_ms * 1e3, "pid": 0, "tid": 0},
+                {"name": "data_fetch", "ph": "X", "ts": 0.0,
+                 "dur": fetch_ms * 1e3, "pid": 0, "tid": 0},
+            ]}, f)
+        return str(root / name)
+
+    def test_injected_regression_ranked_first(self, tmp_path, capsys):
+        """ISSUE 4 acceptance: the injected step-time regression is the
+        top-ranked finding."""
+        import run_diff
+
+        a = self._dir(tmp_path, "a")
+        b = self._dir(tmp_path, "b", p50=0.013, p95=0.027)  # +30/+35%
+        out_json = tmp_path / "diff.json"
+        rc = run_diff.main([a, b, "--json", str(out_json)])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        doc = json.load(open(out_json))
+        assert doc["regressions"] == 2
+        assert doc["ranked"][0]["metric"] == "step_time_p95"  # largest
+        assert doc["ranked"][1]["metric"] == "step_time_p50"
+        assert doc["ranked"][0]["verdict"] == "regressed"
+        first = out.index("REGRESSED step_time_p95")
+        assert first < out.index("REGRESSED step_time_p50")
+        # unchanged metrics rank after, improvements would sit between
+        assert out.index("unchanged goodput") > first
+
+    def test_improvement_and_span_attribution(self, tmp_path, capsys):
+        import run_diff
+
+        a = self._dir(tmp_path, "a")
+        b = self._dir(tmp_path, "b", mfu=0.02, device_ms=13500.0)
+        rc = run_diff.main([a, b, "--json", "-"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out[out.index('{\n'):])
+        by_metric = {d["metric"]: d for d in doc["ranked"]}
+        assert by_metric["mfu"]["verdict"] == "improved"
+        span = by_metric["span/device_step_total_ms"]
+        assert span["verdict"] == "regressed"
+        assert span["rel_change"] == pytest.approx(0.5)
+        assert doc["ranked"][0]["metric"] == "span/device_step_total_ms"
+
+    def test_self_compare_is_clean(self, tmp_path, capsys):
+        import run_diff
+
+        a = self._dir(tmp_path, "a")
+        rc = run_diff.main([a, a, "--fail-on-regression"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 regressed" in out
+        assert "REGRESSED" not in out
+
+    def test_fail_on_regression_exit_code(self, tmp_path, capsys):
+        import run_diff
+
+        a = self._dir(tmp_path, "a")
+        b = self._dir(tmp_path, "b", p50=0.02)
+        assert run_diff.main([a, b]) == 0  # report-only by default
+        assert run_diff.main([a, b, "--fail-on-regression"]) == 1
+
+    def test_missing_run_exits_2(self, tmp_path, capsys):
+        import run_diff
+
+        a = self._dir(tmp_path, "a")
+        assert run_diff.main([a, str(tmp_path / "nope")]) == 2
+        assert "run_b" in capsys.readouterr().err
+
+    def test_zero_baseline_stays_valid_json(self, tmp_path, capsys):
+        """recompiles 0 -> 2 has no finite ratio; the doc must still be
+        strict-parseable JSON (no bare Infinity) and rank the jump
+        first."""
+        import run_diff
+
+        base = {"windows": 1, "counters": {}, "first_step": 0,
+                "last_step": 10, "exit_reason": "complete",
+                "recompiles": 0, "step_time_p50": 0.01}
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(base))
+        b.write_text(json.dumps(dict(base, recompiles=2)))
+        out_json = tmp_path / "diff.json"
+        assert run_diff.main(
+            [str(a), str(b), "--json", str(out_json)]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "REGRESSED recompiles" in out and "0->new" in out
+        raw = out_json.read_text()
+        assert "Infinity" not in raw
+        doc = json.loads(raw)  # strict parse succeeds
+        assert doc["ranked"][0]["metric"] == "recompiles"
+        assert doc["ranked"][0]["rel_change"] is None
+        assert doc["regressions"] == 1
+
+    def test_absent_fields_not_compared(self, tmp_path, capsys):
+        """v1 records (no memory watermark) list the field as not
+        comparable instead of inventing a delta."""
+        import run_diff
+
+        a = self._dir(tmp_path, "a")
+        rec = {"windows": 1, "counters": {}, "step_time_p50": 0.01,
+               "step_time_p95": 0.02, "examples_per_sec_mean": 640.0,
+               "mfu": 0.01, "goodput": 1.0, "peak_live_bytes": 4096,
+               "first_step": 0, "last_step": 10, "exit_reason": "complete"}
+        b = tmp_path / "b_report.json"
+        b.write_text(json.dumps(rec))
+        rc = run_diff.main([a, str(b)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "not comparable: peak_live_bytes: absent in A" in out
+
+    def test_json_feeds_bench_gate_record_mode(self, tmp_path, capsys):
+        """The --json doc is directly gateable: stamp floors from run
+        A's report, then bench_gate --record the A-vs-B diff doc — the
+        regressed candidate fails the gate."""
+        import bench_gate
+        import run_diff
+        import telemetry_report
+
+        a = self._dir(tmp_path, "a")
+        b = self._dir(tmp_path, "b", p50=0.013, p95=0.027)
+        report_a = tmp_path / "report_a.json"
+        assert telemetry_report.main([a, "--json", str(report_a)]) == 0
+        floors = tmp_path / "floors.json"
+        assert bench_gate.main(
+            ["--stamp", str(report_a), "--floors", str(floors)]
+        ) == 0
+        diff_json = tmp_path / "diff.json"
+        assert run_diff.main([a, b, "--json", str(diff_json)]) == 0
+        assert bench_gate.main(
+            ["--record", str(diff_json), "--floors", str(floors)]
+        ) == 1
+        out = capsys.readouterr().out
+        assert "[FAIL] step_time_p50" in out
+        # and the self-compare diff doc passes the same gate
+        self_json = tmp_path / "self.json"
+        assert run_diff.main([a, a, "--json", str(self_json)]) == 0
+        assert bench_gate.main(
+            ["--record", str(self_json), "--floors", str(floors)]
+        ) == 0
+
+
+def test_ci_perf_gates_run_in_tier1(tmp_path):
+    """ISSUE 4 CI satellite, at the subprocess level the CI would use:
+    bench_gate trajectory mode over the banked BENCH_r0*.json rounds
+    AND a run_diff --json self-compare both exit 0 — a perf-record or
+    report schema break fails the tier-1 pass instead of silently
+    rotting. (Fast: both are pure-JSON CPU paths.)"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    files = sorted(
+        os.path.join(REPO, f)
+        for f in os.listdir(REPO)
+        if re.fullmatch(r"BENCH_r\d+\.json", f)
+    )
+    assert files, "no banked BENCH_*.json trajectory in the repo"
+    gate = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         *files],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+    assert "0 regressed" in gate.stdout
+
+    # run_diff self-compare: a run dir diffed against itself is clean.
+    run = TestRunDiff()._dir(tmp_path, "self")
+    diff = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_diff.py"),
+         run, run, "--json", "-", "--fail-on-regression"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert diff.returncode == 0, diff.stdout + diff.stderr
+    assert "0 regressed" in diff.stdout
+    doc = json.loads(diff.stdout[diff.stdout.index('{\n'):])
+    assert doc["regressions"] == 0
+
+
 class TestBenchGate:
     """tools/bench_gate.py (ISSUE 3 tentpole (4)): the CI perf gate must
     pass on the committed BENCH_r0*.json trajectory and fail on a

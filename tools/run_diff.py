@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Attribute the delta between two runs (ISSUE 4 tentpole (3)).
+
+    python tools/run_diff.py <run_a> <run_b>
+    python tools/run_diff.py <run_a> <run_b> --json diff.json
+    python tools/run_diff.py <run_a> <run_b> --fail-on-regression
+
+``run_a`` / ``run_b`` are each a run dir (anything
+``tools/telemetry_report.py`` accepts: the workdir, its telemetry dir,
+or a metrics.jsonl path) or a pre-extracted ``telemetry_report --json``
+record file. A is the baseline, B the candidate.
+
+The comparison covers every number the telemetry record carries a
+direction for — step-time p50/p95, throughput, MFU, goodput, peak
+live-memory watermark, compile/recompile counts, and per-span host time
+from the Chrome trace — and prints a RANKED "what changed" summary:
+regressions first, largest relative change first, improvements after,
+ties broken stably. Metrics absent from either record (a v1 run has no
+memory watermark) are listed as not comparable, never guessed.
+
+``--json`` writes a machine-readable document: both records, the
+ranked delta list, and the candidate's gateable figures flattened at
+top level — so the output is directly consumable by
+``tools/bench_gate.py --record diff.json --floors floors.json`` (the
+CI smoke in tests/test_tools.py self-compares a run dir through
+exactly that path).
+
+Exit codes: 0 = compared (regressions only reported), 1 = regressions
+found AND ``--fail-on-regression`` was set, 2 = a record could not be
+built from either argument.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import telemetry_report  # noqa: E402
+
+# (record key, direction, unit, scale) — direction says which way is a
+# regression; scale is display-only (step times print as ms).
+DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
+    ("step_time_p50", "lower", "ms", 1e3),
+    ("step_time_p95", "lower", "ms", 1e3),
+    ("examples_per_sec_mean", "higher", "/s", 1.0),
+    ("examples_per_sec_last", "higher", "/s", 1.0),
+    ("tokens_per_sec_last", "higher", "/s", 1.0),
+    ("mfu", "higher", "", 1.0),
+    ("goodput", "higher", "", 1.0),
+    ("peak_live_bytes", "lower", "MiB", 1.0 / 2**20),
+    ("compiles", "lower", "", 1.0),
+    ("recompiles", "lower", "", 1.0),
+)
+
+# The candidate keys flattened into the --json doc for bench_gate
+# --record (mirrors bench_gate.RECORD_KEYS plus the last-window rate).
+GATE_KEYS = (
+    "step_time_p50",
+    "step_time_p95",
+    "peak_live_bytes",
+    "mfu",
+    "goodput",
+    "examples_per_sec_mean",
+)
+
+# Relative change below this is "unchanged" (run-to-run wobble, not a
+# finding); overridable with --threshold.
+DEFAULT_THRESHOLD = 0.02
+
+# Ranking magnitude assigned to a zero-baseline jump (JSON cannot carry
+# Infinity; anything appearing from zero outranks any finite change).
+_INF_MAGNITUDE = 1e9
+
+
+def load_record(arg: str) -> tuple[dict | None, str]:
+    """(record, error). Accepts a telemetry_report --json file or
+    anything telemetry_report resolves as a run dir."""
+    if os.path.isfile(arg) and not arg.endswith(".jsonl"):
+        try:
+            with open(arg) as f:
+                doc = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            doc = None
+        if isinstance(doc, dict) and "windows" in doc and "counters" in doc:
+            return doc, ""
+    record, _, err = telemetry_report.build_record(arg)
+    return record, err
+
+
+def _span_totals(record: dict) -> dict[str, float]:
+    return {
+        name: p["total_ms"]
+        for name, p in (record.get("trace_phases") or {}).items()
+    }
+
+
+def diff_records(
+    a: dict, b: dict, threshold: float = DEFAULT_THRESHOLD
+) -> tuple[list[dict], list[str]]:
+    """(ranked deltas, not-comparable notes). Each delta::
+
+        {"metric", "a", "b", "unit", "scale", "rel_change",
+         "direction", "verdict": "regressed"|"improved"|"unchanged",
+         "severity"}
+
+    ``rel_change`` is signed (b/a - 1), null for a zero baseline (a
+    0 -> nonzero jump has no finite ratio, and ``Infinity`` is not
+    legal JSON); ``severity`` is the magnitude of the change in the
+    REGRESSION direction (0 for improvements / unchanged ties),
+    capped finite — it is what the ranking sorts by.
+    """
+    rows: list[tuple[str, str, str, float, float | None, float | None]] = []
+    for key, direction, unit, scale in DIFF_KEYS:
+        rows.append((key, direction, unit, scale, a.get(key), b.get(key)))
+    span_a, span_b = _span_totals(a), _span_totals(b)
+    for name in sorted(set(span_a) | set(span_b)):
+        rows.append(
+            (
+                f"span/{name}_total_ms",
+                "lower",
+                "ms",
+                1.0,
+                span_a.get(name),
+                span_b.get(name),
+            )
+        )
+
+    deltas: list[dict] = []
+    skipped: list[str] = []
+    for key, direction, unit, scale, va, vb in rows:
+        if va is None and vb is None:
+            continue  # neither run has it: not worth a line
+        if va is None or vb is None:
+            skipped.append(
+                f"{key}: absent in {'A' if va is None else 'B'}"
+            )
+            continue
+        va, vb = float(va), float(vb)
+        if va == 0.0 and vb == 0.0:
+            rel = 0.0
+        elif va == 0.0:
+            rel = math.inf  # 0 -> something: no finite ratio exists
+        else:
+            rel = vb / va - 1.0
+        regression = rel > 0 if direction == "lower" else rel < 0
+        # Cap the ranking magnitude finite: json has no Infinity, and
+        # "appeared from zero" should outrank any finite change anyway.
+        magnitude = min(abs(rel), _INF_MAGNITUDE)
+        if magnitude <= threshold:
+            verdict, severity = "unchanged", 0.0
+        elif regression:
+            verdict, severity = "regressed", magnitude
+        else:
+            verdict, severity = "improved", 0.0
+        deltas.append(
+            {
+                "metric": key,
+                "a": va,
+                "b": vb,
+                "unit": unit,
+                "scale": scale,
+                "rel_change": rel if math.isfinite(rel) else None,
+                "direction": direction,
+                "verdict": verdict,
+                "severity": severity,
+                "_magnitude": magnitude,
+            }
+        )
+    order = {"regressed": 0, "improved": 1, "unchanged": 2}
+    deltas.sort(
+        key=lambda d: (order[d["verdict"]], -d["_magnitude"], d["metric"])
+    )
+    for d in deltas:
+        del d["_magnitude"]
+    return deltas, skipped
+
+
+def _fmt_value(d: dict, which: str) -> str:
+    v = d[which] * d["scale"]
+    return f"{v:,.4g}{d['unit']}"
+
+
+def _fmt_rel(rel: float | None) -> str:
+    if rel is None:
+        return "0->new"  # zero baseline: no finite ratio
+    return f"{rel * 100:+.1f}%"
+
+
+def render(a_arg: str, b_arg: str, a: dict, b: dict,
+           deltas: list[dict], skipped: list[str]) -> str:
+    out = ["== run diff (A = baseline, B = candidate) =="]
+    for label, arg, rec in (("A", a_arg, a), ("B", b_arg, b)):
+        out.append(
+            f"{label}: {arg} (steps {rec.get('first_step')}.."
+            f"{rec.get('last_step')}, {rec.get('windows')} window(s), "
+            f"ended: {rec.get('exit_reason') or 'UNKNOWN'})"
+        )
+    regressed = [d for d in deltas if d["verdict"] == "regressed"]
+    improved = [d for d in deltas if d["verdict"] == "improved"]
+    out.append(
+        f"what changed ({len(regressed)} regressed, {len(improved)} "
+        "improved), ranked:"
+    )
+    for d in deltas:
+        tag = {"regressed": "REGRESSED", "improved": "improved ",
+               "unchanged": "unchanged"}[d["verdict"]]
+        out.append(
+            f"  {tag} {d['metric']:<28} {_fmt_rel(d['rel_change']):>8}  "
+            f"{_fmt_value(d, 'a')} -> {_fmt_value(d, 'b')}"
+        )
+    for note in skipped:
+        out.append(f"  not comparable: {note}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("run_a", help="baseline: run dir or report.json")
+    ap.add_argument("run_b", help="candidate: run dir or report.json")
+    ap.add_argument(
+        "--json", metavar="PATH",
+        help="write the machine-readable diff here ('-' = stdout); the "
+        "candidate's gateable figures are flattened at top level for "
+        "bench_gate --record",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=DEFAULT_THRESHOLD,
+        help="relative change below this is 'unchanged' (default 0.02)",
+    )
+    ap.add_argument(
+        "--fail-on-regression", action="store_true",
+        help="exit 1 when any metric regressed beyond the threshold",
+    )
+    args = ap.parse_args(argv)
+
+    a, err_a = load_record(args.run_a)
+    if a is None:
+        print(f"run_a: {err_a}", file=sys.stderr)
+        return 2
+    b, err_b = load_record(args.run_b)
+    if b is None:
+        print(f"run_b: {err_b}", file=sys.stderr)
+        return 2
+    deltas, skipped = diff_records(a, b, args.threshold)
+    print(render(args.run_a, args.run_b, a, b, deltas, skipped))
+    regressions = [d for d in deltas if d["verdict"] == "regressed"]
+    if args.json:
+        doc = {
+            "a_path": args.run_a,
+            "b_path": args.run_b,
+            "threshold": args.threshold,
+            "ranked": deltas,
+            "not_comparable": skipped,
+            "regressions": len(regressions),
+            "a": a,
+            "b": b,
+        }
+        # bench_gate --record compatibility: candidate figures on top.
+        doc.update({k: b.get(k) for k in GATE_KEYS})
+        payload = json.dumps(doc, indent=2) + "\n"
+        if args.json == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload)
+    if regressions and args.fail_on_regression:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,11 @@ span timeline), and prints:
   window cross-link, and the observed device duty cycle next to the
   analytic MFU. v1 runs simply omit these lines — absent fields degrade
   gracefully.
+* fleet facts (schema v3, ISSUE 4): when the run dir holds per-host
+  telemetry shards (``telemetry.host{k}.jsonl``), they are merged into
+  a per-host table and the slowest host is flagged; the last
+  ``kind="fleet"`` line's skew/straggler verdict is rendered either
+  way. Single-shard dirs report exactly as before.
 
 ``--json`` additionally writes one machine-readable record with the
 same numbers — shaped for dropping into future BENCH_*.json entries.
@@ -40,6 +45,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -109,10 +115,16 @@ def _split_sessions(lines: list[dict]) -> list[list[dict]]:
 def _aggregate_counters(sessions: list[list[dict]]) -> dict[str, int]:
     """Whole-run counters: sum each session's last (= highest) values —
     the per-session counters are cumulative, so the last line carries
-    the session total."""
+    the session total. Fleet lines are skipped: they ride immediately
+    after every reduced window carrying HOST-LOCAL counters (their
+    per-host evidence lives in the fleet object), so a torn tail ending
+    on one would silently swap the fleet-reduced totals for one host's."""
     totals: dict[str, int] = {}
     for sess in sessions:
-        for k, v in sess[-1]["counters"].items():
+        last = next(
+            (l for l in reversed(sess) if l["kind"] != "fleet"), sess[-1]
+        )
+        for k, v in last["counters"].items():
             totals[k] = totals.get(k, 0) + v
     return totals
 
@@ -185,6 +197,12 @@ def summarize(lines: list[dict], trace: dict | None) -> dict:
     record["profile"] = next(
         (l["profile"] for l in reversed(finals) if "profile" in l), None
     )
+    # ----- schema-v3 fleet fields (None/absent on v1/v2 runs) -----
+    fleet_lines = [l for l in lines if l["kind"] == "fleet"]
+    record["fleet"] = fleet_lines[-1]["fleet"] if fleet_lines else None
+    record["fleet_straggler_windows"] = sum(
+        1 for l in fleet_lines if l["fleet"].get("straggler")
+    )
     # From derived ONLY: the hub publishes it per fit, while the gauge
     # is process-global and would attribute an earlier fit's
     # measurement to this record.
@@ -204,6 +222,110 @@ def summarize(lines: list[dict], trace: dict | None) -> dict:
         if trace.get("droppedEventCount"):
             record["trace_dropped_events"] = trace["droppedEventCount"]
     return record
+
+
+def resolve_metrics_path(arg: str) -> str | None:
+    """A run dir / telemetry dir / metrics.jsonl argument -> the primary
+    metrics file (host 0's run record), or — when only host shards
+    exist — the lowest-indexed shard."""
+    cand = [
+        arg,
+        os.path.join(arg, "metrics.jsonl"),
+        os.path.join(arg, "telemetry", "metrics.jsonl"),
+    ]
+    path = next((p for p in cand if os.path.isfile(p)), None)
+    if path is not None:
+        return path
+    shards = _shard_paths(arg) or _shard_paths(os.path.join(arg, "telemetry"))
+    return shards[0][1] if shards else None
+
+
+def _shard_paths(d: str) -> list[tuple[int, str]]:
+    """``(host, path)`` for each telemetry.host{k}.jsonl under ``d``,
+    ordered by host index."""
+    if not os.path.isdir(d):
+        return []
+    hits = []
+    for name in os.listdir(d):
+        m = re.fullmatch(r"telemetry\.host(\d+)\.jsonl", name)
+        if m:
+            hits.append((int(m.group(1)), os.path.join(d, name)))
+    return sorted(hits)
+
+
+def host_shard_records(telemetry_dir: str) -> list[dict]:
+    """Per-host mini-records from the dir's host shards (ISSUE 4
+    satellite): one summary row per ``telemetry.host{k}.jsonl``. Empty
+    for single-shard (single-host) run dirs — their report is exactly
+    the pre-fleet one.
+
+    Process 0 writes no shard (metrics.jsonl IS its stream — see
+    sinks.host_metrics_path), so when shards exist without a host-0
+    one, the main record file is merged in as host 0."""
+    shards = _shard_paths(telemetry_dir)
+    main = os.path.join(telemetry_dir, "metrics.jsonl")
+    if shards and not any(h == 0 for h, _ in shards) and os.path.isfile(main):
+        shards.insert(0, (0, main))
+    out = []
+    for host, path in shards:
+        lines, bad = load_lines(path)
+        if not lines:
+            continue
+        rec = summarize(lines, None)
+        out.append(
+            {
+                "host": host,
+                "windows": rec["windows"],
+                "last_step": rec["last_step"],
+                "exit_reason": rec["exit_reason"],
+                "step_time_p50": rec["step_time_p50"],
+                "step_time_p95": rec["step_time_p95"],
+                "examples_per_sec_last": rec["examples_per_sec_last"],
+                "steps_lost": rec["counters"].get(
+                    "resilience/steps_lost", 0
+                ),
+                "peak_live_bytes": rec["peak_live_bytes"],
+                "invalid_lines": bad,
+            }
+        )
+    return out
+
+
+def build_record(arg: str) -> tuple[dict | None, int, str]:
+    """(record, skipped-line count, error) for a run-dir argument — the
+    shared entry point for main() and tools/run_diff.py. ``record`` is
+    None exactly when ``error`` is non-empty."""
+    path = resolve_metrics_path(arg)
+    if path is None:
+        return None, 0, (
+            f"no telemetry found under {arg!r} (looked for "
+            "telemetry/metrics.jsonl and telemetry.host*.jsonl — was the "
+            "run started with --workdir and the jsonl sink enabled?)"
+        )
+    lines, skipped = load_lines(path)
+    if not lines:
+        return None, skipped, (
+            f"{path}: no valid schema-v{schema.SCHEMA_VERSION} lines "
+            f"({skipped} invalid)"
+        )
+    trace_file = os.path.join(os.path.dirname(path), "trace.json")
+    trace = None
+    if os.path.isfile(trace_file):
+        try:
+            with open(trace_file) as f:
+                trace = json.load(f)
+        except json.JSONDecodeError:
+            print(f"WARNING: unreadable trace {trace_file}", file=sys.stderr)
+    record = summarize(lines, trace)
+    hosts = host_shard_records(os.path.dirname(path))
+    record["hosts"] = hosts or None
+    p95s = [
+        (h["step_time_p95"], h["host"])
+        for h in hosts
+        if h["step_time_p95"] is not None
+    ]
+    record["slowest_host"] = max(p95s)[1] if p95s else None
+    return record, skipped, ""
 
 
 def _fmt(v, unit="", nd=2) -> str:
@@ -315,6 +437,43 @@ def render(record: dict, skipped: int) -> str:
             f"run-relative step {prof.get('start_step')} in "
             f"{_fmt(prof.get('wall_secs'), 's')} -> {prof.get('dir')}"
         )
+    # ----- schema-v3 fleet sections (omitted for v1/v2 runs) -----
+    hosts = record.get("hosts")
+    if hosts:
+        slowest = record.get("slowest_host")
+        out.append(
+            f"fleet: {len(hosts)} host shard(s)"
+            + (f"; SLOWEST host {slowest}" if slowest is not None else "")
+        )
+        for h in hosts:
+            p50, p95 = h["step_time_p50"], h["step_time_p95"]
+            out.append(
+                f"  host {h['host']}: step p50 "
+                + _fmt(p50 * 1e3 if p50 is not None else None, "ms")
+                + " / p95 "
+                + _fmt(p95 * 1e3 if p95 is not None else None, "ms")
+                + f", {_fmt(h['examples_per_sec_last'])} examples/sec, "
+                + f"lost={h['steps_lost']}, "
+                + f"ended: {h['exit_reason'] or 'UNKNOWN'}"
+                + (" <- SLOWEST" if h["host"] == slowest else "")
+            )
+    fl = record.get("fleet")
+    if fl:
+        line = (
+            f"fleet skew (last fleet line): {_fmt(fl.get('skew'), 'x')}"
+        )
+        if fl.get("slowest_host") is not None:
+            line += f", slowest host {fl['slowest_host']}"
+        if fl.get("side"):
+            line += f", {fl['side']}-side"
+        if record.get("fleet_straggler_windows"):
+            line += (
+                f"; STRAGGLER flagged in "
+                f"{record['fleet_straggler_windows']} window(s)"
+            )
+        if fl.get("emergency"):
+            line += " (emergency snapshot)"
+        out.append(line)
     if "trace_phases" in record:
         out.append("host time by span (from trace.json):")
         for name, p in record["trace_phases"].items():
@@ -343,37 +502,10 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
-    cand = [
-        args.workdir,
-        os.path.join(args.workdir, "metrics.jsonl"),
-        os.path.join(args.workdir, "telemetry", "metrics.jsonl"),
-    ]
-    path = next((p for p in cand if os.path.isfile(p)), None)
-    if path is None:
-        print(
-            f"no telemetry found under {args.workdir!r} (looked for "
-            "telemetry/metrics.jsonl — was the run started with "
-            "--workdir and the jsonl sink enabled?)",
-            file=sys.stderr,
-        )
+    record, skipped, err = build_record(args.workdir)
+    if record is None:
+        print(err, file=sys.stderr)
         return 1
-    lines, skipped = load_lines(path)
-    if not lines:
-        print(
-            f"{path}: no valid schema-v{schema.SCHEMA_VERSION} lines "
-            f"({skipped} invalid)",
-            file=sys.stderr,
-        )
-        return 1
-    trace_file = os.path.join(os.path.dirname(path), "trace.json")
-    trace = None
-    if os.path.isfile(trace_file):
-        try:
-            with open(trace_file) as f:
-                trace = json.load(f)
-        except json.JSONDecodeError:
-            print(f"WARNING: unreadable trace {trace_file}", file=sys.stderr)
-    record = summarize(lines, trace)
     print(render(record, skipped))
     if args.json:
         payload = json.dumps(record, indent=2) + "\n"

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""GPT-2 serving CLI: checkpoint -> live /generate endpoint.
+
+    python examples/gpt2/serve.py --workdir=/path/to/run --port=8000 \
+        --max_slots=8
+
+    curl -s localhost:8000/generate -d \
+        '{"text": "The ", "max_new_tokens": 32, "temperature": 0.8}'
+
+Loads the latest checkpoint (same eval_shape-template restore as
+generate.py), warms up the serving engine's whole bucket ladder (the
+AOT pass — steady state is zero-recompile, watch
+``post_warmup_recompiles`` on ``/health``), starts the continuous
+batcher and the HTTP frontend, and serves until SIGTERM — which drains
+in-flight requests, 503s new ones, and exits 0 (the same preemption
+contract as training; a second signal force-quits).
+
+Text in/out uses a BPE vocab (--vocab_dir, or vocab.json/merges.txt
+in --data_dir), falling back to raw bytes for byte-level corpora
+(vocab_size <= 256, same rule as generate.py); otherwise send token
+ids as "prompt". A schema-v4
+``kind="serving"`` stats line is appended to ``workdir/serving.jsonl``
+every ``--stats_every`` seconds (the serving counterpart of training's
+``metrics.jsonl`` — same JSONL discipline, ``/window`` serves the
+latest line).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from absl import app, flags
+
+from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
+from tensorflow_examples_tpu.train.cli import _setup
+from tensorflow_examples_tpu.train.config import define_flags_from_config
+from tensorflow_examples_tpu.train.loop import state_factory
+from tensorflow_examples_tpu.workloads import gpt2
+
+define_flags_from_config(gpt2.Gpt2Config())
+flags.DEFINE_integer("port", 8000, "HTTP port (0 = auto-assign)")
+flags.DEFINE_integer("max_slots", 8, "concurrent decode slots")
+flags.DEFINE_integer("max_queue", 64, "bounded submit queue (then 503)")
+flags.DEFINE_float("max_delay_s", 0.002, "idle burst-coalescing window")
+flags.DEFINE_float("serve_watchdog_secs", 60.0,
+                   "serve-loop hang detection (0 disables)")
+flags.DEFINE_float("stats_every", 10.0,
+                   "seconds between serving.jsonl stats lines (0 disables)")
+flags.DEFINE_string("vocab_dir", "", "dir with vocab.json+merges.txt")
+FLAGS = flags.FLAGS
+
+
+class _ByteTokenizer:
+    """generate.py's byte-level text fallback (vocab_size <= 256) with
+    the encode/decode surface the frontend expects of a tokenizer."""
+
+    def encode(self, text):
+        return list(text.encode())
+
+    def decode(self, tokens):
+        return bytes(
+            min(max(int(t), 0), 255) for t in tokens
+        ).decode(errors="replace")
+
+
+def _load_tokenizer(cfg):
+    from tensorflow_examples_tpu.data.tokenizers import ByteLevelBPE
+
+    for d in (FLAGS.vocab_dir, cfg.data_dir):
+        if d and os.path.exists(os.path.join(d, "vocab.json")):
+            return ByteLevelBPE.from_dir(d)
+    return _ByteTokenizer() if cfg.vocab_size <= 256 else None
+
+
+def main(argv):
+    del argv
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.serving import (
+        ContinuousBatcher,
+        InferenceEngine,
+        ServeConfig,
+        ServingFrontend,
+        run_until_preempted,
+    )
+
+    cfg = _setup(gpt2, gpt2.Gpt2Config())
+    if not cfg.workdir:
+        raise app.UsageError("--workdir is required for serve")
+    make_state, _ = state_factory(gpt2.make_task(cfg), cfg)
+    abstract = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+    restored = CheckpointManager(cfg.workdir).restore_latest(abstract)
+    if restored is None:
+        raise SystemExit(f"no checkpoint under {cfg.workdir}")
+    params = jax.tree.map(jnp.asarray, restored[0].params)
+
+    engine = InferenceEngine(
+        gpt2.model_config(cfg),
+        params,
+        cfg=ServeConfig(
+            max_slots=FLAGS.max_slots,
+            max_queue=FLAGS.max_queue,
+            max_delay_s=FLAGS.max_delay_s,
+            watchdog_secs=FLAGS.serve_watchdog_secs,
+        ),
+    )
+    t0 = time.perf_counter()
+    engine.warmup()
+    print(
+        f"warm: {engine.expected_compiles()} programs in "
+        f"{time.perf_counter() - t0:.1f}s; serving from step "
+        f"{restored[1]}",
+        file=sys.stderr,
+    )
+
+    batcher = ContinuousBatcher(engine).start()
+    frontend = ServingFrontend(
+        batcher, port=FLAGS.port, tokenizer=_load_tokenizer(cfg)
+    ).start()
+    print(f"listening on :{frontend.port} (POST /generate)", file=sys.stderr)
+
+    if FLAGS.stats_every > 0:
+        stats_path = os.path.join(cfg.workdir, "serving.jsonl")
+
+        def stats_loop():
+            while not batcher._stop.is_set():
+                time.sleep(FLAGS.stats_every)
+                with open(stats_path, "a") as f:
+                    f.write(json.dumps(batcher.stats_line()) + "\n")
+
+        threading.Thread(
+            target=stats_loop, name="serving-stats", daemon=True
+        ).start()
+
+    raise SystemExit(run_until_preempted(frontend))
+
+
+if __name__ == "__main__":
+    app.run(main)

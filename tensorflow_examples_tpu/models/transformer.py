@@ -403,6 +403,31 @@ def sharding_rules(extra: ShardingRules | None = None) -> ShardingRules:
 # ---------------------------------------------------------------- decoding
 
 
+def sample_tokens(
+    logits: jax.Array,
+    rng: jax.Array | None,
+    *,
+    temperature: float = 1.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Sample next-token ids from ``logits`` [..., vocab] (greedy when
+    ``temperature == 0``; ``top_k > 0`` filters to the k largest logits
+    first). The serving engine's ``_sample_row``
+    (tensorflow_examples_tpu/serving/engine.py) is the traced-knob
+    twin of this math — a batch mixes per-request settings, so the
+    static ``if``s become selects. Keep them in lockstep: the tier-1
+    batched==unbatched golden pins serving output against
+    :func:`generate`, which samples here."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
 def init_cache(model: Transformer, batch_size: int, dtype=None):
     """Allocate an empty KV cache (flax 'cache' collection).
 
@@ -459,14 +484,9 @@ def generate(
     cache = vars_out["cache"]
 
     def sample(logits, rng):
-        logits = logits.astype(jnp.float32)
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        if top_k:
-            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-            logits = jnp.where(logits < kth, NEG_INF, logits)
-        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+        return sample_tokens(
+            logits, rng, temperature=temperature, top_k=top_k
+        )
 
     rng, sub = jax.random.split(rng)
     first = sample(logits[:, -1], sub)

@@ -164,12 +164,25 @@ GMM_TILE_CAP: int = 512
 
 def _gmm_tiling(m: int, k: int, n: int) -> "tuple[int, int, int]":
     """Largest tiles <= GMM_TILE_CAP the shape admits: tm must DIVIDE m
-    (make_group_metadata raises otherwise); k/n are masked internally
-    so their tiles are only capped to the dim."""
+    (make_group_metadata raises otherwise). tk prefers the largest
+    lane-aligned (multiple-of-128) tile in [cap/2, cap] that DIVIDES
+    k — at the bench shape k=768 a capped 512 tile leaves a masked 256
+    remainder tile on every contraction pass, where 384 tiles it
+    exactly — and falls back to ``min(cap, k)`` (masked remainder)
+    when no such divisor exists. The cap/2 floor keeps shapes like
+    k=640/896 (no large divisor) on one near-cap masked pass instead
+    of many tiny exact ones — grid-step overhead is the whole reason
+    these tiles are big. n is masked internally so its tile is only
+    capped to the dim."""
     tm = GMM_TILE_CAP
     while m % tm:
         tm //= 2
-    return tm, min(GMM_TILE_CAP, k), min(GMM_TILE_CAP, n)
+    tk = next(
+        (t for t in range(GMM_TILE_CAP, GMM_TILE_CAP // 2 - 1, -128)
+         if k % t == 0),
+        min(GMM_TILE_CAP, k),
+    )
+    return tm, tk, min(GMM_TILE_CAP, n)
 
 
 def _grouped_matmul(lhs, rhs, sizes):

@@ -1,0 +1,45 @@
+"""Inference serving engine (ISSUE 5 tentpole).
+
+The training half of the repo can fit, survive faults, and observe
+itself; this package opens the inference half: load a trained
+checkpoint and serve concurrent generate/classify requests at
+TPU-friendly static shapes.
+
+Layout (one module per concern, mirroring the training stack):
+
+* ``kv_cache.py``  — preallocated slot-granular KV cache pool with
+  per-slot length tracking and the variable-length decode attention
+  that reads it (the per-slot generalization of
+  ``ops/decode.flash_decode_attention``'s populated-prefix contract).
+* ``engine.py``    — the compiled serving step: bucketed prefill +
+  fixed-shape continuous decode, warmed up ahead of traffic over the
+  padding-bucket ladder and wrapped in the PR-3 recompilation sentinel
+  so steady-state serving is provably zero-recompile.
+* ``batcher.py``   — the continuous-batching request queue: admission
+  control, max-batch/max-delay coalescing, per-request deadlines,
+  bounded-queue backpressure with a load-shed counter, futures back to
+  callers.
+* ``frontend.py``  — stdlib HTTP endpoints (``/generate`` ``/classify``
+  ``/metrics`` ``/health`` ``/window``) + SIGTERM drain with
+  resilience-layer parity (reuses ``train.resilience.PreemptionGuard``).
+
+``tools/serve_bench.py`` drives the whole stack closed-loop and banks a
+BENCH-style JSON record; ``docs/serving.md`` is the operator guide.
+"""
+
+from tensorflow_examples_tpu.serving.batcher import (  # noqa: F401
+    ContinuousBatcher,
+    DeadlineExceeded,
+    Draining,
+    QueueFull,
+    Request,
+)
+from tensorflow_examples_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine,
+    ServeConfig,
+)
+from tensorflow_examples_tpu.serving.frontend import (  # noqa: F401
+    ServingFrontend,
+    run_until_preempted,
+)
+from tensorflow_examples_tpu.serving.kv_cache import KVCachePool  # noqa: F401

@@ -1,0 +1,550 @@
+"""Continuous-batching request queue over the inference engine.
+
+The throughput story of serving (the "serves heavy traffic" half of the
+ROADMAP north star) is batching; the latency story is NOT waiting for a
+full batch. Continuous batching does both: the decode step always runs
+at the engine's fixed ``[max_slots]`` shape, and requests join (prefill
+into a free slot) and leave (retire at EOS/limit) BETWEEN steps — a new
+request never waits for the current batch to finish, a finished request
+never makes the batch wait.
+
+Flow control, outermost first:
+
+* **Backpressure**: the submit queue is bounded (``max_queue``). A full
+  queue sheds the request immediately (:class:`QueueFull`, counted in
+  ``serving/shed_total``) — the caller gets a 503 now instead of a
+  timeout later, and the queue can never grow without bound.
+* **Admission control**: a request whose prompt+generation budget
+  cannot fit the model's ``max_len`` is rejected up front
+  (``serving/rejected_total``); one whose deadline already passed while
+  queued is expired without touching the device
+  (``serving/expired_total``).
+* **Coalescing**: from idle, the first arrival opens a ``max_delay_s``
+  window so a burst prefills together before the first decode step;
+  under load, admission happens opportunistically between decode steps
+  with no added delay. ``max_batch`` caps concurrency below the slot
+  count when wanted.
+* **Deadlines**: a request past its deadline mid-generation retires
+  early with what it has (``truncated="deadline"``).
+
+Latency accounting (the histograms the frontend's ``/metrics`` renders,
+all ``registry.TimeHistogram``): ``serving/queue_wait`` (submit ->
+admitted), ``serving/prefill`` (prefill wall), ``serving/ttft``
+(submit -> first token), ``serving/tpot`` (per generated token decode
+wall), ``serving/e2e`` (submit -> done).
+
+The loop runs on one daemon thread; a ``utils.diagnostics.Watchdog``
+(``watchdog_secs > 0``) gets phase markers (``serve_idle`` /
+``serve_admit`` / ``serve_prefill`` / ``serve_decode``) so a wedged
+device step is attributed exactly like a training-loop hang.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import logging
+import queue
+import threading
+import time
+
+from tensorflow_examples_tpu.serving.engine import EngineStepError
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry.spans import span
+
+log = logging.getLogger(__name__)
+
+
+class QueueFull(RuntimeError):
+    """Bounded submit queue is full: request load-shed (HTTP 503)."""
+
+
+class Draining(RuntimeError):
+    """Batcher is draining for shutdown: new requests rejected (503)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before any token was produced."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generate/classify request (token ids in, token ids out)."""
+
+    prompt: list[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    eos_id: int | None = None
+    deadline_s: float | None = None  # relative to submit time
+    kind: str = "generate"           # generate | classify
+    classify_top_n: int = 5
+
+
+@dataclasses.dataclass
+class Result:
+    """Resolved request payload (the frontend serializes this)."""
+
+    tokens: list[int]               # generated tokens (generate)
+    prompt_len: int
+    top: list[dict] | None = None   # classify payload
+    truncated: str | None = None  # None | "deadline" | "max_len" | "shutdown"
+    queue_wait_s: float = 0.0
+    ttft_s: float | None = None
+    total_s: float = 0.0
+
+
+class _InFlight:
+    __slots__ = (
+        "req", "future", "slot", "t_submit", "t_admit", "t_first",
+        "deadline", "tokens", "last_token",
+    )
+
+    def __init__(self, req: Request, future, t_submit: float):
+        self.req = req
+        self.future = future
+        self.slot: int | None = None
+        self.t_submit = t_submit
+        self.t_admit: float | None = None
+        self.t_first: float | None = None
+        self.deadline = (
+            t_submit + req.deadline_s
+            if req.deadline_s is not None else None
+        )
+        self.tokens: list[int] = []
+        self.last_token: int | None = None
+
+
+class ContinuousBatcher:
+    def __init__(self, engine, *, registry=None, watchdog=None):
+        self.engine = engine
+        cfg = engine.cfg
+        self.max_batch = min(
+            cfg.max_batch or cfg.max_slots, cfg.max_slots
+        )
+        self.max_delay_s = cfg.max_delay_s
+        self.registry = (
+            registry if registry is not None else engine.registry
+        )
+        self._q: queue.Queue[_InFlight] = queue.Queue(
+            maxsize=cfg.max_queue
+        )
+        self._active: dict[int, _InFlight] = {}
+        # Requests the loop has dequeued but not yet admitted into
+        # _active (mid-prefill). close(drain=True)'s poll must count
+        # them or a drain landing in that window truncates an accepted
+        # request. Single-writer (the loop thread); int reads are
+        # atomic under the GIL.
+        self._staged = 0
+        self._draining = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._start_unix = time.time()
+        self._watchdog = watchdog
+        if watchdog is None and cfg.watchdog_secs > 0:
+            from tensorflow_examples_tpu.utils.diagnostics import Watchdog
+
+            self._watchdog = Watchdog(
+                cfg.watchdog_secs,
+                fatal_timeout_s=4 * cfg.watchdog_secs,
+            )
+
+    # ------------------------------------------------------------ intake
+
+    def submit(self, req: Request) -> concurrent.futures.Future:
+        """Enqueue; resolves to :class:`Result`. Raises
+        :class:`Draining`/:class:`QueueFull` instead of queueing when
+        the request can never be served promptly, and fails the future
+        fast on admission-impossible requests."""
+        reg = self.registry
+        reg.counter("serving/requests_total").inc()
+        if self._draining or self._stop.is_set():
+            reg.counter("serving/rejected_total").inc()
+            raise Draining("serving is draining; retry against a live host")
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        item = _InFlight(req, fut, time.monotonic())
+        budget = len(req.prompt) + (
+            req.max_new_tokens if req.kind == "generate" else 0
+        )
+        if req.kind not in ("generate", "classify"):
+            fut.set_exception(ValueError(f"unknown kind {req.kind!r}"))
+            reg.counter("serving/rejected_total").inc()
+            return fut
+        if not req.prompt or budget > self.engine.model_cfg.max_len:
+            fut.set_exception(
+                ValueError(
+                    f"prompt ({len(req.prompt)}) + max_new_tokens must fit "
+                    f"1..max_len={self.engine.model_cfg.max_len}"
+                )
+            )
+            reg.counter("serving/rejected_total").inc()
+            return fut
+        vocab = self.engine.model_cfg.vocab_size
+        if any(t < 0 or t >= vocab for t in req.prompt):
+            # jit-side gathers clamp out-of-range ids, which would
+            # silently generate from a DIFFERENT prompt — reject here.
+            fut.set_exception(
+                ValueError(f"prompt token ids must be in [0, {vocab})")
+            )
+            reg.counter("serving/rejected_total").inc()
+            return fut
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            reg.counter("serving/shed_total").inc()
+            raise QueueFull(
+                f"request queue at capacity ({self._q.maxsize}); load shed"
+            ) from None
+        if self._draining or self._stop.is_set():
+            # Raced close(): its queue sweep may already have passed,
+            # leaving this item unresolved in a dead batcher (the caller
+            # would block its full request timeout instead of getting an
+            # instant 503). Pull it back out if the loop hasn't taken
+            # it; whoever dequeued it first resolves the future.
+            with self._q.mutex:
+                try:
+                    self._q.queue.remove(item)
+                    removed = True
+                except ValueError:
+                    removed = False
+            if removed:
+                reg.counter("serving/rejected_total").inc()
+                raise Draining(
+                    "serving is draining; retry against a live host"
+                )
+        reg.gauge("serving/queue_depth").set(self._q.qsize())
+        return fut
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> "ContinuousBatcher":
+        if self._watchdog is not None:
+            self._watchdog.start()
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, *, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop accepting, optionally finish everything already
+        accepted (queued + in flight), then stop the loop thread."""
+        self._draining = True
+        if drain:
+            deadline = time.monotonic() + timeout
+
+            def busy():
+                return bool(
+                    self._active or self._staged or not self._q.empty()
+                )
+
+            while (
+                time.monotonic() < deadline
+                and self._thread is not None
+                and self._thread.is_alive()
+            ):
+                if not busy():
+                    # A request dequeued this instant may not have
+                    # bumped _staged yet; confirm emptiness after a
+                    # tick before declaring the drain complete.
+                    time.sleep(0.01)
+                    if not busy():
+                        break
+                time.sleep(0.005)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        # Anything still unresolved (drain=False, or the drain timed
+        # out) is failed/retired now — callers must never block forever.
+        self._fail_pending(Draining("serving shut down before drain"))
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _fail_pending(self, exc: Exception) -> None:
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            item.future.set_exception(exc)
+        for item in list(self._active.values()):
+            self._retire(item, truncated="shutdown")
+
+    # -------------------------------------------------------------- loop
+
+    def _wd(self, phase: str) -> None:
+        if self._watchdog is not None:
+            self._watchdog.enter(phase)
+
+    def _loop(self) -> None:
+        reg = self.registry
+        decode_steps = 0
+        while not self._stop.is_set():
+            staged = self._gather()
+            if staged:
+                self._wd("serve_prefill")
+                for item in staged:
+                    try:
+                        self._admit(item)
+                    except Exception as e:  # noqa: BLE001 — one bad
+                        # request must not take the serve loop down
+                        log.exception("prefill failed; failing request")
+                        if item.slot is not None:
+                            self.engine.pool.free(item.slot)
+                            item.slot = None
+                        if not item.future.done():
+                            item.future.set_exception(e)
+                        reg.counter("serving/errors_total").inc()
+                        if isinstance(e, EngineStepError):
+                            # The failed step consumed the donated KV
+                            # caches — every in-flight request's state
+                            # is gone with them.
+                            self._fail_active(e)
+                    finally:
+                        self._staged -= 1
+            if not self._active:
+                continue
+            self._wd("serve_decode")
+            t0 = time.perf_counter()
+            try:
+                with span("serve_decode_step", active=len(self._active)):
+                    entries = [
+                        (
+                            it.slot, it.last_token, it.req.seed,
+                            it.req.temperature, it.req.top_k,
+                        )
+                        for it in self._active.values()
+                    ]
+                    out = self.engine.decode(entries)
+            except Exception as e:  # noqa: BLE001 — fail the batch,
+                # keep serving: the next admissions start clean
+                log.exception("decode step failed; failing active batch")
+                reg.counter("serving/errors_total").inc()
+                self._fail_active(e)
+                continue
+            dt = time.perf_counter() - t0
+            decode_steps += 1
+            if self._watchdog is not None:
+                self._watchdog.ping(decode_steps)
+            tpot = reg.histogram("serving/tpot")
+            reg.histogram("serving/decode_step").record(dt)
+            for slot, token in out.items():
+                item = self._active[slot]
+                item.tokens.append(token)
+                item.last_token = token
+                tpot.record(dt)
+                self._maybe_finish(item)
+            reg.gauge("serving/active_requests").set(len(self._active))
+
+    def _gather(self) -> list[_InFlight]:
+        """Pull admissible requests without over-committing slots. Idle:
+        block briefly for the first arrival, then hold a
+        ``max_delay_s`` window so a burst prefills together. Busy:
+        drain whatever is queued into the free slots, no waiting."""
+        free = min(
+            self.max_batch - len(self._active),
+            self.engine.pool.num_slots - self.engine.pool.active_slots,
+        )
+        staged: list[_InFlight] = []
+        if not self._active:
+            self._wd("serve_idle")
+            try:
+                self._take(staged, timeout=0.05)
+            except queue.Empty:
+                return staged
+            window_end = time.monotonic() + self.max_delay_s
+            while len(staged) < free:
+                remaining = window_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    self._take(staged, timeout=remaining)
+                except queue.Empty:
+                    break
+        else:
+            self._wd("serve_admit")
+            while len(staged) < free:
+                try:
+                    self._take(staged)
+                except queue.Empty:
+                    break
+        self.registry.gauge("serving/queue_depth").set(self._q.qsize())
+        return staged
+
+    def _fail_active(self, exc: Exception) -> None:
+        """Fail and free every in-flight request (a step error lost or
+        poisoned the shared device state; next admissions start clean)."""
+        for it in list(self._active.values()):
+            del self._active[it.slot]
+            self.engine.pool.free(it.slot)
+            if not it.future.done():
+                it.future.set_exception(exc)
+
+    def _take(self, staged: list, timeout: float | None = None) -> None:
+        """Dequeue one request into ``staged``, counted in ``_staged``
+        the moment it leaves the queue so the drain poll never sees it
+        in neither place."""
+        item = (
+            self._q.get(timeout=timeout)
+            if timeout is not None else self._q.get_nowait()
+        )
+        self._staged += 1
+        staged.append(item)
+
+    def _admit(self, item: _InFlight) -> None:
+        reg = self.registry
+        now = time.monotonic()
+        if item.deadline is not None and now > item.deadline:
+            reg.counter("serving/expired_total").inc()
+            item.future.set_exception(
+                DeadlineExceeded(
+                    f"deadline ({item.req.deadline_s:.3f}s) passed after "
+                    f"{now - item.t_submit:.3f}s in queue"
+                )
+            )
+            return
+        slot = self.engine.pool.alloc()
+        if slot is None:  # _gather bounds by free slots; belt-and-braces
+            reg.counter("serving/shed_total").inc()
+            item.future.set_exception(QueueFull("no free KV slot"))
+            return
+        item.slot = slot
+        item.t_admit = now
+        reg.histogram("serving/queue_wait").record(now - item.t_submit)
+        req = item.req
+        t0 = time.perf_counter()
+        with span("serve_prefill", tokens=len(req.prompt)):
+            first, last_logits = self.engine.prefill(
+                slot, req.prompt, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+            )
+        reg.histogram("serving/prefill").record(time.perf_counter() - t0)
+        item.t_first = time.monotonic()
+        reg.histogram("serving/ttft").record(item.t_first - item.t_submit)
+        if req.kind == "classify":
+            from tensorflow_examples_tpu.serving.engine import top_logprobs
+
+            self.engine.pool.free(slot)
+            item.slot = None
+            self._resolve(
+                item,
+                Result(
+                    tokens=[], prompt_len=len(req.prompt),
+                    top=top_logprobs(last_logits, req.classify_top_n),
+                ),
+            )
+            return
+        item.tokens.append(first)
+        item.last_token = first
+        self._active[slot] = item
+        self._maybe_finish(item)
+
+    # ----------------------------------------------------------- retire
+
+    def _maybe_finish(self, item: _InFlight) -> None:
+        req, truncated = item.req, None
+        done = (
+            len(item.tokens) >= req.max_new_tokens
+            or (req.eos_id is not None and item.last_token == req.eos_id)
+        )
+        if not done and item.deadline is not None \
+                and time.monotonic() > item.deadline:
+            done, truncated = True, "deadline"
+        if not done and item.slot is not None and (
+            len(req.prompt) + len(item.tokens)
+            >= self.engine.model_cfg.max_len
+        ):
+            done, truncated = True, "max_len"  # admission makes this rare
+        if done:
+            self._retire(item, truncated=truncated)
+
+    def _retire(self, item: _InFlight, *, truncated: str | None) -> None:
+        if item.slot is not None and item.slot in self._active:
+            del self._active[item.slot]
+        if item.slot is not None:
+            self.engine.pool.free(item.slot)
+        self._resolve(
+            item,
+            Result(
+                tokens=item.tokens,
+                prompt_len=len(item.req.prompt),
+                truncated=truncated,
+            ),
+        )
+
+    def _resolve(self, item: _InFlight, result: Result) -> None:
+        now = time.monotonic()
+        result.queue_wait_s = (
+            (item.t_admit or now) - item.t_submit
+        )
+        result.ttft_s = (
+            item.t_first - item.t_submit if item.t_first else None
+        )
+        result.total_s = now - item.t_submit
+        reg = self.registry
+        reg.histogram("serving/e2e").record(result.total_s)
+        reg.counter("serving/completed_total").inc()
+        reg.counter("serving/generated_tokens_total").inc(
+            len(result.tokens)
+        )
+        if not item.future.set_running_or_notify_cancel():
+            return  # caller gave up; nothing to deliver
+        item.future.set_result(result)
+
+    # ------------------------------------------------------------- stats
+
+    def stats_line(self) -> dict:
+        """A schema-v4 ``kind="serving"`` JSONL line: the serving
+        counterpart of the training window line (validated in tier-1;
+        the frontend serves the latest one at ``/window`` and
+        examples/gpt2/serve.py appends them to ``serving.jsonl``)."""
+        reg = self.registry
+        counters = {
+            k: v for k, v in reg.counter_values().items()
+            if k.startswith(("serving/", "compile/"))
+        }
+        gauges = {
+            k: v for k, v in reg.gauge_values().items()
+            if k.startswith("serving/")
+        }
+        hists = reg.histogram_summaries()
+        derived = {}
+        for name in ("queue_wait", "prefill", "ttft", "tpot", "e2e"):
+            h = hists.get(f"serving/{name}")
+            if h and h["count"]:
+                derived[f"{name}_p50"] = h["p50"]
+                derived[f"{name}_p95"] = h["p95"]
+        return {
+            "schema_version": schema.SERVING_SCHEMA_VERSION,
+            "kind": "serving",
+            "step": int(
+                counters.get("serving/decode_steps", 0)
+            ),
+            "time_unix": time.time(),
+            "session_start_unix": self._start_unix,
+            "host": 0,
+            "metrics": {},
+            "counters": counters,
+            "gauges": gauges,
+            "derived": derived,
+            "serving": {
+                "active_requests": len(self._active),
+                "queue_depth": self._q.qsize(),
+                "slots": self.engine.pool.num_slots,
+                "kv_occupancy": self.engine.pool.occupancy,
+                "post_warmup_recompiles": (
+                    self.engine.post_warmup_recompiles()
+                ),
+                "draining": 1 if self._draining else 0,
+            },
+        }
+
+
+def default_registry():  # convenience re-export for the frontend/tools
+    return registry_mod.default_registry()

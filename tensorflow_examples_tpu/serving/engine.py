@@ -1,0 +1,569 @@
+"""The compiled serving step: bucketed prefill + fixed-shape decode.
+
+Shape discipline is the whole design (SURVEY.md's "as fast as the
+hardware allows" applied to inference): XLA recompiles on any new
+abstract shape, and a serving process that compiles mid-traffic turns
+a p50 of milliseconds into a p95 of seconds. So every program the
+engine runs comes from a FINITE, warmed-up ladder:
+
+* **Prefill** pads each prompt to the smallest power-of-two length
+  bucket (``ServeConfig.prefill_bucket_floor`` up to the model's
+  ``max_len``) and runs batch-1: one compiled program per rung.
+  Causal masking makes the pad rows inert — the true prompt length
+  rides in as a traced scalar that only picks the logits row and the
+  cache write extent.
+* **Decode** always runs the full ``[max_slots]`` batch — continuous
+  batching means the batch composition changes every step, so the
+  batch *shape* must not. Per-slot state (token, position, sampling
+  key/temperature/top-k) rides in as traced vectors; the KV cache is
+  sliced to the smallest power-of-two bucket covering the longest
+  active request (``kv_bucket_floor`` ladder), so short-context steps
+  read O(bucket) cache bytes — the serving-side mirror of
+  ``ops/decode.flash_decode_attention``'s populated-prefix ladder,
+  which the prefill path reuses directly under ``attention="flash"``
+  (its scalar-length contract matches prefill exactly; the per-slot
+  length *vector* of continuous decode is what
+  ``kv_cache.varlen_decode_attention`` generalizes).
+
+``warmup()`` compiles the entire ladder ahead of traffic (the
+AOT-compiled serving path: every program exists before the first
+request) and every compiled variant is wrapped in the PR-3
+``CompilationSentinel`` — a post-warmup recompile is a WARNING naming
+the exact shape delta, and ``post_warmup_recompiles()`` is the number
+CI asserts to be zero (tools/serve_bench.py banks it in the bench
+record).
+
+The forward math operates directly on the ``models/transformer.py``
+param tree (same names: wte/wpe/h_i/ln_f) rather than through flax
+``Transformer.apply``: the flax decode path keys the whole batch off
+one scalar cache index, which continuous batching cannot use. Parity
+with the flax model is pinned by tests/test_serving.py (engine vs
+``transformer.generate`` greedy decode, token-identical).
+
+Sampling reuses ``models.transformer.sample_tokens``'s exact math with
+per-request keys (``fold_in(PRNGKey(seed), absolute_position)``), so a
+request's tokens are a pure function of (params, prompt, seed) — the
+batch it happened to be coalesced into cannot change its output, which
+is what makes the continuous-batching golden test meaningful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_examples_tpu.models.transformer import TransformerConfig
+from tensorflow_examples_tpu.ops.attention import NEG_INF, attention_reference
+from tensorflow_examples_tpu.serving import kv_cache as kv_mod
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+from tensorflow_examples_tpu.telemetry.compilation import CompilationSentinel
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine + batcher knobs (one object configures the whole stack)."""
+
+    max_slots: int = 8           # concurrent requests = decode batch shape
+    prefill_bucket_floor: int = 16
+    kv_bucket_floor: int = 64
+    attention: str = "xla"       # xla | flash (flash: Pallas prefill attend)
+    cache_dtype: str = ""        # "" -> follow the params dtype
+    compile_warmup: int = 1      # expected compiles per sentinel-wrapped fn
+    # ---- continuous batcher (serving/batcher.py) ----
+    max_batch: int = 0           # admission cap; 0 -> max_slots
+    max_queue: int = 64          # bounded queue: beyond this, load-shed
+    max_delay_s: float = 0.002   # idle coalescing window before first prefill
+    watchdog_secs: float = 0.0   # 0 disables the serve-loop watchdog
+    # ---- frontend ----
+    request_timeout_s: float = 120.0
+
+
+# --------------------------------------------------------------- forward
+#
+# Pure functions over the Transformer param tree. f32-by-default like the
+# flax model (params dtype is the compute dtype); LayerNorm/softmax math
+# mirrors flax defaults (eps 1e-5, gelu approximate).
+
+
+def _layer_norm(x, p, eps=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _block_mlp(x, p):
+    h = jnp.dot(x, p["mlp_fc"]["kernel"]) + p["mlp_fc"]["bias"]
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.dot(h, p["mlp_proj"]["kernel"]) + p["mlp_proj"]["bias"]
+
+
+def _qkv(x, p):
+    """[..., d] -> q, k, v each [..., H, hd]."""
+    y = jnp.einsum("...d,dthc->...thc", x, p["qkv"]["kernel"])
+    y = y + p["qkv"]["bias"]
+    return y[..., 0, :, :], y[..., 1, :, :], y[..., 2, :, :]
+
+
+def _attn_out(att, p):
+    """[..., H, hd] attention output -> [..., d] residual contribution."""
+    return jnp.einsum("...hc,hcd->...d", att, p["proj"]["kernel"]) + p[
+        "proj"
+    ]["bias"]
+
+
+def _prefill_attend(q, k, v, *, impl: str):
+    """Causal self-attention for prefill, [B, L, H, hd] layout.
+
+    ``impl="flash"`` reuses ``ops/decode.flash_decode_attention`` with
+    its exact contract: the freshly-computed K/V ARE the populated
+    cache and the static bucket length is the scalar ``length`` — a
+    prefill is precisely the single-length case of cache attention.
+    """
+    swap = lambda t: t.transpose(0, 2, 1, 3)  # [B,L,H,D] -> [B,H,L,D]
+    if impl == "flash":
+        from tensorflow_examples_tpu.ops.decode import flash_decode_attention
+
+        out = flash_decode_attention(swap(q), swap(k), swap(v), q.shape[1])
+    else:
+        out = attention_reference(swap(q), swap(k), swap(v), causal=True)
+    return swap(out)
+
+
+def forward_full(cfg: TransformerConfig, params, tokens, *, impl="xla"):
+    """Full causal forward of ``tokens`` [B, L]: logits [B, L, V] plus
+    the per-layer K/V ([2, num_layers, B, H, L, hd]) the prefill path
+    writes into the cache. Also the engine's cacheless reference path
+    (which recomputes attention over the whole prefix per emitted
+    token)."""
+    wte = params["wte"]["embedding"]
+    positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+    x = wte[tokens] + params["wpe"]["embedding"][positions][None]
+    ks, vs = [], []
+    for layer in range(cfg.num_layers):
+        p = params[f"h_{layer}"]
+        y = _layer_norm(x, p["ln_1"])
+        q, k, v = _qkv(y, p["attn"])
+        ks.append(k)
+        vs.append(v)
+        x = x + _attn_out(_prefill_attend(q, k, v, impl=impl), p["attn"])
+        x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
+    x = _layer_norm(x, params["ln_f"])
+    return jnp.dot(x, wte.T), jnp.stack(ks), jnp.stack(vs)
+
+
+def _decode_forward(cfg: TransformerConfig, params, k_cache, v_cache,
+                    tokens, positions, *, kv_bucket: int):
+    """One continuous-decode step over every slot.
+
+    tokens/positions: [S] — each slot's input token and the cache row
+    it occupies (= the slot's pre-step populated length). Returns the
+    updated caches and next-token logits [S, V]. Slots not actively
+    decoding ride along with position 0: their write lands in a row a
+    future prefill fully overwrites, and their output is discarded.
+    """
+    wte = params["wte"]["embedding"]
+    x = wte[tokens] + params["wpe"]["embedding"][positions]
+    idx = jnp.arange(tokens.shape[0])
+    lengths = positions + 1  # populated length including the new token
+    for layer in range(cfg.num_layers):
+        p = params[f"h_{layer}"]
+        y = _layer_norm(x, p["ln_1"])
+        q, k, v = _qkv(y, p["attn"])  # [S, H, hd]
+        k_cache = k_cache.at[layer, idx, :, positions, :].set(
+            k.astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[layer, idx, :, positions, :].set(
+            v.astype(v_cache.dtype)
+        )
+        att = kv_mod.varlen_decode_attention(
+            q,
+            jax.lax.slice_in_dim(k_cache[layer], 0, kv_bucket, axis=2),
+            jax.lax.slice_in_dim(v_cache[layer], 0, kv_bucket, axis=2),
+            lengths,
+        )
+        x = x + _attn_out(att, p["attn"])
+        x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
+    x = _layer_norm(x, params["ln_f"])
+    return k_cache, v_cache, jnp.dot(x, wte.T)
+
+
+# -------------------------------------------------------------- sampling
+
+
+def _sample_row(key, logits, temp, top_k):
+    """Traced-knob clone of ``models.transformer.sample_tokens`` for ONE
+    row: temperature/top_k arrive as arrays (a batch mixes settings), so
+    the static ``if``s become selects — same math, same keys, identical
+    tokens (tests pin it)."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)
+    kth = jax.lax.dynamic_index_in_dim(
+        jnp.sort(scaled),
+        jnp.maximum(scaled.shape[0] - top_k, 0),
+        keepdims=False,
+    )
+    filtered = jnp.where(
+        (top_k > 0) & (scaled < kth), NEG_INF, scaled
+    )
+    sampled = jax.random.categorical(key, filtered).astype(jnp.int32)
+    return jnp.where(temp == 0.0, greedy, sampled)
+
+
+_sample_batch = jax.vmap(_sample_row)
+
+
+def request_key(seed: int, position: int) -> jax.Array:
+    """The per-token sampling key: a pure function of (request seed,
+    absolute position), so batched serving and the unbatched reference
+    replay draw identical samples."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), position)
+
+
+# Vmapped over per-slot (seed, position) vectors INSIDE the jitted
+# decode step — eager per-slot fold_in dispatches on the batcher loop
+# thread would sit between consecutive compiled decode steps, exactly
+# where TPOT is won or lost. Seeds are int32 (the frontend caps them at
+# 2**31 - 1) so the traced PRNGKey seeding matches the eager replay's.
+_request_key_batch = jax.vmap(request_key)
+
+
+# ---------------------------------------------------------------- engine
+
+
+class EngineStepError(RuntimeError):
+    """A compiled prefill/decode step failed at runtime. The KV caches
+    were donated to the failed call (consumed on donation-honoring
+    backends), so the engine has already reallocated them — every
+    in-flight request's cache state is gone and the batcher must fail
+    the whole active set, not just the request being stepped."""
+
+
+class InferenceEngine:
+    """Loads params once, owns the KV pool, runs the compiled steps.
+
+    Device-facing methods (``prefill`` / ``decode`` / ``warmup``) are
+    single-threaded by contract — the continuous batcher's loop thread
+    is the only caller. ``submit``-side concurrency lives in
+    serving/batcher.py.
+    """
+
+    def __init__(
+        self,
+        model_cfg: TransformerConfig,
+        params,
+        *,
+        cfg: ServeConfig | None = None,
+        registry=None,
+    ):
+        if model_cfg.moe_experts:
+            raise NotImplementedError(
+                "serving engine currently covers dense GPT-2 models only"
+            )
+        if model_cfg.attention not in ("xla", "flash"):
+            # ring/ulysses are training-side context-parallel impls.
+            raise ValueError(
+                f"model attention={model_cfg.attention!r}; the serving "
+                "forward supports 'xla' or 'flash'"
+            )
+        self.model_cfg = model_cfg
+        self.cfg = cfg or ServeConfig()
+        if self.cfg.attention not in ("xla", "flash"):
+            raise ValueError(
+                f"ServeConfig.attention={self.cfg.attention!r} not in "
+                "('xla', 'flash')"
+            )
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.registry = (
+            registry if registry is not None
+            else registry_mod.default_registry()
+        )
+        self.sentinel = CompilationSentinel(
+            warmup=self.cfg.compile_warmup, registry=self.registry
+        )
+        param_dtype = self.params["wte"]["embedding"].dtype
+        cache_dtype = (
+            jnp.dtype(self.cfg.cache_dtype)
+            if self.cfg.cache_dtype
+            else param_dtype
+        )
+        self.pool = kv_mod.KVCachePool(
+            num_layers=model_cfg.num_layers,
+            num_slots=self.cfg.max_slots,
+            num_heads=model_cfg.num_heads,
+            max_len=model_cfg.max_len,
+            head_dim=model_cfg.head_dim,
+            dtype=cache_dtype,
+            registry=self.registry,
+        )
+        self.prefill_ladder = kv_mod.bucket_ladder(
+            self.cfg.prefill_bucket_floor, model_cfg.max_len
+        )
+        self.kv_ladder = kv_mod.bucket_ladder(
+            self.cfg.kv_bucket_floor, model_cfg.max_len
+        )
+        # The KV caches are donated (args 1/2 after partial binds the
+        # bucket): both steps return the updated caches and the pool
+        # unconditionally reassigns from the outputs, so XLA can alias
+        # in place instead of copying two [L, slots, H, max_len, D]
+        # buffers per generated token. Backends without donation
+        # support just ignore the hint.
+        self._prefill_fns = {
+            lb: self.sentinel.wrap(
+                jax.jit(
+                    functools.partial(self._prefill_impl, lb),
+                    donate_argnums=(1, 2),
+                ),
+                f"serve_prefill_L{lb}",
+            )
+            for lb in self.prefill_ladder
+        }
+        self._decode_fns = {
+            kb: self.sentinel.wrap(
+                jax.jit(
+                    functools.partial(self._decode_impl, kb),
+                    donate_argnums=(1, 2),
+                ),
+                f"serve_decode_K{kb}",
+            )
+            for kb in self.kv_ladder
+        }
+        self.warmed = False
+        self._ref_fwd = None
+
+    # ----------------------------------------------------- compiled fns
+
+    def _prefill_impl(self, bucket, params, k_cache, v_cache, slot,
+                      tokens, length, key, temp, top_k):
+        """tokens [1, bucket] (right-padded), length = true prompt len.
+        Writes the slot's cache rows [0, bucket) (pad rows carry
+        garbage K/V that per-slot length masking never reads), samples
+        the first generated token from the logits at row length-1."""
+        del bucket  # static: encoded in tokens.shape
+        logits, ks, vs = forward_full(
+            self.model_cfg, params, tokens, impl=self.cfg.attention
+        )
+        # [L, 1, bucket, H, hd] -> [L, 1, H, bucket, hd] cache layout.
+        kstack = ks.transpose(0, 1, 3, 2, 4).astype(k_cache.dtype)
+        vstack = vs.transpose(0, 1, 3, 2, 4).astype(v_cache.dtype)
+        start = (0, slot.astype(jnp.int32), 0, 0, 0)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, kstack, start)
+        v_cache = jax.lax.dynamic_update_slice(v_cache, vstack, start)
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], length - 1, keepdims=False
+        )
+        return k_cache, v_cache, _sample_row(key, last, temp, top_k), last
+
+    def _decode_impl(self, bucket, params, k_cache, v_cache, tokens,
+                     positions, seeds, temps, top_ks):
+        k_cache, v_cache, logits = _decode_forward(
+            self.model_cfg, params, k_cache, v_cache, tokens, positions,
+            kv_bucket=bucket,
+        )
+        # The sampled token lands at sequence index position + 1.
+        keys = _request_key_batch(seeds, positions + 1)
+        return k_cache, v_cache, _sample_batch(keys, logits, temps, top_ks)
+
+    # --------------------------------------------------------- lifecycle
+
+    def warmup(self) -> dict[str, int]:
+        """Compile the full bucket ladder ahead of traffic (the AOT
+        pass). Returns per-fn compile counts; after this, any further
+        compile is a sentinel-warned recompile and
+        ``post_warmup_recompiles()`` counts it."""
+        s = self.cfg.max_slots
+        zero = jnp.zeros((), jnp.int32)
+        key = jax.random.PRNGKey(0)
+        ftemp = jnp.float32(0.0)
+        for lb in self.prefill_ladder:
+            self.pool.k, self.pool.v, tok, _ = self._prefill_fns[lb](
+                self.params, self.pool.k, self.pool.v, zero,
+                jnp.zeros((1, lb), jnp.int32), zero + 1, key, ftemp, zero,
+            )
+            tok.block_until_ready()
+        for kb in self.kv_ladder:
+            self.pool.k, self.pool.v, toks = self._decode_fns[kb](
+                self.params, self.pool.k, self.pool.v,
+                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.float32),
+                jnp.zeros((s,), jnp.int32),
+            )
+            toks.block_until_ready()
+        self.pool.reset()
+        self.warmed = True
+        counts = self.sentinel.compile_counts()
+        log.info(
+            "serving engine warm: %d compiled programs (%s)",
+            sum(counts.values()),
+            ", ".join(sorted(counts)),
+        )
+        return counts
+
+    def expected_compiles(self) -> int:
+        return len(self.prefill_ladder) + len(self.kv_ladder)
+
+    def post_warmup_recompiles(self) -> int:
+        """Total compiles beyond each variant's warmup allowance — the
+        number that must be 0 in steady state (CI asserts it)."""
+        return sum(
+            max(0, n - self.sentinel.warmup)
+            for n in self.sentinel.compile_counts().values()
+        )
+
+    # ------------------------------------------------------ request ops
+
+    def prefill(self, slot: int, prompt: Sequence[int], *, seed: int = 0,
+                temperature: float = 0.0, top_k: int = 0):
+        """Run a prompt into ``slot``; returns (first generated token,
+        last-position logits as numpy — the classify payload)."""
+        n = len(prompt)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n > self.model_cfg.max_len:
+            raise ValueError(
+                f"prompt length {n} exceeds max_len {self.model_cfg.max_len}"
+            )
+        bucket = kv_mod.pick_bucket(self.prefill_ladder, n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = prompt
+        try:
+            self.pool.k, self.pool.v, tok, last = self._prefill_fns[bucket](
+                self.params, self.pool.k, self.pool.v,
+                jnp.int32(slot), jnp.asarray(tokens), jnp.int32(n),
+                request_key(seed, n), jnp.float32(temperature),
+                jnp.int32(top_k),
+            )
+        except Exception as e:
+            self.pool.reallocate()
+            raise EngineStepError(
+                f"compiled prefill step failed (KV caches reallocated): "
+                f"{type(e).__name__}: {e}"
+            ) from e
+        self.pool.lengths[slot] = n
+        self.registry.counter("serving/prefill_tokens").inc(n)
+        return int(tok), np.asarray(last)
+
+    def decode(self, entries: Sequence[tuple[int, int, int, float, int]]):
+        """One continuous-decode step. ``entries`` is the active set:
+        (slot, input_token, seed, temperature, top_k) per request —
+        every entry's input token sits at cache row
+        ``pool.lengths[slot]``. Returns {slot: generated token}."""
+        if not entries:
+            return {}
+        s = self.cfg.max_slots
+        tokens = np.zeros((s,), np.int32)
+        positions = np.zeros((s,), np.int32)
+        temps = np.zeros((s,), np.float32)
+        top_ks = np.zeros((s,), np.int32)
+        seeds = np.zeros((s,), np.int32)
+        slots = []
+        for slot, token, seed, temp, tk in entries:
+            pos = int(self.pool.lengths[slot])
+            tokens[slot] = token
+            positions[slot] = pos
+            temps[slot] = temp
+            top_ks[slot] = tk
+            seeds[slot] = seed
+            slots.append(slot)
+        bucket = kv_mod.pick_bucket(
+            self.kv_ladder, int(positions.max(initial=0)) + 1
+        )
+        try:
+            self.pool.k, self.pool.v, out = self._decode_fns[bucket](
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(seeds), jnp.asarray(temps),
+                jnp.asarray(top_ks),
+            )
+        except Exception as e:
+            self.pool.reallocate()
+            raise EngineStepError(
+                f"compiled decode step failed (KV caches reallocated): "
+                f"{type(e).__name__}: {e}"
+            ) from e
+        out = np.asarray(out)
+        for slot in slots:
+            self.pool.lengths[slot] += 1
+        self.registry.counter("serving/decode_steps").inc()
+        self.registry.counter("serving/decode_tokens").inc(len(slots))
+        return {slot: int(out[slot]) for slot in slots}
+
+    # ------------------------------------------------------- references
+
+    def _reference_step(self):
+        """One jitted (params, tokens[1, max_len], length, key, temp,
+        top_k) -> (sampled token, last-row logits) step for the
+        reference replay. Always the full ``max_len`` shape — rows past
+        ``length`` hold zeros that causal masking makes inert, so ONE
+        compile covers every prefix length and the replay is not
+        eager-dispatch-bound. Deliberately NOT sentinel-wrapped: the
+        reference is test/verify machinery, never the serving path, and
+        must not count against the zero-recompile budget."""
+        if self._ref_fwd is None:
+            def step(params, tokens, length, key, temp, top_k):
+                logits, _, _ = forward_full(
+                    self.model_cfg, params, tokens, impl="xla"
+                )
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], length - 1, keepdims=False
+                )
+                return _sample_row(key, last, temp, top_k), last
+
+            self._ref_fwd = jax.jit(step)
+        return self._ref_fwd
+
+    def _reference_last(self, toks: list[int], *, seed: int,
+                        temperature: float, top_k: int):
+        padded = np.zeros((1, self.model_cfg.max_len), np.int32)
+        padded[0, :len(toks)] = toks
+        return self._reference_step()(
+            self.params, jnp.asarray(padded), jnp.int32(len(toks)),
+            request_key(seed, len(toks)), jnp.float32(temperature),
+            jnp.int32(top_k),
+        )
+
+    def reference_generate(self, prompt: Sequence[int], *, max_new: int,
+                           seed: int = 0, temperature: float = 0.0,
+                           top_k: int = 0, eos_id: int | None = None):
+        """The unbatched, cacheless replay of one request: a full
+        forward of the whole prefix per emitted token, sampling with
+        the same (seed, position) keys. O(n^2) on purpose — it shares
+        no batching, bucketing, or KV-cache machinery with the serving
+        path, which is what makes the continuous-batching golden
+        comparison meaningful."""
+        toks = [int(t) for t in prompt]
+        out: list[int] = []
+        for _ in range(max_new):
+            tok, _ = self._reference_last(
+                toks, seed=seed, temperature=temperature, top_k=top_k
+            )
+            nxt = int(tok)
+            out.append(nxt)
+            toks.append(nxt)
+            if eos_id is not None and nxt == eos_id:
+                break
+        return out
+
+    def reference_classify(self, prompt: Sequence[int], *, top_n: int = 5):
+        _, last = self._reference_last(
+            [int(t) for t in prompt], seed=0, temperature=0.0, top_k=0
+        )
+        return top_logprobs(np.asarray(last), top_n)
+
+
+def top_logprobs(logits: np.ndarray, top_n: int) -> list[dict]:
+    """Next-token distribution head: top-n (token, logprob) pairs."""
+    x = logits.astype(np.float64)
+    logz = np.log(np.sum(np.exp(x - x.max()))) + x.max()
+    order = np.argsort(x)[::-1][:top_n]
+    return [
+        {"token": int(t), "logprob": float(x[t] - logz)} for t in order
+    ]

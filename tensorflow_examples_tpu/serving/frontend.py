@@ -1,0 +1,386 @@
+"""HTTP frontend + SIGTERM drain for the serving stack.
+
+Extends the ISSUE-4 stdlib ``http.server`` pattern
+(``telemetry/serve.py``) with the request side: POST endpoints that
+feed the continuous batcher and block on its futures, next to the same
+observability surface a training process exposes.
+
+Endpoints:
+
+* ``POST /generate`` — body ``{"prompt": [ids], "max_new_tokens": n,
+  "temperature": t, "top_k": k, "seed": s, "eos_id": id,
+  "deadline_s": d}`` (all but ``prompt`` optional; ``"text"`` may
+  replace ``prompt`` when the frontend was built with a tokenizer).
+  Replies ``{"tokens": [...], "prompt_len": n, "truncated": null,
+  "queue_wait_s": ..., "ttft_s": ..., "total_s": ...}`` (+ ``"text"``
+  with a tokenizer).
+* ``POST /classify`` — same request shape (no generation knobs);
+  replies the top-n next-token distribution
+  ``{"top": [{"token": id, "logprob": lp}, ...]}``.
+* ``GET /metrics`` — the registry as Prometheus text
+  (``telemetry.serve.render_prometheus``): the ``serving/*`` counters
+  and gauges plus the latency summaries — ``serving_queue_wait``,
+  ``serving_prefill``, ``serving_ttft``, ``serving_tpot``,
+  ``serving_e2e`` — each with p50/p95/p99 quantiles.
+* ``GET /health`` — JSON: draining flag, active/queued requests, KV
+  occupancy, post-warmup recompile count, watchdog phase when the
+  batcher runs one. 503 once draining (a load balancer stops routing
+  here the moment the drain starts).
+* ``GET /window`` — the latest schema-v4 ``kind="serving"`` stats line
+  (``ContinuousBatcher.stats_line``).
+
+Status mapping (the flow-control contract, outermost first):
+``QueueFull``/``Draining`` -> 503 (retry elsewhere/later, body says
+which), ``DeadlineExceeded`` -> 504, admission ``ValueError``/bad JSON
+-> 400, anything else -> 500 with the exception class named.
+
+**SIGTERM drain** (resilience-layer parity with
+``train.resilience.PreemptionGuard``): :func:`run_until_preempted`
+installs the guard, serves until SIGTERM/SIGINT, then (1) flips the
+batcher to draining — new submits raise ``Draining``, the frontend
+returns 503 — (2) waits for every accepted request to finish, (3)
+closes the ports, (4) returns exit code 0. A second signal force-quits
+through the guard's escalation path, exactly like training.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import http.server
+import json
+import logging
+import threading
+import time
+
+from tensorflow_examples_tpu.serving.batcher import (
+    ContinuousBatcher,
+    DeadlineExceeded,
+    Draining,
+    QueueFull,
+    Request,
+)
+from tensorflow_examples_tpu.telemetry.serve import (
+    json_safe,
+    render_prometheus,
+)
+# Module-level on purpose: a lazy import inside run_until_preempted would
+# leave a multi-second window after "ready" during which SIGTERM still
+# hits the default handler (import of the train package is slow) — the
+# guard must be installable the instant the caller asks.
+from tensorflow_examples_tpu.train.resilience import PreemptionGuard
+
+log = logging.getLogger(__name__)
+
+_MAX_BODY = 1 << 20  # 1 MiB of JSON is already a pathological prompt
+
+
+def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
+    """Validated JSON body -> :class:`Request` (raises ValueError with a
+    client-facing message on any malformed field)."""
+    if not isinstance(body, dict):
+        raise ValueError("body must be a JSON object")
+    prompt = body.get("prompt")
+    if prompt is None and "text" in body:
+        if tokenizer is None:
+            raise ValueError(
+                "this server has no tokenizer; send token ids as 'prompt'"
+            )
+        if not isinstance(body["text"], str):
+            raise ValueError("'text' must be a string")
+        prompt = tokenizer.encode(body["text"])
+    if (
+        not isinstance(prompt, list)
+        or not prompt
+        or not all(isinstance(t, int) and not isinstance(t, bool)
+                   for t in prompt)
+    ):
+        raise ValueError("'prompt' must be a non-empty list of token ids")
+    known = {
+        "prompt", "text", "max_new_tokens", "temperature", "top_k",
+        "seed", "eos_id", "deadline_s", "top_n",
+    }
+    unknown = set(body) - known
+    if unknown:
+        raise ValueError(f"unknown fields: {sorted(unknown)}")
+
+    def number(name, default, cls=float, minimum=None, maximum=None):
+        v = body.get(name, default)
+        if v is None:
+            if default is None:  # nullable fields (eos_id, deadline_s)
+                return None
+            raise ValueError(f"'{name}' must be a number")
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(f"'{name}' must be a number")
+        if cls is int and isinstance(v, float) and not v.is_integer():
+            raise ValueError(f"'{name}' must be an integer")
+        v = cls(v)
+        if minimum is not None and v < minimum:
+            raise ValueError(f"'{name}' must be >= {minimum}")
+        if maximum is not None and v > maximum:
+            raise ValueError(f"'{name}' must be <= {maximum}")
+        return v
+
+    return Request(
+        prompt=[int(t) for t in prompt],
+        max_new_tokens=number("max_new_tokens", 16, int, 1),
+        temperature=number("temperature", 0.0, float, 0.0),
+        top_k=number("top_k", 0, int, 0),
+        seed=number("seed", 0, int, 0, maximum=2**31 - 1),
+        eos_id=number("eos_id", None, int, 0),
+        deadline_s=number("deadline_s", None, float, 0.0),
+        kind=kind,
+        classify_top_n=number("top_n", 5, int, 1),
+    )
+
+
+class ServingFrontend:
+    """The serving process's HTTP surface. One daemon-threaded
+    ``ThreadingHTTPServer``; request handlers block on batcher futures
+    (scrape endpoints never do), so a slow generation cannot starve
+    ``/metrics``."""
+
+    def __init__(
+        self,
+        batcher: ContinuousBatcher,
+        *,
+        port: int = 0,
+        bind_host: str = "",
+        tokenizer=None,
+    ):
+        self.batcher = batcher
+        self.tokenizer = tokenizer
+        self.requested_port = int(port)
+        self.bind_host = bind_host
+        self.port: int | None = None
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ payloads
+
+    def handle_request(self, body: dict, *, kind: str) -> tuple[int, dict]:
+        """(status, reply) for one generate/classify body — the HTTP
+        handler minus the socket, so tests and the bench can drive the
+        full admission/serialization path in-process."""
+        try:
+            req = _request_from_body(
+                body, kind=kind, tokenizer=self.tokenizer
+            )
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        try:
+            fut = self.batcher.submit(req)
+            result = fut.result(
+                timeout=self.batcher.engine.cfg.request_timeout_s
+            )
+        except Draining as e:
+            return 503, {"error": str(e), "draining": True}
+        except QueueFull as e:
+            return 503, {"error": str(e), "retry": True}
+        except DeadlineExceeded as e:
+            return 504, {"error": str(e)}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except concurrent.futures.TimeoutError:
+            return 504, {
+                "error": (
+                    "request timed out after "
+                    f"{self.batcher.engine.cfg.request_timeout_s}s"
+                )
+            }
+        except Exception as e:  # noqa: BLE001 — surface, don't crash
+            log.exception("request failed")
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+        reply: dict = {
+            "prompt_len": result.prompt_len,
+            "truncated": result.truncated,
+            "queue_wait_s": result.queue_wait_s,
+            "ttft_s": result.ttft_s,
+            "total_s": result.total_s,
+        }
+        if kind == "classify":
+            reply["top"] = result.top
+        else:
+            reply["tokens"] = result.tokens
+            if self.tokenizer is not None:
+                reply["text"] = self.tokenizer.decode(result.tokens)
+        return 200, reply
+
+    def health_payload(self) -> tuple[int, dict]:
+        batcher = self.batcher
+        engine = batcher.engine
+        body = {
+            "ok": not batcher.draining,
+            "draining": batcher.draining,
+            "active_requests": len(batcher._active),
+            "queue_depth": batcher._q.qsize(),
+            "kv_occupancy": engine.pool.occupancy,
+            "post_warmup_recompiles": engine.post_warmup_recompiles(),
+            "warmed": engine.warmed,
+        }
+        wd = batcher._watchdog
+        if wd is not None:
+            status = wd.status()
+            body.update(
+                phase=status["phase"],
+                phase_age_secs=status["phase_age_secs"],
+                stalled_secs=status["stalled_secs"],
+            )
+        return (200 if body["ok"] else 503), body
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "ServingFrontend":
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, status, content_type, payload: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _send_json(self, status, obj):
+                self._send(
+                    status,
+                    "application/json",
+                    (json.dumps(json_safe(obj)) + "\n").encode(),
+                )
+
+            def do_POST(self):  # noqa: N802 - http.server contract
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path not in ("/generate", "/classify"):
+                    self._send_json(
+                        404, {"error": "POST endpoints: /generate /classify"}
+                    )
+                    return
+                try:
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                    except ValueError:
+                        n = -1
+                    if n < 0:
+                        self._send_json(
+                            400, {"error": "bad Content-Length header"}
+                        )
+                        return
+                    if n > _MAX_BODY:
+                        self._send_json(
+                            413, {"error": f"body exceeds {_MAX_BODY} bytes"}
+                        )
+                        return
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                    except json.JSONDecodeError as e:
+                        self._send_json(400, {"error": f"bad JSON: {e}"})
+                        return
+                    status, reply = server.handle_request(
+                        body, kind=path[1:]
+                    )
+                    self._send_json(status, reply)
+                except ConnectionError:  # client went away mid-write
+                    pass
+
+            def do_GET(self):  # noqa: N802 - http.server contract
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            render_prometheus(
+                                server.batcher.registry
+                            ).encode(),
+                        )
+                    elif path == "/health":
+                        self._send_json(*server.health_payload())
+                    elif path == "/window":
+                        self._send_json(200, server.batcher.stats_line())
+                    else:
+                        self._send(
+                            404,
+                            "text/plain; charset=utf-8",
+                            b"GET: /metrics /health /window   "
+                            b"POST: /generate /classify\n",
+                        )
+                except ConnectionError:
+                    pass
+
+            def log_message(self, fmt, *args):  # quiet under load
+                log.debug("serving frontend: " + fmt, *args)
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.bind_host, self.requested_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="serving-frontend",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info(
+            "serving frontend live on port %d "
+            "(POST /generate /classify; GET /metrics /health /window)",
+            self.port,
+        )
+        return self
+
+    def url(self, path: str = "/generate") -> str:
+        host = self.bind_host or "127.0.0.1"
+        return f"http://{host}:{self.port}{path}"
+
+    def close(self) -> None:
+        """Idempotent; stops accepting connections (in-flight handler
+        threads finish their writes — they hold batcher futures, which
+        the drain resolves first)."""
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)
+
+
+def run_until_preempted(
+    frontend: ServingFrontend,
+    *,
+    poll_s: float = 0.2,
+    drain_timeout_s: float = 60.0,
+    guard=None,
+) -> int:
+    """Serve until SIGTERM/SIGINT, then drain and return 0.
+
+    The serving mirror of the trainer's preemption contract
+    (``train.resilience.PreemptionGuard``): first signal starts a clean
+    drain — the batcher rejects new work (frontend answers 503), every
+    already-accepted request runs to completion, ports close, exit 0 —
+    and a second signal force-quits. ``guard`` is injectable for tests
+    (anything with ``.install()`` and ``.requested``).
+    """
+    if guard is None:
+        guard = PreemptionGuard()
+    guard.install()
+    batcher = frontend.batcher
+    try:
+        while not guard.requested:
+            time.sleep(poll_s)
+        log.warning(
+            "preemption requested: draining %d active + %d queued requests",
+            len(batcher._active), batcher._q.qsize(),
+        )
+        batcher.registry.counter("serving/preemptions").inc()
+        batcher.close(drain=True, timeout=drain_timeout_s)
+        log.info("drain complete; shutting down frontend")
+        return 0
+    finally:
+        frontend.close()
+        if not batcher._stop.is_set():
+            batcher.close(drain=False)
+        if hasattr(guard, "uninstall"):
+            guard.uninstall()

@@ -1,0 +1,211 @@
+"""Slot-granular KV cache pool + variable-length decode attention.
+
+The training-side decode path (``models/transformer.py`` flax ``cache``
+collection) keys the whole batch off ONE scalar index — fine for
+sampling a fixed batch in lockstep, useless for continuous batching
+where every concurrent request sits at a different position. This
+module owns the serving-side replacement:
+
+* ``KVCachePool`` preallocates the worst-case cache ONCE —
+  ``[layers, slots, heads, max_len, head_dim]`` for K and V — and hands
+  out *slots* (one per in-flight request) with host-side alloc/free and
+  per-slot populated-length tracking. Slot state is published as
+  ``serving/kv_occupancy`` / ``serving/kv_tokens`` gauges on every
+  transition, so a scrape always sees live cache pressure.
+* ``varlen_decode_attention`` is the per-slot generalization of
+  ``ops/decode.flash_decode_attention``'s contract: each slot's query
+  attends over exactly its own populated prefix (``lengths`` rides in
+  as a vector, not a scalar). The bucket discipline lives in the
+  caller (``engine.py``): the cache is sliced to the smallest
+  power-of-two KV bucket covering the longest active request before
+  this runs, so a step over mostly-short requests reads O(bucket)
+  cache bytes, not O(max_len) — the same populated-prefix economics as
+  the flash-decode bucket ladder, expressed through XLA slicing
+  instead of a Pallas grid (scalar-prefetch index maps cannot see a
+  per-slot length vector; the single-length case — prefill — reuses
+  the Pallas kernel directly, see ``engine._prefill_attend``).
+
+Everything here is functionally pure on the device side: the pool's
+arrays are replaced wholesale by the jitted steps that update them, so
+the engine composes with donation on backends that support it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_examples_tpu.ops.attention import NEG_INF
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+
+
+def bucket_ladder(floor: int, max_len: int) -> list[int]:
+    """Power-of-two padding buckets: ``floor, 2*floor, ...`` capped at
+    (and always including) ``max_len``. One compiled program per rung;
+    the smallest sufficient rung serves each request."""
+    if floor < 1 or max_len < 1:
+        raise ValueError(f"floor={floor} and max_len={max_len} must be >= 1")
+    ladder: list[int] = []
+    b = min(floor, max_len)
+    while b < max_len:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_len)
+    return ladder
+
+
+def pick_bucket(ladder: list[int], needed: int) -> int:
+    """Smallest rung >= needed (ladder is ascending; last rung = max)."""
+    for b in ladder:
+        if b >= needed:
+            return b
+    raise ValueError(
+        f"needed={needed} exceeds the largest bucket {ladder[-1]}"
+    )
+
+
+def varlen_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    lengths: jax.Array,
+    *,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Single-token attention over per-slot populated cache prefixes.
+
+    q: [S, H, D] — one new query per slot, sitting at global position
+    ``lengths[s] - 1`` (its own K/V already written to the cache).
+    k_cache / v_cache: [S, H, Kb, D] — the cache sliced to the active
+    KV bucket; slots' rows >= their length are garbage and masked.
+    lengths: [S] int32 populated lengths INCLUDING the new token.
+
+    Returns [S, H, D]. Numerics mirror
+    ``ops/decode.decode_attention_reference`` (f32 scores/softmax,
+    output cast back to q.dtype) with the scalar length promoted to a
+    vector — slot s sees columns < lengths[s], nothing else.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "shd,shkd->shk", q, k_cache, preferred_element_type=jnp.float32
+    ) * sm_scale
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(col < lengths[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    return jnp.einsum(
+        "shk,shkd->shd", p, v_cache, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+class KVCachePool:
+    """Preallocated per-request KV slots with host-side bookkeeping.
+
+    Device state: ``k``/``v`` [L, S, H, max_len, D], replaced wholesale
+    by the engine's jitted steps. Host state: a free-slot list and the
+    per-slot populated lengths (the numpy mirror the engine feeds back
+    into every decode step). Thread-safe: the batcher loop allocates
+    and frees while frontend threads read occupancy.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_layers: int,
+        num_slots: int,
+        num_heads: int,
+        max_len: int,
+        head_dim: int,
+        dtype=jnp.float32,
+        registry=None,
+    ):
+        if num_slots < 1:
+            raise ValueError(f"num_slots={num_slots} must be >= 1")
+        self.num_layers = num_layers
+        self.num_slots = num_slots
+        self.num_heads = num_heads
+        self.max_len = max_len
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self._registry = registry
+        shape = (num_layers, num_slots, num_heads, max_len, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.lengths = np.zeros((num_slots,), np.int32)
+        self._free = list(range(num_slots - 1, -1, -1))  # pop() -> slot 0 first
+        self._lock = threading.Lock()
+        self._publish()
+
+    # ------------------------------------------------------------- slots
+
+    def _reg(self):
+        return (
+            self._registry
+            if self._registry is not None
+            else registry_mod.default_registry()
+        )
+
+    def _publish(self) -> None:
+        reg = self._reg()
+        active = self.num_slots - len(self._free)
+        reg.gauge("serving/kv_occupancy").set(active / self.num_slots)
+        reg.gauge("serving/kv_slots_active").set(active)
+        reg.gauge("serving/kv_tokens").set(int(self.lengths.sum()))
+
+    def alloc(self) -> int | None:
+        """Claim a free slot (None when the pool is full). The slot's
+        length starts at 0; the engine's prefill sets it."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self.lengths[slot] = 0
+            self._publish()
+            return slot
+
+    def free(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._free:  # double-free is a caller bug
+                raise ValueError(f"slot {slot} is already free")
+            self.lengths[slot] = 0
+            self._free.append(slot)
+            self._publish()
+
+    def reallocate(self) -> None:
+        """Replace ``k``/``v`` with fresh zeroed device arrays. The
+        engine calls this when a donated compiled step fails at
+        runtime: donation consumed the old buffers, so without
+        replacement every later step would hit 'Array has been
+        deleted'. Slot bookkeeping is untouched — the batcher fails and
+        frees the whole in-flight set (its KV is gone) right after."""
+        shape = (self.num_layers, self.num_slots, self.num_heads,
+                 self.max_len, self.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+
+    def reset(self) -> None:
+        """Release every slot and zero the length mirror (the device
+        arrays keep whatever garbage they hold — unpopulated rows are
+        never read). Used after engine warmup."""
+        with self._lock:
+            self.lengths[:] = 0
+            self._free = list(range(self.num_slots - 1, -1, -1))
+            self._publish()
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.active_slots / self.num_slots
+
+    def max_active_length(self) -> int:
+        """Longest populated prefix over all slots (0 when idle) — the
+        engine picks the decode KV bucket from this."""
+        with self._lock:
+            return int(self.lengths.max(initial=0))

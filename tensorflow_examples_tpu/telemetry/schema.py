@@ -1,5 +1,5 @@
 """Self-describing JSONL metrics schema (ISSUE 2 CI satellite; v2 in
-ISSUE 3; v3 in ISSUE 4).
+ISSUE 3; v3 in ISSUE 4; v4 in ISSUE 5).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -59,6 +59,15 @@ Line shape (version 3; version-1/-2 lines remain valid input)::
         "emergency": true            # optional: cached snapshot from the
                                      #   watchdog-fatal path (no collective)
       }
+
+      # --- version 4 additions (serving/batcher.py stats lines) ---
+      "serving": {                   # REQUIRED on (and exclusive to)
+                                     #   kind == "serving" lines; all
+                                     #   numeric
+        "active_requests": 3, "queue_depth": 0, "slots": 8,
+        "kv_occupancy": 0.375, "post_warmup_recompiles": 0,
+        "draining": 0
+      }
     }
 
 Version-1/-2 lines (the pre-ISSUE-3/-4 streams) carry none of the later
@@ -73,11 +82,20 @@ from typing import Any
 
 SCHEMA_VERSION = 3
 
-SUPPORTED_VERSIONS = (1, 2, 3)
+# Version 4 (ISSUE 5): the serving stack's request-side line. Training
+# lines stay v3 — SCHEMA_VERSION is what the trainer hub stamps;
+# serving/batcher.py stamps SERVING_SCHEMA_VERSION on its
+# ``kind="serving"`` stats lines (a v3-shaped line plus a required
+# "serving" object: active_requests / queue_depth / kv_occupancy /
+# post_warmup_recompiles / draining, all numeric).
+SERVING_SCHEMA_VERSION = 4
+
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
-KINDS = KINDS_V2 + ("fleet",)
+KINDS_V3 = KINDS_V2 + ("fleet",)
+KINDS = KINDS_V3 + ("serving",)
 
 _REQUIRED = ("schema_version", "kind", "step", "time_unix",
              "session_start_unix", "metrics", "counters", "gauges",
@@ -89,6 +107,14 @@ _V2_FIELDS = ("memory", "compile", "profile")
 
 # v3-only top-level fields, same rule for v1/v2 lines.
 _V3_FIELDS = ("host", "fleet")
+
+# v4-only top-level objects, same rule for v1/v2/v3 lines.
+_V4_FIELDS = ("serving",)
+
+# Required keys of a v4 serving object (the writer is
+# serving/batcher.py stats_line; every one is numeric).
+SERVING_KEYS = ("active_requests", "queue_depth", "slots",
+                "kv_occupancy", "post_warmup_recompiles", "draining")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -136,7 +162,7 @@ def validate_line(obj: Any) -> list[str]:
             f"schema_version {version!r} not in {SUPPORTED_VERSIONS}"
         )
         return problems
-    kinds = {1: KINDS_V1, 2: KINDS_V2}.get(version, KINDS)
+    kinds = {1: KINDS_V1, 2: KINDS_V2, 3: KINDS_V3}.get(version, KINDS)
     if obj["kind"] not in kinds:
         problems.append(f"kind {obj['kind']!r} not in {kinds}")
     if not isinstance(obj["step"], int) or isinstance(obj["step"], bool) \
@@ -177,6 +203,9 @@ def validate_line(obj: Any) -> list[str]:
         for key in _V3_FIELDS:
             if key in obj:
                 problems.append(f"v3 field {key!r} on a schema-v1 line")
+        for key in _V4_FIELDS:
+            if key in obj:
+                problems.append(f"v4 field {key!r} on a schema-v1 line")
         return problems
 
     # ------------------------------------------------- v2 additions
@@ -236,6 +265,9 @@ def validate_line(obj: Any) -> list[str]:
         for key in _V3_FIELDS:
             if key in obj:
                 problems.append(f"v3 field {key!r} on a schema-v2 line")
+        for key in _V4_FIELDS:
+            if key in obj:
+                problems.append(f"v4 field {key!r} on a schema-v2 line")
         return problems
 
     # ------------------------------------------------- v3 additions
@@ -306,6 +338,25 @@ def validate_line(obj: Any) -> list[str]:
                 )
     elif "fleet" in obj:
         problems.append("fleet object on a non-fleet line")
+
+    if version == 3:
+        if "serving" in obj:
+            problems.append("v4 field 'serving' on a schema-v3 line")
+        return problems
+
+    # ------------------------------------------------- v4 additions
+    if obj["kind"] == "serving":
+        if not isinstance(obj.get("serving"), dict):
+            problems.append("serving line is missing the serving object")
+        else:
+            _check_numeric_map(obj, "serving", problems)
+            for key in SERVING_KEYS:
+                if key not in obj["serving"]:
+                    problems.append(
+                        f"serving object is missing required key {key!r}"
+                    )
+    elif "serving" in obj:
+        problems.append("serving object on a non-serving line")
     return problems
 
 

@@ -272,20 +272,28 @@ class TestExplicitEP:
 
     def test_gmm_tiling_respects_row_divisibility(self):
         """gmm's make_group_metadata requires tm | m; the adaptive
-        tiling must halve tm until it divides, cap tk/tn to the dim,
-        and pick the large tiles at the bench shape (the whole point —
-        128^3 at [16384, 768, 3072] is ~19k grid steps of overhead)."""
+        tiling must halve tm until it divides, prefer a tk that DIVIDES
+        k (768 takes 384-wide tiles exactly; a capped 512 leaves a
+        masked 256 remainder tile every pass), and pick the large tiles
+        at the bench shape (the whole point — 128^3 at
+        [16384, 768, 3072] is ~19k grid steps of overhead)."""
         from tensorflow_examples_tpu.parallel.moe import (
             GMM_TILE_CAP, _gmm_tiling,
         )
 
         cap = GMM_TILE_CAP
-        assert _gmm_tiling(16384, 768, 3072) == (cap, min(cap, 768), cap)
+        assert _gmm_tiling(16384, 768, 3072) == (cap, 384, cap)
         assert _gmm_tiling(256, 128, 128) == (256, 128, 128)
+        assert _gmm_tiling(256, 3072, 3072) == (256, cap, cap)  # cap | k
+        # No lane-aligned divisor <= cap: fall back to min(cap, k).
+        assert _gmm_tiling(256, 64, 64) == (256, 64, 64)
+        # No divisor in [cap/2, cap] either (640's largest is 128):
+        # one near-cap masked pass beats five tiny exact ones.
+        assert _gmm_tiling(256, 640, 640) == (256, cap, cap)
         m, k, n = 384, 768, 3072  # m = 3·128: cap halves to 128
         tm, tk, tn = _gmm_tiling(m, k, n)
         assert m % tm == 0 and tm == 128
-        assert tk <= k and tn <= n
+        assert k % tk == 0 and tk <= k and tn <= n
 
     @pytest.mark.parametrize("top_k", [1, 2])
     def test_grouped_matches_scatter_impl(self, top_k):

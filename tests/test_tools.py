@@ -204,7 +204,11 @@ class TestStepFlops:
         tr = Trainer(mnist.make_task(cfg), cfg, mesh=bench._chip_mesh())
         ds = synthetic_images(n=64, shape=(28, 28, 1), num_classes=10, seed=0)
         it = train_iterator(ds, 8, seed=0)
-        return bench, tr, bench._bundle_prep(tr, it, 1, 4)[0]
+        yield bench, tr, bench._bundle_prep(tr, it, 1, 4)[0]
+        # last_mode is flops PROVENANCE for the bench record; a test
+        # that exercised the fallback must not bank "compiled-bundled/k"
+        # for whatever measures flops next in this process.
+        bench._step_flops.last_mode = None
 
     def test_bundle_uses_lowering_when_available(self, trainer_and_stack):
         bench, tr, stack = trainer_and_stack
@@ -1076,6 +1080,60 @@ class TestBenchGate:
         ) == 0
         out = capsys.readouterr().out
         assert "[SKIP] peak_live_bytes: absent from record" in out
+
+
+@pytest.mark.serving
+class TestServeBench:
+    """The tier-1 serving smoke (ISSUE 5 CI satellite): stand the whole
+    stack up on CPU, drive 20 concurrent requests over real HTTP via
+    ``tools/serve_bench.py --smoke``, and bank a well-formed BENCH
+    record with ZERO post-warmup recompiles."""
+
+    @pytest.mark.timeout(300)
+    def test_smoke_banks_wellformed_record(self, tmp_path, capsys):
+        import serve_bench
+
+        out = tmp_path / "serve_record.json"
+        rc = serve_bench.main(
+            ["--smoke", "--requests", "20", "--out", str(out)]
+        )
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        # The stdout line is the same record (the BENCH driver contract:
+        # last JSON line of stdout is the result).
+        stdout_rec = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]
+        )
+        assert stdout_rec == rec
+        assert rec["bench"] == "serving" and rec["backend"] == "cpu"
+        assert rec["requests"] == 20 and rec["completed"] == 20
+        assert rec["errors"] == 0 and rec["ok"] is True
+        assert rec["transport"] == "http"
+        # Zero-recompile steady state: exactly the warmed ladder.
+        assert rec["post_warmup_recompiles"] == 0
+        assert rec["compiles"] == rec["expected_compiles"]
+        # Verified subset is token-identical to the unbatched reference.
+        assert rec["verified"] == 3 and rec["verify_ok"] is True
+        for key in ("req_per_s", "tok_per_s", "ttft_p95_ms",
+                    "tpot_p95_ms", "e2e_p95_ms", "queue_wait_p95_ms"):
+            assert isinstance(rec[key], (int, float)) and rec[key] > 0, key
+
+    def test_make_prompts_spans_buckets(self):
+        import serve_bench
+
+        prompts = serve_bench.make_prompts(
+            16, vocab=97, max_len=64, max_new=8
+        )
+        lengths = {len(p) for p in prompts}
+        assert min(lengths) == 1 and max(lengths) == 56
+        assert all(0 <= t < 97 for p in prompts for t in p)
+
+    def test_requires_a_target(self):
+        import serve_bench
+
+        with pytest.raises(SystemExit):
+            serve_bench.main([])
 
 
 def test_readme_test_count_is_current():

@@ -92,12 +92,15 @@ def _component_benches(deadline: float) -> None:
         for t in ((128, 128, 128), (256, 256, 256), (512, 256, 256),
                   (512, 512, 512), (1024, 768, 512)):
             try:
+                # positional like parallel/moe.py: gmm is a custom_vjp
+                # with nondiff_argnums — tiling= by keyword happens to
+                # work today but is not contract across jax bumps.
                 res["x".join(map(str, t))] = _timeit(
                     lambda lo, hi, _t=t: mb.gmm(
-                        lo, hi, sizes_even, lo.dtype, tiling=_t),
+                        lo, hi, sizes_even, lo.dtype, _t),
                     lhs, rhs_in)
             except Exception as e:  # noqa: BLE001 — a tiling may be
-                res["x".join(map(str, t))] = f"error: {type(e).__name__}"
+                res["x".join(map(str, t))] = f"error: {type(e).__name__}: {e}"
         return res
 
     comp: dict = {}

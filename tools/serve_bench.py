@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the serving stack; banks a BENCH record.
+
+Stands the whole serving path up — engine (AOT warmup over the bucket
+ladder), continuous batcher, HTTP frontend — then drives it closed-loop
+(``--concurrency`` worker threads, each submitting its next request the
+moment the previous one resolves: offered load = concurrency / mean
+latency, the standard closed-loop operating point) and emits ONE
+BENCH-style JSON record::
+
+    {"bench": "serving", "backend": "cpu", "requests": 20,
+     "concurrency": 8, "req_per_s": ..., "tok_per_s": ...,
+     "ttft_p50_ms": ..., "ttft_p95_ms": ..., "tpot_p50_ms": ...,
+     "tpot_p95_ms": ..., "e2e_p95_ms": ..., "queue_wait_p95_ms": ...,
+     "expected_compiles": ..., "compiles": ...,
+     "post_warmup_recompiles": 0, "shed": 0, "errors": 0,
+     "verified": 3, "verify_ok": true, "ok": true}
+
+``ok`` is the CI verdict: every request completed, the verified subset
+is token-identical to the engine's unbatched reference replay, and NOT
+ONE compile happened after warmup (``post_warmup_recompiles == 0`` —
+the zero-recompile steady-state claim, measured, not asserted).
+
+Modes:
+
+* ``--smoke`` — tier-1 CI: a tiny random-param GPT-2 on whatever
+  backend is present (CPU in CI), 20 mixed-length requests over HTTP,
+  3 of them verified against the reference. Seconds, not minutes.
+* ``--workdir DIR`` — load a real trained checkpoint (the
+  ``examples/gpt2`` layout, trained at the DEFAULT model shape — the
+  workdir banks no config, so a checkpoint from non-default
+  ``--num_layers``/``--d_model``/... flags will fail the template
+  restore; serve those via ``examples/gpt2/serve.py``, which takes the
+  full flag surface) and measure serving throughput/latency at
+  ``--concurrency`` on the local accelerator.
+
+``--inproc`` skips the HTTP hop (batcher futures driven directly) to
+separate transport cost from engine cost; ``--out`` banks the record
+as a JSON file next to the BENCH_r*.json trajectory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+SMOKE_MODEL = dict(
+    vocab_size=211,
+    max_len=64,
+    num_layers=2,
+    num_heads=2,
+    d_model=32,
+    dropout=0.0,
+    attention="xla",
+)
+
+
+def build_smoke_engine(serve_cfg=None, *, registry=None):
+    """Tiny random-param GPT-2 + engine, shared with tests/test_serving:
+    big enough to cross prefill buckets, small enough for tier-1."""
+    import jax
+
+    from tensorflow_examples_tpu.models import transformer
+    from tensorflow_examples_tpu.serving.engine import (
+        InferenceEngine,
+        ServeConfig,
+    )
+
+    cfg = transformer.TransformerConfig(**SMOKE_MODEL)
+    model = transformer.Transformer(cfg)
+    import jax.numpy as jnp
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, tokens)["params"]
+    return InferenceEngine(
+        cfg,
+        params,
+        cfg=serve_cfg or ServeConfig(max_slots=8, prefill_bucket_floor=16,
+                                     kv_bucket_floor=32),
+        registry=registry,
+    )
+
+
+def build_checkpoint_engine(workdir: str, serve_cfg, *, registry=None):
+    """Engine over the latest checkpoint in an ``examples/gpt2`` workdir
+    (restores through an eval_shape template like generate.py). The
+    template is the DEFAULT Gpt2Config — the workdir banks no config,
+    so non-default-shape checkpoints cannot be restored here."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.serving.engine import InferenceEngine
+    from tensorflow_examples_tpu.train.checkpoint import CheckpointManager
+    from tensorflow_examples_tpu.train.loop import state_factory
+    from tensorflow_examples_tpu.workloads import gpt2
+
+    cfg = gpt2.Gpt2Config(workdir=workdir)
+    make_state, _ = state_factory(gpt2.make_task(cfg), cfg)
+    abstract = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+    try:
+        restored = CheckpointManager(workdir).restore_latest(abstract)
+    except Exception as e:
+        raise SystemExit(
+            f"restore failed against the default-shape template — a "
+            f"checkpoint trained with non-default model flags must be "
+            f"served via examples/gpt2/serve.py instead: {e}"
+        ) from None
+    if restored is None:
+        raise SystemExit(f"no checkpoint under {workdir}")
+    params = jax.tree.map(jnp.asarray, restored[0].params)
+    return InferenceEngine(
+        gpt2.model_config(cfg), params, cfg=serve_cfg, registry=registry
+    )
+
+
+def make_prompts(n: int, *, vocab: int, max_len: int, max_new: int,
+                 seed: int = 0) -> list[list[int]]:
+    """Mixed-length prompts spanning the prefill buckets (that's the
+    continuous-batching claim under test: different lengths coalesce)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cap = max(2, max_len - max_new)
+    lengths = [int(rng.integers(1, cap + 1)) for _ in range(n)]
+    # Force the extremes so every run exercises bucket 1 and the top.
+    lengths[0], lengths[-1] = 1, cap
+    return [
+        [int(t) for t in rng.integers(0, vocab, (ln,))] for ln in lengths
+    ]
+
+
+def _post_json(url: str, body: dict, timeout: float) -> tuple[int, dict]:
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+    except (OSError, ValueError) as e:
+        # Transport-level failure (URLError, reset, timeout, torn JSON
+        # body): count it as THIS request's error instead of letting it
+        # kill the worker thread and strand every prompt it would have
+        # pulled next.
+        return 0, {"error": f"{type(e).__name__}: {e}"}
+
+
+def drive(frontend, prompts, *, concurrency: int, max_new: int,
+          temperature: float, top_k: int, http_url: str | None,
+          timeout: float) -> dict:
+    """Closed loop: workers pull the next prompt off a shared list the
+    moment their current request resolves. Returns per-request replies
+    (index-aligned with ``prompts``) + wall time."""
+    replies: list[tuple[int, dict] | None] = [None] * len(prompts)
+    next_i = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = next_i[0]
+                if i >= len(prompts):
+                    return
+                next_i[0] += 1
+            body = {
+                "prompt": prompts[i],
+                "max_new_tokens": max_new,
+                "temperature": temperature,
+                "top_k": top_k,
+                "seed": i,  # per-request stream: replayable
+            }
+            if http_url is not None:
+                replies[i] = _post_json(http_url, body, timeout)
+            else:
+                replies[i] = frontend.handle_request(body, kind="generate")
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, name=f"serve-bench-{k}", daemon=True)
+        for k in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout * max(1, len(prompts)))
+    wall = time.perf_counter() - t0
+    return {"replies": replies, "wall_s": wall}
+
+
+def bench_record(engine, registry, outcome, prompts, *, concurrency,
+                 verified, verify_ok, backend) -> dict:
+    hists = registry.histogram_summaries()
+
+    def pct(name, q):
+        h = hists.get(f"serving/{name}")
+        v = h and h.get(f"p{q}")
+        return round(v * 1e3, 3) if v is not None else None
+
+    replies = outcome["replies"]
+    done = [r for r in replies if r is not None and r[0] == 200]
+    toks = sum(len(r[1].get("tokens", ())) for r in done)
+    wall = outcome["wall_s"]
+    counters = registry.counter_values()
+    errors = len(replies) - len(done)
+    rec = {
+        "bench": "serving",
+        "backend": backend,
+        "requests": len(prompts),
+        "completed": len(done),
+        "errors": errors,
+        "concurrency": concurrency,
+        "max_slots": engine.cfg.max_slots,
+        "wall_s": round(wall, 3),
+        "req_per_s": round(len(done) / wall, 3) if wall else None,
+        "tok_per_s": round(toks / wall, 3) if wall else None,
+        "generated_tokens": toks,
+        "queue_wait_p95_ms": pct("queue_wait", 95),
+        "prefill_p95_ms": pct("prefill", 95),
+        "ttft_p50_ms": pct("ttft", 50),
+        "ttft_p95_ms": pct("ttft", 95),
+        "tpot_p50_ms": pct("tpot", 50),
+        "tpot_p95_ms": pct("tpot", 95),
+        "e2e_p50_ms": pct("e2e", 50),
+        "e2e_p95_ms": pct("e2e", 95),
+        "expected_compiles": engine.expected_compiles(),
+        "compiles": int(counters.get("compile/count", 0)),
+        "post_warmup_recompiles": engine.post_warmup_recompiles(),
+        "shed": int(counters.get("serving/shed_total", 0)),
+        "verified": verified,
+        "verify_ok": verify_ok,
+    }
+    rec["ok"] = bool(
+        errors == 0
+        and verify_ok
+        and rec["post_warmup_recompiles"] == 0
+    )
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model, 20 requests, verify 3 (tier-1 CI)")
+    ap.add_argument("--workdir", default="",
+                    help="serve the latest checkpoint in this run dir")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="request count (default: 20 smoke / 64 otherwise)")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--max-new-tokens", type=int, default=8)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--max-slots", type=int, default=8)
+    ap.add_argument("--verify", type=int, default=-1,
+                    help="replay N requests unbatched and compare "
+                         "token-for-token (-1: 3 in smoke, 0 otherwise)")
+    ap.add_argument("--inproc", action="store_true",
+                    help="skip the HTTP hop (engine+batcher cost only)")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-request client timeout (seconds)")
+    ap.add_argument("--out", default="", help="bank the record here")
+    args = ap.parse_args(argv)
+    if not args.smoke and not args.workdir:
+        ap.error("pick a target: --smoke or --workdir DIR")
+
+    import jax
+
+    from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
+    from tensorflow_examples_tpu.serving.engine import ServeConfig
+    from tensorflow_examples_tpu.serving.frontend import ServingFrontend
+    from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+
+    registry = MetricsRegistry()  # private: the record owns its counters
+    serve_cfg = ServeConfig(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        **(dict(prefill_bucket_floor=16, kv_bucket_floor=32)
+           if args.smoke else {}),
+    )
+    if args.workdir:
+        engine = build_checkpoint_engine(
+            args.workdir, serve_cfg, registry=registry
+        )
+    else:
+        engine = build_smoke_engine(serve_cfg, registry=registry)
+
+    n = args.requests or (20 if args.smoke else 64)
+    verify = args.verify if args.verify >= 0 else (3 if args.smoke else 0)
+    prompts = make_prompts(
+        n,
+        vocab=engine.model_cfg.vocab_size,
+        max_len=engine.model_cfg.max_len,
+        max_new=args.max_new_tokens,
+    )
+
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    print(
+        f"# warm: {engine.expected_compiles()} programs in {warmup_s:.1f}s "
+        f"(prefill ladder {engine.prefill_ladder}, "
+        f"kv ladder {engine.kv_ladder})",
+        file=sys.stderr,
+    )
+
+    batcher = ContinuousBatcher(engine, registry=registry).start()
+    frontend = ServingFrontend(batcher, port=0)
+    http_url = None
+    if not args.inproc:
+        frontend.start()
+        http_url = frontend.url("/generate")
+    try:
+        outcome = drive(
+            frontend, prompts,
+            concurrency=args.concurrency, max_new=args.max_new_tokens,
+            temperature=args.temperature, top_k=args.top_k,
+            http_url=http_url, timeout=args.timeout,
+        )
+        verify_ok = True
+        for i in range(min(verify, n)):
+            reply = outcome["replies"][i]
+            if reply is None or reply[0] != 200:
+                verify_ok = False
+                continue
+            ref = engine.reference_generate(
+                prompts[i], max_new=args.max_new_tokens, seed=i,
+                temperature=args.temperature, top_k=args.top_k,
+            )
+            if reply[1]["tokens"] != ref:
+                verify_ok = False
+                print(
+                    f"# VERIFY FAIL req {i}: served {reply[1]['tokens']} "
+                    f"!= reference {ref}",
+                    file=sys.stderr,
+                )
+    finally:
+        batcher.close(drain=True)
+        frontend.close()
+
+    rec = bench_record(
+        engine, registry, outcome, prompts,
+        concurrency=args.concurrency, verified=min(verify, n),
+        verify_ok=verify_ok, backend=jax.default_backend(),
+    )
+    rec["warmup_s"] = round(warmup_s, 3)
+    rec["transport"] = "inproc" if args.inproc else "http"
+    print(json.dumps(rec))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,12 @@ FLOORS = {
         # BASELINE.md for the diag.
         "resnet50_examples_per_sec_per_chip": (185187.0807, 65958.3),
         "resnet50_input_examples_per_sec_per_chip": (124.0052, 53598.89),  # 1-CPU host!
+        # ISSUE 6: the r02 pipeline-only figure (host decode+augment,
+        # no device in the loop) promoted from a buried extras
+        # annotation to a tracked, floored metric. Fingerprint is the
+        # r02 record's own (a mid-wedge probe — the floors policy
+        # carries each floor with its record's evidence).
+        "resnet50_input_pipeline_only_images_per_sec": (474.6, 2279.33),
         "gpt2_124m_tokens_per_sec": (3592223.8352, 59962.35),
         "gpt2_long4k_tokens_per_sec": (4231329.5553, 47927.17),
         "gpt2_long16k_tokens_per_sec": (9130385.6576, 70377.3),
@@ -133,6 +139,16 @@ FLOORS = {
         # (headline must fit the 540 s dead-tunnel budget).
         "resnet50_examples_per_sec_per_chip": (0.436, 0.09),
         "resnet50_input_examples_per_sec_per_chip": (0.472, 0.10),
+        # ISSUE 6: stamped 2026-08-04 from tools/host_input_bench.py
+        # --smoke on this 2-vCPU rig (parallel pipeline, 4 workers /
+        # 2 readers, native decode, record-shuffle window on;
+        # sequential reference ~610-700). LOWEST of three back-to-back
+        # healthy records (runs here spread ~715-915 with ambient
+        # load; the tool's own median-of-5 GEMM probe is the
+        # fingerprint — NOT bench.py's probe — and a loaded run's
+        # probe collapses with it, so the 2x comparability window
+        # already skips the worst noise).
+        "host_input_pipeline_images_per_sec": (715.9, 0.0881),
         "gpt2_124m_tokens_per_sec": (37.3, 0.10),
         "gpt2_long4k_tokens_per_sec": (19.6, 0.10),
         "gpt2_long16k_tokens_per_sec": (23.6, 0.10),
@@ -815,8 +831,16 @@ def bench_resnet50_input() -> dict:
     _write_bench_tfrecords(root)
 
     # Host-pipeline-only throughput (no device): isolates input cost.
-    host_it = imagenet_data.tfrecord_iter(root, "train", batch, train=True)
-    next(host_it)  # warm tf.data
+    # ISSUE 6: measured through the sharded-parallel reader + worker-
+    # pool pipeline (data/workers.py) — the production hot path — with
+    # the worker count sized to the host.
+    input_workers = max(2, min(8, os.cpu_count() or 1))
+    input_readers = 2
+    host_it = imagenet_data.parallel_tfrecord_iter(
+        root, "train", batch, train=True,
+        num_readers=input_readers, num_workers=input_workers,
+    )
+    next(host_it)  # warm the pool + native decode
     pipe_vals = []
     pipe_batches = 4 if BACKEND == "tpu" else 2
     for _ in range(WINDOWS):
@@ -824,10 +848,14 @@ def bench_resnet50_input() -> dict:
         for _ in range(pipe_batches):
             next(host_it)
         pipe_vals.append(pipe_batches * batch / (time.perf_counter() - t0))
+    host_it.close()  # drain worker/reader threads before the train feed
 
     trainer, cfg = _resnet50_trainer(batch)
     it = device_prefetch(
-        imagenet_data.tfrecord_iter(root, "train", batch, train=True),
+        imagenet_data.parallel_tfrecord_iter(
+            root, "train", batch, train=True,
+            num_readers=input_readers, num_workers=input_workers,
+        ),
         trainer._batch_sharding,
     )
     flops = _step_flops(trainer, next(it))
@@ -850,6 +878,8 @@ def bench_resnet50_input() -> dict:
         batch=batch,
         pipeline_only_images_per_sec=round(statistics.median(pipe_vals), 1),
         pipeline_only_windows=[round(v, 1) for v in sorted(pipe_vals)],
+        input_workers=input_workers,
+        input_readers=input_readers,
         model_tflops_per_sec=_model_tflops(flops, steps, dt_med),
     )
 

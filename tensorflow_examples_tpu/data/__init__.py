@@ -15,6 +15,12 @@ prefetch(device)). Design:
 - With no dataset on disk (``data_dir=""``) each workload falls back to a
   seeded synthetic dataset with the real shapes/dtypes, so every example
   and test runs hermetically.
+- The ImageNet hot path (ISSUE 6, docs/data.md) is a pure-python
+  parallel pipeline: sharded parallel readers (``sources.ShardedReader``)
+  feeding a background decode/augment worker pool (``workers.WorkerPool``)
+  — deterministic and exactly resumable for any reader/worker count, with
+  the ``data_wait``/``data_work`` span split and depth-adaptive device
+  prefetch (``prefetch.DepthController``) on top.
 """
 
 from tensorflow_examples_tpu.data.memory import (
@@ -23,3 +29,4 @@ from tensorflow_examples_tpu.data.memory import (
     train_iterator,
 )
 from tensorflow_examples_tpu.data.prefetch import device_prefetch
+from tensorflow_examples_tpu.data.workers import PipelinedIterator, WorkerPool

@@ -12,6 +12,61 @@ from __future__ import annotations
 
 import numpy as np
 
+# (mean bytes, std bytes) -> [256, C] f32 lookup table. Normalizing a
+# uint8 batch is a gather through this table — one pass over the batch,
+# no per-image Python and no intermediate f32 copy of the /255 step.
+_NORM_LUT_CACHE: dict = {}
+
+
+def normalize_lut(mean: np.ndarray, std: np.ndarray) -> np.ndarray:
+    """The 256-entry per-channel normalization table for uint8 images.
+
+    Entry [v, c] is computed with the exact f32 expression the direct
+    path uses — ``(v.astype(f32) / 255.0 - mean) / std`` — so the
+    gathered output is BYTE-identical to the unbatched formula."""
+    key = (mean.astype(np.float32).tobytes(), std.astype(np.float32).tobytes())
+    lut = _NORM_LUT_CACHE.get(key)
+    if lut is None:
+        vals = np.arange(256, dtype=np.float32)[:, None] / 255.0
+        lut = ((vals - mean.astype(np.float32)) / std.astype(np.float32))
+        lut = np.ascontiguousarray(lut.astype(np.float32))
+        _NORM_LUT_CACHE[key] = lut
+    return lut
+
+
+def normalize_images(
+    images: np.ndarray, mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """Batched ``(x/255 - mean) / std`` with mean/std broadcast ONCE.
+
+    uint8 batches go through the per-channel lookup table (no f32
+    intermediate); float batches take the direct broadcast expression.
+    Both are byte-identical to the per-image loop they replace
+    (ISSUE 6 satellite)."""
+    if images.dtype == np.uint8:
+        lut = normalize_lut(mean, std)
+        c = images.shape[-1]
+        return np.ascontiguousarray(
+            lut[images, np.arange(c, dtype=np.intp)]
+        )
+    return (
+        (images.astype(np.float32) / 255.0 - mean.astype(np.float32))
+        / std.astype(np.float32)
+    ).astype(np.float32)
+
+
+def flip_images(
+    images: np.ndarray, flips: np.ndarray, *, copy: bool = True
+) -> np.ndarray:
+    """Horizontal-flip the selected rows of a batch in ONE vectorized
+    assignment (no per-image loop). The single flip implementation —
+    ``_crop_flip`` reuses it with ``copy=False`` on its freshly
+    gathered batch."""
+    out = images.copy() if copy else images
+    fl = flips.astype(bool)
+    out[fl] = out[fl, :, ::-1]
+    return np.ascontiguousarray(out)
+
 
 def _crop_flip(
     images: np.ndarray, ys: np.ndarray, xs: np.ndarray, flips: np.ndarray, pad: int
@@ -30,9 +85,7 @@ def _crop_flip(
     out = padded[
         np.arange(b)[:, None, None], row_idx[:, :, None], col_idx[:, None, :]
     ]
-    fl = flips.astype(bool)
-    out[fl] = out[fl, :, ::-1]
-    return np.ascontiguousarray(out)
+    return flip_images(out, flips, copy=False)
 
 
 def random_crop_flip(

@@ -462,11 +462,14 @@ def _mirror_crop(h, w, train, rng):
     return (*found, flip)
 
 
-def decode_augment_reference(
+def _decode_crop_resize(
     jpeg: bytes, *, train: bool, seed: int, out_size: int
 ) -> np.ndarray:
-    """Numpy mirror of one fastjpeg.cpp image (denom=1 path: exact when
-    the crop is < 2x out_size, which any test-sized image satisfies)."""
+    """One image through the PIL/numpy mirror of fastjpeg.cpp's decode +
+    crop + bilinear resize + flip — WITHOUT normalization, returning the
+    [S, S, 3] f32 0..255 image. Normalization is applied batched by the
+    caller (data/augment.normalize_images) with the identical f32
+    expression, so batching changes nothing byte-wise."""
     import io
 
     from PIL import Image
@@ -475,7 +478,6 @@ def decode_augment_reference(
     h, w, _ = img.shape
     rng = _SplitMix64(seed)
     y0, x0, ch, cw, flip = _mirror_crop(h, w, train, rng)
-    out = np.empty((out_size, out_size, 3), np.float32)
     oy = np.arange(out_size)
     sy = y0 + (oy + 0.5) * ch / out_size - 0.5
     y1 = np.clip(np.floor(sy).astype(np.int64), 0, h - 1)
@@ -492,19 +494,32 @@ def decode_augment_reference(
     res = top + bot
     if flip:
         res = res[:, ::-1]
-    out[:] = ((res.astype(np.float32) / 255.0) - MEAN_RGB) / STDDEV_RGB
-    return out
+    return res.astype(np.float32)
+
+
+def decode_augment_reference(
+    jpeg: bytes, *, train: bool, seed: int, out_size: int
+) -> np.ndarray:
+    """Numpy mirror of one fastjpeg.cpp image (denom=1 path: exact when
+    the crop is < 2x out_size, which any test-sized image satisfies)."""
+    raw = _decode_crop_resize(
+        jpeg, train=train, seed=seed, out_size=out_size
+    )
+    return ((raw / 255.0) - MEAN_RGB) / STDDEV_RGB
 
 
 def _normalize_uint8(images: np.ndarray) -> np.ndarray:
     """uint8 HWC batch → normalized f32 via the threaded C++ host library
-    (native/fastdata.cpp), numpy fallback when the toolchain is absent.
-    Single definition so the exact and non-exact streams cannot drift."""
+    (native/fastdata.cpp); numpy fallback is the batched LUT gather
+    (data/augment.normalize_images — byte-identical to the direct
+    expression, mean/std broadcast once). Single definition so the
+    exact and non-exact streams cannot drift."""
     from tensorflow_examples_tpu import native
+    from tensorflow_examples_tpu.data import augment as augment_mod
 
     img = native.normalize(images, MEAN_RGB, STDDEV_RGB)
     if img is None:
-        img = (images.astype(np.float32) / 255.0 - MEAN_RGB) / STDDEV_RGB
+        img = augment_mod.normalize_images(images, MEAN_RGB, STDDEV_RGB)
     return img
 
 
@@ -584,3 +599,344 @@ def has_tfrecords(data_dir: str, split: str) -> bool:
     import glob
 
     return bool(glob.glob(os.path.join(data_dir, f"{split}-*")))
+
+
+# ----------------------------------- parallel pipeline (ISSUE 6 tentpole)
+#
+# The pure-python hot path: sharded parallel readers (data/sources.py
+# ShardedReader — no tf import anywhere on this path) feeding a
+# background decode/augment worker pool (data/workers.py). Everything is
+# a pure function of (seed, start_step): per-epoch shard order is a
+# seeded permutation, records flow in deterministic shard order for ANY
+# reader count, per-image augment seeds are keyed on the global batch
+# index — so the stream is bit-identical to the sequential
+# single-reader/zero-worker reference AND exactly resumable (the golden
+# contract tools/host_input_bench.py measures and tests pin).
+
+
+def parse_imagenet_example(record: bytes) -> tuple[bytes, int]:
+    """(jpeg bytes, 0-based label) from one standard ImageNet Example."""
+    from tensorflow_examples_tpu.data import sources as sources_mod
+
+    feats = sources_mod.parse_example(record)
+    try:
+        jpeg = feats["image/encoded"][0]
+        label = int(feats["image/class/label"][0]) - 1  # 1-based on disk
+    except (KeyError, IndexError) as e:
+        raise ValueError(
+            f"record is not ImageNet-schema (have {sorted(feats)})"
+        ) from e
+    return jpeg, label
+
+
+def decode_augment_batch(
+    jpegs: list,
+    labels: list,
+    *,
+    train: bool,
+    image_size: int,
+    seed: int,
+    step: int,
+    threads: int | None = None,
+) -> dict:
+    """One pipeline batch: JPEG decode + ResNet crop/resize/flip +
+    normalize. Prefers the threaded C++ stage (native/fastjpeg.cpp) when
+    built and enabled; otherwise the PIL/numpy mirror with the SAME
+    splitmix64 augment draws, normalized in one batched broadcast
+    (data/augment.normalize_images). Deterministic given
+    (seed, step, row) on either path."""
+    from tensorflow_examples_tpu import native
+    from tensorflow_examples_tpu.data import augment as augment_mod
+
+    n = len(jpegs)
+    seeds = _image_seeds(seed, step, n) if train else None
+    if _native_decode_enabled():
+        res = native.decode_augment_batch(
+            jpegs, train=train, out_size=image_size, seeds=seeds,
+            mean=MEAN_RGB, std=STDDEV_RGB, threads=threads,
+        )
+        if res is not None:
+            img, ok = res
+            if not ok.all():
+                # Loud, like the PIL fallback (which raises on a bad
+                # JPEG): a zero-filled image with a real label is
+                # silent training-data corruption. The failure lands at
+                # a deterministic batch index on every path.
+                bad = [int(i) for i in np.flatnonzero(ok == 0)]
+                raise ValueError(
+                    f"undecodable JPEG record(s) at batch {step} "
+                    f"rows {bad} (corrupt shard?)"
+                )
+            return {"image": img, "label": np.asarray(labels, np.int32)}
+    raw = np.stack(
+        [
+            _decode_crop_resize(
+                j,
+                train=train,
+                seed=int(seeds[i]) if seeds is not None else 0,
+                out_size=image_size,
+            )
+            for i, j in enumerate(jpegs)
+        ]
+    )
+    img = augment_mod.normalize_images(raw, MEAN_RGB, STDDEV_RGB)
+    return {"image": img, "label": np.asarray(labels, np.int32)}
+
+
+def _count_records_py(host_files: list, data_dir: str, tag: str) -> int:
+    """Pure-python record count across this host's shards, cached in
+    the same host-local dir as the tf path's count (see
+    ``_count_records`` for the cache-placement rationale)."""
+    import hashlib
+    import json
+
+    from tensorflow_examples_tpu.data import sources as sources_mod
+
+    is_url = "://" in data_dir
+    sig = hashlib.sha1(
+        "|".join(
+            [data_dir if is_url else os.path.abspath(data_dir)]
+            + [
+                f"{os.path.basename(f)}:{os.path.getsize(f)}"
+                for f in host_files
+            ]
+        ).encode()
+    ).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "TFE_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tensorflow_examples_tpu"),
+    )
+    cache = os.path.join(cache_dir, f"record_count-{tag}-{sig}.json")
+    try:
+        with open(cache) as fh:
+            return int(json.load(fh)["count"])
+    except Exception:
+        pass
+    n = sum(
+        sum(1 for _ in sources_mod.iter_tfrecord_records(f))
+        for f in host_files
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(cache, "w") as fh:
+            json.dump({"count": n}, fh)
+    except Exception:
+        pass
+    return n
+
+
+def parallel_tfrecord_iter(
+    data_dir: str,
+    split: str,
+    batch_size: int,
+    *,
+    train: bool,
+    image_size: int = 224,
+    seed: int = 0,
+    num_readers: int = 2,
+    num_workers: int = 0,
+    start_step: int = 0,
+    host_index: int | None = None,
+    host_count: int | None = None,
+    buffer_records: int = 512,
+    decode_threads: int | None = None,
+    shuffle_window: int | None = None,
+):
+    """Sharded-parallel, worker-pipelined, exactly-resumable TFRecord
+    input (ISSUE 6 tentpole). Per-host sharding semantics match
+    ``tfrecord_iter`` (``files[host::hosts]``); pass ``host_index`` /
+    ``host_count`` explicitly to simulate a fleet without jax.
+
+    Train: infinite epoch-chained stream, each epoch a seeded shard
+    permutation read in deterministic order, batches dropped at the
+    epoch remainder, per-image augment seeds keyed on the global batch
+    index. ``start_step`` resumes mid-epoch (skip bounded by one
+    epoch's records, none of the decode). Eval: one pass, final batch
+    padded with a zero ``mask``.
+
+    ``num_workers > 0`` returns a closeable
+    :class:`~tensorflow_examples_tpu.data.workers.PipelinedIterator`
+    (``background = True`` — the prefetch layer records pops as
+    ``data_wait``); ``num_workers == 0`` decodes inline — the
+    sequential reference the parallel stream is bit-identical to.
+    """
+    import glob as glob_mod
+
+    from tensorflow_examples_tpu.data import sources as sources_mod
+    from tensorflow_examples_tpu.data import workers as workers_mod
+
+    pattern = os.path.join(data_dir, f"{split}-*")
+    files = sorted(glob_mod.glob(pattern))
+    if not files:
+        raise FileNotFoundError(f"no TFRecord shards matching {pattern}")
+    if host_index is None or host_count is None:
+        import jax
+
+        host_index = jax.process_index() if host_index is None else host_index
+        host_count = jax.process_count() if host_count is None else host_count
+    host_files = files[host_index::host_count]
+    if not host_files:
+        raise ValueError(
+            f"host {host_index}/{host_count} holds zero of the "
+            f"{len(files)} {split} shards"
+        )
+    # Pool workers decode single-threaded (the pool IS the parallelism);
+    # the inline path lets the C++ stage use its own threads unless the
+    # caller pins it (the bench's sequential reference pins 1).
+    if decode_threads is None and num_workers > 0:
+        decode_threads = 1
+
+    def decode(item):
+        """One worker item: raw records -> parsed -> decoded batch.
+        Parsing sits in the worker stage so the consumer thread's
+        serial (GIL-held) work per batch is just chunking bytes."""
+        step, records = item
+        jpegs, labels = [], []
+        for rec in records:
+            jpeg, label = parse_imagenet_example(rec)
+            jpegs.append(jpeg)
+            labels.append(label)
+        return decode_augment_batch(
+            jpegs, labels, train=train, image_size=image_size,
+            seed=seed, step=step, threads=decode_threads,
+        )
+
+    if train:
+        n_records = _count_records_py(
+            host_files, data_dir, f"{split}-h{len(host_files)}"
+        )
+        bpe = n_records // batch_size
+        if bpe == 0:
+            raise ValueError(
+                f"{n_records} records in this host's {split} shards is "
+                f"less than one batch of {batch_size}"
+            )
+        epoch0, within = divmod(start_step, bpe)
+
+        def raw_batches():
+            import contextlib
+
+            step = start_step
+            epoch = epoch0
+            skip = within * batch_size
+            while True:
+                rng = np.random.default_rng((seed, epoch))
+                order = [
+                    host_files[i]
+                    for i in rng.permutation(len(host_files))
+                ]
+                records = sources_mod.interleave_shards(
+                    order,
+                    sources_mod.iter_tfrecord_records,
+                    num_readers=num_readers,
+                    buffer_records=buffer_records,
+                )
+                # Record-level shuffle (the tf.data path's 16*batch
+                # shuffle buffer): seeded per epoch, applied to the
+                # deterministic merged stream — so it mixes WITHIN
+                # shards without breaking reader-count independence.
+                # The resume skip runs POST-shuffle: batch N of a
+                # resumed epoch is the same batch N the uninterrupted
+                # run produced. ``shuffle_window`` overrides the
+                # default 16*batch window (0 disables; the bench keeps
+                # the window under its tiny epoch so it measures the
+                # streaming regime real epochs run in).
+                window = (
+                    16 * batch_size
+                    if shuffle_window is None
+                    else shuffle_window
+                )
+                stream = sources_mod.seeded_window_shuffle(
+                    records,
+                    window,
+                    np.random.default_rng((seed, epoch, 1)),
+                )
+                group: list = []
+                with contextlib.closing(records):
+                    skipped = 0
+                    for rec in stream:
+                        if skipped < skip:
+                            skipped += 1
+                            continue
+                        group.append(rec)
+                        if len(group) == batch_size:
+                            yield (step, group)
+                            step += 1
+                            group = []
+                # Epoch remainder dropped (drop_remainder semantics —
+                # bpe full batches per epoch, every epoch).
+                epoch += 1
+                skip = 0
+
+        source = raw_batches()
+    else:
+
+        def raw_batches():
+            import contextlib
+
+            records = sources_mod.interleave_shards(
+                host_files,
+                sources_mod.iter_tfrecord_records,
+                num_readers=num_readers,
+                buffer_records=buffer_records,
+            )
+            group: list = []
+            step = 0
+            with contextlib.closing(records):
+                for rec in records:
+                    group.append(rec)
+                    if len(group) == batch_size:
+                        yield (step, group)
+                        step += 1
+                        group = []
+            if group:
+                yield (step, group)
+
+        source = raw_batches()
+
+    def finish(batch: dict) -> dict:
+        if train:
+            return batch
+        n = len(batch["label"])
+        if n < batch_size:
+            pad = batch_size - n
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                for k, v in batch.items()
+            }
+            batch["mask"] = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+            )
+        else:
+            batch["mask"] = np.ones(n, np.float32)
+        return batch
+
+    if num_workers > 0:
+        pool = workers_mod.WorkerPool(
+            decode, num_workers, name="imagenet_decode"
+        )
+        decoded = workers_mod.PipelinedIterator(pool, source)
+        if train:
+            return decoded
+        return _FinishingIterator(decoded, finish)
+    return (finish(decode(item)) for item in source)
+
+
+class _FinishingIterator:
+    """Apply a cheap host-side finisher to a background pipeline while
+    keeping the ``background``/``close`` contract visible to prefetch."""
+
+    background = True
+
+    def __init__(self, inner, finish):
+        self._inner = inner
+        self._finish = finish
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._finish(next(self._inner))
+
+    def close(self) -> None:
+        self._inner.close()

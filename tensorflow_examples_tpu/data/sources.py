@@ -353,3 +353,479 @@ def synthetic_glue(
             "label": label,
         }
     )
+
+
+# ------------------------------------------- sharded parallel readers
+#
+# ISSUE 6 tentpole (a): N reader threads over disjoint shard slices,
+# merged into ONE deterministic stream. The merge order is defined by
+# the shard list alone — shards in the given (seeded, per-epoch) order,
+# records in in-shard order — NOT by thread timing, so the output is
+# bit-identical for every num_readers; num_readers=1 IS the sequential
+# reference path. Parallelism comes from readers filling per-shard
+# bounded buffers ahead of the consumer's cursor.
+
+
+class _ShardEnd:
+    pass
+
+
+_SHARD_END = _ShardEnd()
+
+
+class ShardedReader:
+    """Deterministic parallel reader over an ordered shard list.
+
+    ``read_fn(shard)`` yields one shard's records in order. Readers
+    claim shards in list order (an atomic cursor — reader t is NOT
+    pinned to slice t::N, so one huge shard can't serialize the tail)
+    and push records into that shard's bounded queue in BLOCKS of
+    ``block_records`` (one queue handoff per block: per-record
+    cross-thread wakeups would pay a GIL thread-switch per record and
+    dominate small-record streams); the consumer walks shards strictly
+    in list order, so the merged stream equals the sequential
+    concatenation for ANY reader count. Memory is bounded GLOBALLY,
+    not just per shard: readers may claim at most ``max_ahead`` shards
+    past the consumer's cursor (a split of many small shards would
+    otherwise buffer entirely into host RAM). ``close()`` (also run by
+    the generator's ``finally``) stops readers promptly — no orphan
+    threads when the consumer abandons the stream mid-epoch.
+    """
+
+    def __init__(
+        self,
+        shards: list,
+        read_fn,
+        *,
+        num_readers: int = 1,
+        buffer_records: int = 256,
+        block_records: int = 32,
+        max_ahead: int = 0,
+        name: str = "shard_reader",
+    ):
+        import queue as queue_mod
+        import threading
+
+        self.shards = list(shards)
+        self.read_fn = read_fn
+        self.num_readers = max(int(num_readers), 1)
+        self.block_records = max(int(block_records), 1)
+        # Lookahead window: enough shards that every reader has one in
+        # flight and one queued behind the consumer's cursor.
+        self.max_ahead = int(max_ahead) or max(2 * self.num_readers, 2)
+        self._queues = [
+            queue_mod.Queue(
+                maxsize=max(
+                    int(buffer_records) // self.block_records, 1
+                )
+            )
+            for _ in self.shards
+        ]
+        self._stop = threading.Event()
+        self._cursor = 0
+        self._consumed = 0  # shards fully drained by the consumer
+        self._cursor_lock = threading.Lock()
+        self._threads = [
+            threading.Thread(
+                target=self._read_loop, name=f"{name}-{i}", daemon=True
+            )
+            for i in range(min(self.num_readers, max(len(self.shards), 1)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------ reader
+
+    def _claim(self) -> int | None:
+        while not self._stop.is_set():
+            with self._cursor_lock:
+                if self._cursor >= len(self.shards):
+                    return None
+                if self._cursor < self._consumed + self.max_ahead:
+                    i = self._cursor
+                    self._cursor += 1
+                    return i
+            # Far enough ahead of the consumer: wait for it to advance
+            # (global memory bound — see class docstring).
+            self._stop.wait(0.05)
+        return None
+
+    def _put(self, q, item) -> bool:
+        import queue as queue_mod
+
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _read_loop(self) -> None:
+        while not self._stop.is_set():
+            i = self._claim()
+            if i is None:
+                return
+            q = self._queues[i]
+            block: list = []
+            try:
+                for rec in self.read_fn(self.shards[i]):
+                    block.append(rec)
+                    if len(block) >= self.block_records:
+                        if not self._put(q, block):
+                            return
+                        block = []
+            except BaseException as e:  # noqa: BLE001 - re-raised in order
+                if block:
+                    self._put(q, block)
+                self._put(q, e)
+                continue
+            if block and not self._put(q, block):
+                return
+            if not self._put(q, _SHARD_END):
+                return
+
+    # ---------------------------------------------------------- consumer
+
+    def records(self):
+        """All records, in deterministic shard-list order."""
+        import queue as queue_mod
+
+        try:
+            for i in range(len(self.shards)):
+                q = self._queues[i]
+                while True:
+                    try:
+                        item = q.get(timeout=0.1)
+                    except queue_mod.Empty:
+                        if self._stop.is_set():
+                            raise RuntimeError(
+                                "ShardedReader closed mid-stream"
+                            ) from None
+                        continue
+                    if item is _SHARD_END:
+                        break
+                    if isinstance(item, BaseException):
+                        raise RuntimeError(
+                            f"shard reader failed on {self.shards[i]!r}"
+                        ) from item
+                    yield from item
+                with self._cursor_lock:
+                    self._consumed = i + 1
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop every reader thread promptly (idempotent)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "ShardedReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def seeded_window_shuffle(items, window: int, rng: np.random.Generator):
+    """tf.data-style bounded shuffle buffer, deterministic given ``rng``.
+
+    Fill a ``window``-slot buffer; for each further item emit a
+    seeded-random slot and refill it, then drain the tail by seeded
+    sampling. Because the upstream order is already deterministic (the
+    sharded reader's contract), the shuffled stream is a pure function
+    of (stream, rng) — identical for any reader count, and exactly
+    replayable for resume. ``window <= 1`` is a pass-through.
+    """
+    if window <= 1:
+        yield from items
+        return
+    buf: list = []
+    for item in items:
+        if len(buf) < window:
+            buf.append(item)
+            continue
+        j = int(rng.integers(window))
+        out = buf[j]
+        buf[j] = item
+        yield out
+    while buf:
+        j = int(rng.integers(len(buf)))
+        buf[j], out = buf[-1], buf[j]
+        buf.pop()
+        yield out
+
+
+def interleave_shards(
+    shards: list, read_fn, *, num_readers: int = 1, buffer_records: int = 256
+):
+    """Generator over every record of ``shards`` in deterministic order
+    (sequential-concatenation semantics), read by ``num_readers``
+    background threads. ``num_readers <= 1`` runs fully inline — zero
+    threads, the literal sequential reference."""
+    if num_readers <= 1:
+        for shard in shards:
+            yield from read_fn(shard)
+        return
+    reader = ShardedReader(
+        shards, read_fn, num_readers=num_readers,
+        buffer_records=buffer_records,
+    )
+    yield from reader.records()
+
+
+# --------------------------------------------- TFRecord without tf
+#
+# The parallel pipeline reads (and tests/tools write) TFRecord shards
+# with a pure-python implementation of the framing — the sharded reader
+# path needs no TensorFlow import at all. Framing per record: uint64le
+# length, uint32le masked-crc32c(length), payload, uint32le
+# masked-crc32c(payload). CRCs are written correctly (tf readers verify
+# them) and skipped on read by default (decode dominates; flip
+# ``verify_crc=True`` to pay the check).
+
+_CRC32C_TABLE: list[int] | None = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), the TFRecord checksum."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    tab = _CRC32C_TABLE
+    for b in data:
+        crc = tab[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def iter_tfrecord_records(path: str, *, verify_crc: bool = False):
+    """Yield raw record payloads from one TFRecord shard (pure python).
+
+    The open itself goes through ``retry_io`` (flaky-store policy, see
+    module docstring). A file ending exactly on a record boundary is
+    the clean EOF; a record cut off mid-frame raises — like tf's
+    ``DataLossError`` — because silent truncation would both lose data
+    and desynchronize the cached record count the resume arithmetic
+    depends on. Full CRC verification stays opt-in (decode dominates),
+    but frame-structure corruption is always loud.
+    """
+
+    def _open():
+        return open(path, "rb")
+
+    f = retry_io(_open, path)
+    try:
+        offset = 0
+        while True:
+            header = f.read(12)
+            if not header:
+                return  # clean EOF: record boundary
+            if len(header) < 12:
+                raise ValueError(
+                    f"{path}: truncated record header at byte {offset} "
+                    "(torn or corrupt shard)"
+                )
+            (length,) = struct.unpack("<Q", header[:8])
+            if verify_crc:
+                (lcrc,) = struct.unpack("<I", header[8:12])
+                if _masked_crc(header[:8]) != lcrc:
+                    raise ValueError(
+                        f"{path}: corrupt length crc at byte {offset}"
+                    )
+            payload = f.read(length)
+            footer = f.read(4)
+            if len(payload) < length or len(footer) < 4:
+                raise ValueError(
+                    f"{path}: truncated record at byte {offset} "
+                    f"(expected {length} payload bytes; torn or corrupt "
+                    "shard)"
+                )
+            if verify_crc:
+                (dcrc,) = struct.unpack("<I", footer)
+                if _masked_crc(payload) != dcrc:
+                    raise ValueError(
+                        f"{path}: corrupt record crc at byte {offset}"
+                    )
+            offset += 16 + length
+            yield payload
+    finally:
+        f.close()
+
+
+def write_tfrecord(path: str, records) -> int:
+    """Write raw payloads as a TFRecord shard (correct masked CRCs, so
+    tf's reader accepts the file); returns the record count."""
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            rec = bytes(rec)
+            header = struct.pack("<Q", len(rec))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(rec)))
+            n += 1
+    return n
+
+
+# Minimal tf.train.Example wire parser — just enough proto to pull
+# bytes_list / int64_list / float_list features out of the standard
+# ImageNet TFRecord schema without importing TensorFlow.
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes):
+    """(field_number, wire_type, value) triples of one message. Value is
+    bytes for length-delimited fields, int for varint/fixed."""
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:  # varint
+            value, pos = _read_varint(buf, pos)
+        elif wire == 2:  # length-delimited
+            length, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == 5:  # fixed32
+            value = struct.unpack("<I", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:  # fixed64
+            value = struct.unpack("<Q", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported proto wire type {wire}")
+        yield field, wire, value
+
+
+def parse_example(record: bytes) -> dict[str, list]:
+    """tf.train.Example bytes -> {feature name: list of values}.
+
+    bytes_list values come back as ``bytes``, int64_list as ``int``
+    (packed or unpacked encodings both accepted), float_list as
+    ``float``. Unknown feature kinds raise — a schema surprise must be
+    loud, not silently empty.
+    """
+    features = b""
+    for field, _, value in _iter_fields(record):
+        if field == 1:  # Example.features
+            features = value
+    out: dict[str, list] = {}
+    for field, _, entry in _iter_fields(features):
+        if field != 1:  # Features.feature map entries
+            continue
+        key = None
+        feature = b""
+        for f2, _, v2 in _iter_fields(entry):
+            if f2 == 1:
+                key = v2.decode("utf-8")
+            elif f2 == 2:
+                feature = v2
+        if key is None:
+            continue
+        values: list = []
+        for f3, wire3, v3 in _iter_fields(feature):
+            if f3 == 1:  # bytes_list
+                for f4, _, v4 in _iter_fields(v3):
+                    if f4 == 1:
+                        values.append(v4)
+            elif f3 == 3:  # int64_list
+                for f4, wire4, v4 in _iter_fields(v3):
+                    if f4 != 1:
+                        continue
+                    if wire4 == 2:  # packed
+                        pos = 0
+                        while pos < len(v4):
+                            n, pos = _read_varint(v4, pos)
+                            values.append(_signed64(n))
+                    else:
+                        values.append(_signed64(v4))
+            elif f3 == 2:  # float_list
+                for f4, wire4, v4 in _iter_fields(v3):
+                    if f4 != 1:
+                        continue
+                    if wire4 == 2:  # packed
+                        values.extend(
+                            struct.unpack(f"<{len(v4) // 4}f", v4)
+                        )
+                    else:
+                        values.append(
+                            struct.unpack("<f", struct.pack("<I", v4))[0]
+                        )
+            else:
+                raise ValueError(
+                    f"feature {key!r}: unsupported Feature kind {f3}"
+                )
+        out[key] = values
+    return out
+
+
+def _signed64(n: int) -> int:
+    return n - (1 << 64) if n >= (1 << 63) else n
+
+
+def make_example(features: dict) -> bytes:
+    """Serialize {name: bytes | int | float | list thereof} as a
+    tf.train.Example — the writer mirror of :func:`parse_example`, so
+    tools and tests can produce standard shards without tf."""
+
+    def varint(n: int) -> bytes:
+        out = bytearray()
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            if n:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+
+    def ld(field: int, payload: bytes) -> bytes:
+        return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+    entries = b""
+    for key, vals in features.items():
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        if all(isinstance(v, (bytes, bytearray)) for v in vals):
+            inner = b"".join(ld(1, bytes(v)) for v in vals)
+            feature = ld(1, inner)  # bytes_list
+        elif all(isinstance(v, int) for v in vals):
+            inner = b"".join(
+                varint(1 << 3) + varint(v & ((1 << 64) - 1)) for v in vals
+            )
+            feature = ld(3, inner)  # int64_list
+        elif all(isinstance(v, float) for v in vals):
+            inner = b"".join(
+                varint((1 << 3) | 5) + struct.pack("<f", v) for v in vals
+            )
+            feature = ld(2, inner)  # float_list
+        else:
+            raise TypeError(f"feature {key!r}: unsupported value types")
+        entries += ld(1, ld(1, key.encode("utf-8")) + ld(2, feature))
+    return ld(1, entries)  # Example.features

@@ -1,0 +1,322 @@
+"""Background decode/augment worker pool (ISSUE 6 tentpole b).
+
+The host input hot path used to run JPEG decode + augmentation inline on
+the fetch thread: every image decoded between two device steps, serial
+with the loop. This module moves that stage onto a pool of background
+workers with the three properties the rest of the stack depends on:
+
+* **Deterministic order.** Work items carry sequence numbers and results
+  are re-assembled in submission order, so the consumer sees exactly the
+  stream a sequential pipeline would produce — bit-identical batches
+  regardless of worker count or scheduling (the golden-batch contract of
+  the sharded reader, data/sources.py, extends through this stage).
+* **Bounded queues.** At most ``depth`` items are in flight (submission
+  queue + reorder buffer together), so a stalled consumer back-pressures
+  the pipeline instead of buffering the dataset into host RAM.
+* **Poison-pill shutdown.** ``close()`` drains the submission queue,
+  feeds one pill per worker, and joins them — idempotent, safe from any
+  thread, and registered with ``atexit`` so a SIGTERM-preempted run
+  (train/resilience.py raises out of the loop) never strands worker
+  threads past interpreter shutdown.
+
+Thread-backed by design: the decode stages this pool runs (libfastjpeg
+via ctypes, PIL, tf eager ops) all release the GIL during the actual
+decode, so threads scale with cores without the pickling/IPC cost a
+process pool would put on every batch. Workers record their compute in
+``data_work`` spans (telemetry/spans.py) from their own threads — the
+span histogram ``span/data_work`` is where fleet straggler attribution
+reads "host time actually spent producing batches" (telemetry/fleet.py),
+distinct from the consumer's queue-starvation ``data_wait``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import queue
+import sys
+import threading
+import weakref
+from typing import Callable, Iterable, Iterator
+
+from tensorflow_examples_tpu.telemetry import registry as _registry
+from tensorflow_examples_tpu.telemetry import spans as _spans
+
+log = logging.getLogger(__name__)
+
+_POISON = object()  # shutdown sentinel; never a user item
+
+# Pools still open at interpreter exit (weak: a collected pool needs no
+# cleanup — its finalizer closed it). atexit walks this so SIGTERM-preempt
+# and plain sys.exit paths leave zero orphan worker threads.
+_LIVE_POOLS: "weakref.WeakSet[WorkerPool]" = weakref.WeakSet()
+
+
+def shutdown_all() -> None:
+    """Close every live pool (atexit hook; callable from signal paths)."""
+    for pool in list(_LIVE_POOLS):
+        pool.close()
+
+
+atexit.register(shutdown_all)
+
+# GIL switch-interval management: the default 5ms interval throttles the
+# per-item producer/worker/consumer handoffs this pipeline lives on —
+# measured ~2x pipeline throughput from 1ms on a 2-core host (workers
+# release the GIL for the decode itself, so the finer interval costs the
+# compute nothing). Refcounted: lowered when the first pool opens,
+# restored to the prior value when the last one closes, so pool-free
+# phases of the process run at the interpreter default again.
+_SWITCH_LOCK = threading.Lock()
+_SWITCH_DEPTH = 0
+_SAVED_SWITCH_INTERVAL: float | None = None
+
+# Worker gauges are shared across pools (a rollback briefly overlaps the
+# old pipeline's pool with its replacement), so they move by DELTAS
+# under one lock — an absolute set() from a stale pool's deferred close
+# would clobber the live pool's numbers.
+_GAUGE_LOCK = threading.Lock()
+
+
+def _adjust_gauge(reg, name: str, delta: float) -> None:
+    with _GAUGE_LOCK:
+        gauge = reg.gauge(name)
+        gauge.set(max((gauge.value or 0.0) + delta, 0.0))
+
+
+def _enter_fast_switch() -> None:
+    global _SWITCH_DEPTH, _SAVED_SWITCH_INTERVAL
+    with _SWITCH_LOCK:
+        _SWITCH_DEPTH += 1
+        if _SWITCH_DEPTH == 1 and sys.getswitchinterval() > 0.001:
+            _SAVED_SWITCH_INTERVAL = sys.getswitchinterval()
+            sys.setswitchinterval(0.001)
+
+
+def _exit_fast_switch() -> None:
+    global _SWITCH_DEPTH, _SAVED_SWITCH_INTERVAL
+    with _SWITCH_LOCK:
+        _SWITCH_DEPTH = max(_SWITCH_DEPTH - 1, 0)
+        if _SWITCH_DEPTH == 0 and _SAVED_SWITCH_INTERVAL is not None:
+            sys.setswitchinterval(_SAVED_SWITCH_INTERVAL)
+            _SAVED_SWITCH_INTERVAL = None
+
+
+class WorkerError(RuntimeError):
+    """A worker's exception, re-raised at the item's stream position so
+    a deterministic pipeline bug surfaces at the same batch index on
+    every run (and on the sequential reference path)."""
+
+    def __init__(self, seq: int, cause: BaseException):
+        super().__init__(f"input worker failed on item {seq}: {cause!r}")
+        self.seq = seq
+
+
+class WorkerPool:
+    """A fixed pool of worker threads applying ``fn`` to submitted items,
+    returning results strictly in submission order."""
+
+    def __init__(
+        self,
+        fn: Callable,
+        num_workers: int,
+        *,
+        depth: int = 0,
+        name: str = "input_worker",
+        registry=None,
+    ):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self.fn = fn
+        self.num_workers = int(num_workers)
+        # In-flight bound: default 2x workers so every worker has one
+        # item queued behind its current one (keeps the pool busy across
+        # a slow consumer poll without unbounded buffering).
+        self.depth = int(depth) if depth else 2 * self.num_workers
+        self.name = name
+        self._registry = registry
+        _enter_fast_switch()  # restored when the last pool closes
+        self._in: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._cond = threading.Condition()
+        self._results: dict[int, tuple[bool, object]] = {}
+        self._closed = False
+        self._threads = [
+            threading.Thread(
+                target=self._work, name=f"{name}-{i}", daemon=True
+            )
+            for i in range(self.num_workers)
+        ]
+        for t in self._threads:
+            t.start()
+        _LIVE_POOLS.add(self)
+        _adjust_gauge(self._reg(), "data/input_workers", self.num_workers)
+
+    def _reg(self):
+        return (
+            self._registry
+            if self._registry is not None
+            else _registry.default_registry()
+        )
+
+    # ------------------------------------------------------------ intake
+
+    def submit(self, seq: int, item) -> None:
+        """Queue one item; blocks when ``depth`` items are in flight."""
+        if self._closed:
+            raise RuntimeError(f"WorkerPool {self.name!r} is closed")
+        self._in.put((seq, item))
+
+    def result(self, seq: int):
+        """Block until item ``seq``'s result is ready; re-raise its
+        worker's exception (as :class:`WorkerError`) at this position."""
+        with self._cond:
+            while seq not in self._results:
+                if self._closed:
+                    raise RuntimeError(
+                        f"WorkerPool {self.name!r} closed with item "
+                        f"{seq} outstanding"
+                    )
+                self._cond.wait(timeout=0.1)
+            ok, value = self._results.pop(seq)
+        if not ok:
+            raise WorkerError(seq, value) from value
+        return value
+
+    def map_ordered(self, items: Iterable) -> Iterator:
+        """Stream ``fn`` over ``items`` with ``depth`` items in flight;
+        yields results in input order. Equivalent to ``map(fn, items)``
+        item-for-item — only the wall clock differs."""
+        it = iter(items)
+        submitted = 0
+        served = 0
+        exhausted = False
+        try:
+            while True:
+                while not exhausted and submitted - served < self.depth:
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    self.submit(submitted, item)
+                    submitted += 1
+                if served == submitted and exhausted:
+                    return
+                yield self.result(served)
+                served += 1
+        finally:
+            # Prompt upstream teardown: closing this generator (the
+            # consumer end) unwinds the source generator's own finally
+            # (e.g. the sharded reader's thread shutdown) immediately,
+            # not at some later GC pass.
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    # ------------------------------------------------------------ worker
+
+    def _work(self) -> None:
+        reg = self._reg()
+        items_ctr = reg.counter("data/worker_items")
+        while True:
+            got = self._in.get()
+            if got is _POISON:
+                return
+            seq, item = got
+            _adjust_gauge(reg, "data/workers_busy", +1)
+            try:
+                # data_work: host compute actually producing batches —
+                # the signal fleet straggler attribution reads, vs the
+                # consumer's queue-starvation data_wait.
+                with _spans.span("data_work"):
+                    out = (True, self.fn(item))
+                items_ctr.inc()
+            except BaseException as e:  # noqa: BLE001 - re-raised at seq
+                out = (False, e)
+            _adjust_gauge(reg, "data/workers_busy", -1)
+            with self._cond:
+                self._results[seq] = out
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------- close
+
+    def close(self, *, timeout: float = 5.0) -> None:
+        """Poison-pill shutdown: discard queued work, stop every worker,
+        wake any blocked ``result()`` caller. Idempotent; safe to call
+        from finalizers, ``atexit``, and preemption paths."""
+        if self._closed:
+            return
+        self._closed = True
+        # Discard pending submissions so pills reach the workers even
+        # when the queue is full of un-started work.
+        try:
+            while True:
+                self._in.get_nowait()
+        except queue.Empty:
+            pass
+        for _ in self._threads:
+            self._in.put(_POISON)
+        for t in self._threads:
+            t.join(timeout)
+            if t.is_alive():  # pragma: no cover - wedged C call
+                log.warning(
+                    "worker thread %s did not exit within %.1fs "
+                    "(daemon; will not block interpreter exit)",
+                    t.name,
+                    timeout,
+                )
+        with self._cond:
+            self._cond.notify_all()
+        _adjust_gauge(self._reg(), "data/input_workers", -self.num_workers)
+        _exit_fast_switch()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "WorkerPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PipelinedIterator:
+    """Iterator facade over ``pool.map_ordered(items)``.
+
+    Carries ``background = True`` — the marker ``data/prefetch.py`` reads
+    to record its queue pops as ``data_wait`` (starvation) instead of
+    ``data_work`` (the workers already recorded the real work from their
+    own threads). Closing (explicitly, via ``with``, or by the GC
+    finalizer) tears down the source generator AND the pool, so the
+    whole pipeline unwinds from the consumer end with no orphans.
+    """
+
+    background = True
+
+    def __init__(self, pool: WorkerPool, items: Iterable):
+        self._pool = pool
+        self._gen = pool.map_ordered(items)
+        self._finalizer = weakref.finalize(self, pool.close)
+
+    def __iter__(self) -> "PipelinedIterator":
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except StopIteration:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        try:
+            self._gen.close()  # unwinds the source generator's finally
+        finally:
+            self._finalizer()  # idempotent pool.close()
+
+    def __enter__(self) -> "PipelinedIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

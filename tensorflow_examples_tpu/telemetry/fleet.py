@@ -15,11 +15,14 @@ a counter sum. This module adds the fleet view:
   breakdown, the slowest host (step-time p95 argmax), the **skew
   ratio** (slowest p95 / fleet median p95), and — when the ratio
   crosses ``TrainConfig.straggler_skew_factor`` — a straggler verdict
-  with **side attribution**: input-side if the host's data-fetch excess
-  explains its step-time excess (the prefetch queue back-pressures the
-  loop, so a starved input pipeline surfaces in ``data_fetch``),
-  compute-side otherwise (slow chip, thermal throttle, a host busy
-  elsewhere).
+  with **side attribution**: input-side if the host's input-pipeline
+  excess explains its step-time excess, compute-side otherwise (slow
+  chip, thermal throttle, a host busy elsewhere). The input signal is
+  ``data_work`` p95 — host time actually spent producing batches
+  (ISSUE 6) — not ``data_fetch``, which also counts queue
+  back-pressure wait and would blame a fast host blocked on the
+  device; ``data_fetch`` p95 remains in the vector as the legacy
+  fallback for peers that predate the split.
 * The summary lands as a ``kind="fleet"`` schema-v3 JSONL line (host
   0's metrics.jsonl is the run record; every host's shard carries it
   too), and the straggler verdict is logged at WARNING on host 0
@@ -52,11 +55,16 @@ log = logging.getLogger(__name__)
 # must have identical shape on every process (same rule as
 # hub.HOST_LOCAL_COUNTERS). Absent values travel as NaN. Aliases the
 # schema's per-host key contract so writer and validator cannot drift.
-VECTOR_KEYS = schema.FLEET_HOST_KEYS
+VECTOR_KEYS = schema.FLEET_VECTOR_KEYS
 
-# Side attribution: the straggler is input-side when its data-fetch
+# Side attribution: the straggler is input-side when its input-pipeline
 # excess (vs the fleet median) covers at least this fraction of its
-# step-time excess — the fetch IS the stall; otherwise compute-side.
+# step-time excess — the input side IS the stall; otherwise
+# compute-side. The input signal is ``data_work`` p95 (host time
+# actually spent producing batches, ISSUE 6) when the host reported
+# one, falling back to ``data_fetch`` p95 for pre-ISSUE-6 peers —
+# data_fetch also counts queue back-pressure wait, which used to tag a
+# fast host blocked on the device as "input-side".
 INPUT_SIDE_FRACTION = 0.5
 
 
@@ -129,6 +137,7 @@ class FleetMonitor:
         reg = self._reg()
         step_p50, step_p95 = reg.histogram("step_time").percentiles(50, 95)
         (fetch_p95,) = reg.histogram("span/data_fetch").percentiles(95)
+        (work_p95,) = reg.histogram("span/data_work").percentiles(95)
         peak = reg.gauge("memory/peak_live_bytes").value
         nan = float("nan")
         # float32: the collective goes through jnp, and the default JAX
@@ -144,6 +153,7 @@ class FleetMonitor:
                 float(peak) if peak is not None else nan,
                 float(counters.get("io/retries", 0)),
                 float(counters.get("data/batches_skipped", 0)),
+                work_p95 if work_p95 is not None else nan,
             ],
             np.float32,
         )
@@ -183,6 +193,13 @@ class FleetMonitor:
         ]
         p95 = matrix[:, VECTOR_KEYS.index("step_time_p95")]
         fetch = matrix[:, VECTOR_KEYS.index("data_fetch_p95")]
+        work = matrix[:, VECTOR_KEYS.index("data_work_p95")]
+        # Input-side evidence per host: time actually spent PRODUCING
+        # batches (data_work) when reported; data_fetch (which also
+        # counts queue back-pressure wait) only as the pre-ISSUE-6
+        # fallback — a fast host blocked on the device must not read
+        # as input-bound.
+        input_sig = np.where(np.isfinite(work), work, fetch)
         summary: dict = {
             "hosts": hosts,
             "slowest_host": None,
@@ -203,10 +220,12 @@ class FleetMonitor:
         if median_p95 > 0 and math.isfinite(p95[slowest]):
             skew = float(p95[slowest] / median_p95)
             summary["skew"] = skew
-            others_fetch = np.delete(fetch, slowest)
+            others_sig = np.delete(input_sig, slowest)
             summary["side"] = self._attribute_side(
-                p95[slowest], median_p95, fetch[slowest],
-                _finite_median(others_fetch if others_fetch.size else fetch),
+                p95[slowest], median_p95, input_sig[slowest],
+                _finite_median(
+                    others_sig if others_sig.size else input_sig
+                ),
             )
             summary["straggler"] = (
                 self.skew_factor > 0
@@ -219,23 +238,25 @@ class FleetMonitor:
     def _attribute_side(
         host_p95: float,
         median_p95: float,
-        host_fetch: float,
-        median_fetch: float,
+        host_input: float,
+        median_input: float,
     ) -> str:
-        """Compute- vs input-side: does the host's data-fetch excess
-        explain its step-time excess? The loop's step clock contains the
-        fetch (the prefetch deque back-pressures), so an input-starved
-        host inflates BOTH; a slow chip inflates only the step time."""
+        """Compute- vs input-side: does the host's input-pipeline excess
+        explain its step-time excess? The input signal is data_work p95
+        (host time producing batches) with data_fetch p95 as the legacy
+        fallback — see ``input_sig`` in ``_summarize``. An input-starved
+        host inflates BOTH the step clock and its input signal; a slow
+        chip inflates only the step time."""
         step_excess = max(host_p95 - median_p95, 0.0)
-        if not math.isfinite(host_fetch):
-            return "compute"  # no fetch evidence: blame the device side
-        base_fetch = median_fetch if math.isfinite(median_fetch) else 0.0
-        fetch_excess = max(host_fetch - base_fetch, 0.0)
+        if not math.isfinite(host_input):
+            return "compute"  # no input evidence: blame the device side
+        base_input = median_input if math.isfinite(median_input) else 0.0
+        input_excess = max(host_input - base_input, 0.0)
         if step_excess <= 0:
             return "compute"
         return (
             "input"
-            if fetch_excess >= INPUT_SIDE_FRACTION * step_excess
+            if input_excess >= INPUT_SIDE_FRACTION * step_excess
             else "compute"
         )
 
@@ -245,15 +266,17 @@ class FleetMonitor:
             return  # one warning per straggling host per fit
         self._warned_hosts.add(host)
         entry = summary["hosts"][host]
+        work = entry.get("data_work_p95")
         log.warning(
             "FLEET STRAGGLER: host %d step-time p95 %.4fs is %.2fx the "
-            "fleet median (skew threshold %.2f) — %s-side (data-fetch "
-            "p95 %s)",
+            "fleet median (skew threshold %.2f) — %s-side (data-work "
+            "p95 %s, data-fetch p95 %s)",
             host,
             entry["step_time_p95"] or float("nan"),
             summary["skew"],
             self.skew_factor,
             summary["side"],
+            f"{work:.4f}s" if work is not None else "n/a",
             f"{entry['data_fetch_p95']:.4f}s"
             if entry["data_fetch_p95"] is not None
             else "n/a",

@@ -50,7 +50,10 @@ Line shape (version 3; version-1/-2 lines remain valid input)::
                                      #   kind == "fleet" lines
         "hosts": [{"host": 0, "step_time_p50": 0.01,
                    "step_time_p95": 0.02, "data_fetch_p95": 0.001,
-                   "steps_lost": 0, "peak_live_bytes": 1024}, ...],
+                   "steps_lost": 0, "peak_live_bytes": 1024,
+                   "data_work_p95": 0.001}, ...],  # data_work_p95:
+                                     #   additive (ISSUE 6), optional
+                                     #   on read
         "slowest_host": 1,           # int|null: p95 argmax
         "skew": 3.2,                 # slowest p95 / fleet median p95
         "side": "input",             # "compute"|"input"|null: where the
@@ -118,14 +121,27 @@ SERVING_KEYS = ("active_requests", "queue_depth", "slots",
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
-# writer side, fleet.VECTOR_KEYS, aliases this tuple — the allgathered
-# vector and the validated line cannot drift apart). io_retries and
-# batches_skipped are each host's OWN pre-reduction numbers — the
-# line-level counters carry the fleet sums, so these entries are the
-# only place a flaky host's IO churn stays localizable.
+# writer side, fleet.VECTOR_KEYS, aliases FLEET_VECTOR_KEYS below — the
+# allgathered vector and the validated line cannot drift apart).
+# io_retries and batches_skipped are each host's OWN pre-reduction
+# numbers — the line-level counters carry the fleet sums, so these
+# entries are the only place a flaky host's IO churn stays localizable.
 FLEET_HOST_KEYS = ("step_time_p50", "step_time_p95", "data_fetch_p95",
                    "steps_lost", "peak_live_bytes", "io_retries",
                    "batches_skipped")
+
+# Additive (optional-on-read) host keys: written by every current fleet
+# line but NOT required by the validator, so v3 lines from runs that
+# predate them keep validating. data_work_p95 (ISSUE 6) is host time
+# actually spent PRODUCING batches (the ``data_work`` span) — the
+# straggler input-side verdict reads it instead of data_fetch_p95,
+# which also counts queue back-pressure wait and would misreport a
+# fast host blocked on the device as input-bound. Values present in a
+# hosts entry are still numeric-or-null checked.
+FLEET_HOST_KEYS_OPTIONAL = ("data_work_p95",)
+
+# The full allgathered per-host vector, in wire order.
+FLEET_VECTOR_KEYS = FLEET_HOST_KEYS + FLEET_HOST_KEYS_OPTIONAL
 
 
 def _is_number(v: Any) -> bool:

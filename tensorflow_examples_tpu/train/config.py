@@ -93,6 +93,24 @@ class TrainConfig:
     #   prefetch pipeline: corrupt host batches are skipped (and counted)
     #   up to this many times before the run errors out; 0 = fail fast
 
+    # Input pipeline (data/; docs/data.md)
+    prefetch_depth: int = 2  # device-prefetch look-ahead: batches held
+    #   host→device ahead of the consuming step (the floor when the
+    #   adaptive controller is armed)
+    prefetch_depth_max: int = 0  # > prefetch_depth arms depth-adaptive
+    #   double buffering (data/prefetch.DepthController): the queue
+    #   deepens toward this bound while the observed data_fetch p95
+    #   dominates the device_step p95 and decays back when the input
+    #   side is comfortably ahead; 0 keeps the fixed depth
+    input_workers: int = 0  # background decode/augment worker threads
+    #   (data/workers.py): > 0 moves the ImageNet TFRecord hot path onto
+    #   the sharded-parallel python pipeline (N readers + this many
+    #   decode workers, deterministic and exactly resumable); 0 keeps
+    #   the inline tf.data/native path
+    input_readers: int = 2  # parallel shard-reader threads of the
+    #   python TFRecord pipeline (only meaningful with input_workers>0);
+    #   1 = the literal sequential reference stream
+
     # Telemetry (tensorflow_examples_tpu/telemetry/; docs/observability.md)
     telemetry_sinks: str = "jsonl,tensorboard,console"  # comma list of
     #   metric sinks per log window: "jsonl" (schema-versioned

@@ -528,6 +528,12 @@ class Trainer:
                     else bundle_sharding(self.mesh),
                     local_batches=local_batches and jax.process_count() > 1,
                     max_skips=cfg.max_skipped_batches,
+                    depth=max(
+                        int(getattr(cfg, "prefetch_depth", 2) or 2), 1
+                    ),
+                    depth_max=int(
+                        getattr(cfg, "prefetch_depth_max", 0) or 0
+                    ),
                 )
 
             train_iter = build_iter(start_step)

@@ -120,6 +120,22 @@ def make_train_iter(cfg: ImagenetConfig, start_step: int):
         # own devices consume (global-view feeding would decode the full
         # global batch on EVERY host and discard (P-1)/P of the work —
         # on the benchmark-critical input pipeline).
+        if getattr(cfg, "input_workers", 0) > 0:
+            # ISSUE 6 hot path: sharded parallel readers + background
+            # decode/augment workers (deterministic AND exactly
+            # resumable by construction — every stream position is a
+            # pure function of (seed, start_step)).
+            return imagenet_data.parallel_tfrecord_iter(
+                cfg.data_dir,
+                "train",
+                cfg.global_batch_size // nproc,
+                train=True,
+                image_size=cfg.image_size,
+                seed=cfg.seed,
+                num_readers=max(getattr(cfg, "input_readers", 2), 1),
+                num_workers=cfg.input_workers,
+                start_step=start_step,
+            )
         return imagenet_data.tfrecord_iter(
             cfg.data_dir,
             "train",

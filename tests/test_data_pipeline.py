@@ -1,0 +1,600 @@
+"""ISSUE 6: host input pipeline — sharded parallel readers, background
+decode/augment workers, the parallel ImageNet stream's determinism
+contract (bit-identical to the sequential reference for any reader/
+worker count, per-host sharding, torn-tail/resume), the data_wait vs
+data_work span split, depth-adaptive prefetch, and the batched augment
+helpers."""
+
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflow_examples_tpu.data import augment as augment_mod
+from tensorflow_examples_tpu.data import imagenet as imagenet_data
+from tensorflow_examples_tpu.data import prefetch as prefetch_mod
+from tensorflow_examples_tpu.data import sources as sources_mod
+from tensorflow_examples_tpu.data import workers as workers_mod
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+from tensorflow_examples_tpu.telemetry import spans as spans_mod
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = registry_mod.reset_default_registry()
+    tracer = spans_mod.reset_default_tracer()
+    yield reg, tracer
+    registry_mod.reset_default_registry()
+    spans_mod.reset_default_tracer()
+
+
+def _take(it, n):
+    out = [next(it) for _ in range(n)]
+    close = getattr(it, "close", None)
+    if close is not None:
+        close()
+    return out
+
+
+# ------------------------------------------------------ TFRecord (pure)
+
+
+class TestPureTFRecord:
+    def test_roundtrip_and_tf_interop(self, tmp_path):
+        path = str(tmp_path / "train-00000-of-00001")
+        recs = [
+            sources_mod.make_example(
+                {"image/encoded": bytes([i]) * 5, "image/class/label": i + 1}
+            )
+            for i in range(7)
+        ]
+        assert sources_mod.write_tfrecord(path, recs) == 7
+        back = list(sources_mod.iter_tfrecord_records(path, verify_crc=True))
+        assert back == recs
+        parsed = sources_mod.parse_example(back[3])
+        assert parsed["image/encoded"] == [bytes([3]) * 5]
+        assert parsed["image/class/label"] == [4]
+        tf = pytest.importorskip("tensorflow")
+        # tf's reader verifies our CRCs; tf's parser reads our proto.
+        got = [
+            int(
+                tf.io.parse_single_example(
+                    r,
+                    {"image/class/label": tf.io.FixedLenFeature([], tf.int64)},
+                )["image/class/label"]
+            )
+            for r in tf.data.TFRecordDataset([path])
+        ]
+        assert got == list(range(1, 8))
+        # and our parser reads tf-written examples
+        ex = tf.train.Example(
+            features=tf.train.Features(
+                feature={
+                    "f": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[5, -3])
+                    )
+                }
+            )
+        ).SerializeToString()
+        assert sources_mod.parse_example(ex)["f"] == [5, -3]
+
+    def test_truncated_record_is_loud(self, tmp_path):
+        """A record cut off mid-frame raises (tf DataLossError parity):
+        silent truncation would desync the cached record count the
+        resume arithmetic trusts. EOF on a record boundary is clean."""
+        path = str(tmp_path / "train-torn")
+        recs = [b"record-%d" % i for i in range(5)]
+        sources_mod.write_tfrecord(path, recs)
+        assert list(sources_mod.iter_tfrecord_records(path)) == recs
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:  # tear the last record mid-payload
+            f.truncate(size - 7)
+        it = sources_mod.iter_tfrecord_records(path)
+        assert [next(it) for _ in range(4)] == recs[:4]
+        with pytest.raises(ValueError, match="truncated record"):
+            next(it)
+
+    def test_seeded_window_shuffle_mixes_and_replays(self):
+        items = list(range(200))
+        rng = lambda: np.random.default_rng(11)  # noqa: E731
+        a = list(sources_mod.seeded_window_shuffle(iter(items), 32, rng()))
+        b = list(sources_mod.seeded_window_shuffle(iter(items), 32, rng()))
+        assert a == b  # pure function of (stream, rng)
+        assert sorted(a) == items  # a permutation: no dupes, no drops
+        assert a != items  # actually shuffles
+        c = list(
+            sources_mod.seeded_window_shuffle(
+                iter(items), 32, np.random.default_rng(12)
+            )
+        )
+        assert c != a  # seed-dependent
+        assert list(
+            sources_mod.seeded_window_shuffle(iter(items), 1, rng())
+        ) == items  # window<=1 is a pass-through
+
+
+# ------------------------------------------------------- sharded reader
+
+
+class TestShardedReader:
+    def _shards(self, n_shards=6, seed=0):
+        rng = np.random.default_rng(seed)
+        return [
+            [f"s{s}r{r}" for r in range(int(rng.integers(2, 9)))]
+            for s in range(n_shards)
+        ]
+
+    def test_merge_identical_for_any_reader_count(self):
+        shards = self._shards()
+        ref = [r for shard in shards for r in shard]
+        for n in (1, 2, 3, 8):
+            got = list(
+                sources_mod.interleave_shards(shards, iter, num_readers=n)
+            )
+            assert got == ref, f"num_readers={n} broke the merge order"
+
+    def test_per_host_union_exactly_once(self):
+        shards = self._shards(n_shards=7, seed=3)
+        ref = [r for shard in shards for r in shard]
+        for hosts in (2, 3):
+            union = []
+            for h in range(hosts):
+                union.extend(
+                    sources_mod.interleave_shards(
+                        shards[h::hosts], iter, num_readers=2
+                    )
+                )
+            assert sorted(union) == sorted(ref)  # no dupes, no drops
+
+    def test_reader_error_raised_in_stream_order(self):
+        def read_fn(shard):
+            if shard == "bad":
+                raise OSError("disk ate it")
+            return iter([shard])
+
+        it = sources_mod.interleave_shards(
+            ["a", "bad", "c"], read_fn, num_readers=2
+        )
+        assert next(it) == "a"
+        with pytest.raises(RuntimeError, match="bad"):
+            list(it)
+
+    def test_global_lookahead_bounded(self):
+        """Many small shards + a stalled consumer: readers stop at the
+        max_ahead window instead of buffering the whole split."""
+        reads = []
+
+        def read_fn(shard):
+            reads.append(shard)
+            return iter([shard])
+
+        reader = sources_mod.ShardedReader(
+            list(range(50)), read_fn, num_readers=4,
+            buffer_records=8, block_records=1, max_ahead=4,
+        )
+        try:
+            stream = reader.records()
+            assert next(stream) == 0
+            time.sleep(0.25)  # consumer stalled mid-shard
+            assert len(reads) <= 4 + 1, reads  # the window, not the list
+        finally:
+            reader.close()
+
+    def test_close_stops_reader_threads(self):
+        """Readers blocked on FULL shard buffers (the abandoned-consumer
+        case) must exit promptly on close — no orphan threads."""
+        started = threading.active_count()
+
+        def read_fn(shard):
+            for r in range(100_000):
+                yield (shard, r)
+
+        reader = sources_mod.ShardedReader(
+            list(range(4)), read_fn, num_readers=3,
+            buffer_records=4, block_records=1,
+        )
+        stream = reader.records()
+        assert next(stream) == (0, 0)
+        reader.close()
+        deadline = time.time() + 5
+        while threading.active_count() > started and time.time() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= started
+
+
+# --------------------------------------------------------- worker pool
+
+
+class TestWorkerPool:
+    def test_ordered_results_match_inline_map(self):
+        rng = np.random.default_rng(0)
+        delays = rng.uniform(0, 0.003, size=40)
+
+        def fn(i):
+            time.sleep(delays[i])
+            return i * i
+
+        with workers_mod.WorkerPool(fn, 4) as pool:
+            got = list(pool.map_ordered(range(40)))
+        assert got == [i * i for i in range(40)]
+
+    def test_exception_surfaces_at_its_position(self):
+        def fn(i):
+            if i == 5:
+                raise ValueError("item five")
+            return i
+
+        pool = workers_mod.WorkerPool(fn, 3)
+        try:
+            it = pool.map_ordered(range(10))
+            assert [next(it) for _ in range(5)] == list(range(5))
+            with pytest.raises(workers_mod.WorkerError, match="item 5"):
+                next(it)
+        finally:
+            pool.close()
+
+    def test_poison_pill_shutdown_no_orphans(self):
+        import sys
+
+        started = threading.active_count()
+        interval0 = sys.getswitchinterval()
+        pool = workers_mod.WorkerPool(lambda x: x, 4)
+        assert sys.getswitchinterval() <= 0.001  # pipeline handoff mode
+        assert threading.active_count() == started + 4
+        it = pool.map_ordered(range(100))
+        assert next(it) == 0
+        pool.close()
+        pool.close()  # idempotent
+        deadline = time.time() + 5
+        while threading.active_count() > started and time.time() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= started
+        # the GIL switch interval is restored once no pool is live
+        assert sys.getswitchinterval() == pytest.approx(interval0)
+        with pytest.raises(RuntimeError, match="closed"):
+            pool.submit(0, 1)
+
+    def test_in_flight_bounded_by_depth(self):
+        seen = []
+
+        def fn(i):
+            seen.append(i)
+            return i
+
+        pool = workers_mod.WorkerPool(fn, 2, depth=3)
+        try:
+            it = pool.map_ordered(range(50))
+            next(it)
+            time.sleep(0.1)  # stalled consumer: pool must not run ahead
+            assert len(seen) <= 1 + 3 + pool.num_workers
+        finally:
+            pool.close()
+
+    def test_workers_record_data_work_spans(self, fresh_registry):
+        reg, _ = fresh_registry
+        with workers_mod.WorkerPool(lambda x: x, 2, registry=reg) as pool:
+            list(pool.map_ordered(range(8)))
+        (p95,) = reg.histogram("span/data_work").percentiles(95)
+        assert p95 is not None
+        assert reg.counter("data/worker_items").value == 8
+
+
+# ------------------------------------------- parallel ImageNet pipeline
+
+
+def _jpeg(rng, h=40, w=48):
+    from PIL import Image
+
+    img = rng.integers(0, 255, (h, w, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=85)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    """4 train shards / 16 records (labels = 1-based record index, so a
+    decoded label IS the record's global index) + a 6-record validation
+    shard. Sized so a 2-host split at batch 4 has no epoch remainder."""
+    root = tmp_path_factory.mktemp("imagenet_shards")
+    rng = np.random.default_rng(0)
+    idx = 0
+    for s in range(4):
+        recs = []
+        for _ in range(4):
+            idx += 1
+            recs.append(
+                sources_mod.make_example(
+                    {"image/encoded": _jpeg(rng), "image/class/label": idx}
+                )
+            )
+        sources_mod.write_tfrecord(
+            str(root / f"train-{s:05d}-of-00004"), recs
+        )
+    recs = [
+        sources_mod.make_example(
+            {"image/encoded": _jpeg(rng), "image/class/label": 1 + (i % 4)}
+        )
+        for i in range(6)
+    ]
+    sources_mod.write_tfrecord(str(root / "validation-00000-of-00001"), recs)
+    cache = tmp_path_factory.mktemp("cache")
+    old = os.environ.get("TFE_TPU_CACHE_DIR")
+    os.environ["TFE_TPU_CACHE_DIR"] = str(cache)
+    yield str(root)
+    if old is None:
+        os.environ.pop("TFE_TPU_CACHE_DIR", None)
+    else:
+        os.environ["TFE_TPU_CACHE_DIR"] = old
+
+
+def _train_iter(root, **kw):
+    base = dict(
+        train=True, image_size=32, seed=5, host_index=0, host_count=1
+    )
+    base.update(kw)
+    return imagenet_data.parallel_tfrecord_iter(root, "train", 4, **base)
+
+
+class TestParallelImagenet:
+    def test_parallel_bit_identical_to_sequential(self, shard_dir):
+        # 16 records / batch 4 -> bpe 4; 10 batches cross 2+ epoch
+        # boundaries (reshuffled shard order each epoch).
+        ref = _take(_train_iter(shard_dir, num_readers=1, num_workers=0), 10)
+        for readers, nw in ((2, 2), (3, 4)):
+            got = _take(
+                _train_iter(
+                    shard_dir, num_readers=readers, num_workers=nw
+                ),
+                10,
+            )
+            for want, have in zip(ref, got):
+                np.testing.assert_array_equal(want["label"], have["label"])
+                np.testing.assert_array_equal(want["image"], have["image"])
+
+    def test_resume_replays_exactly(self, shard_dir):
+        full = _take(_train_iter(shard_dir, num_readers=2, num_workers=2), 9)
+        # mid-epoch, at the epoch boundary (bpe=4), and past it
+        for start in (2, 4, 5):
+            got = _take(
+                _train_iter(
+                    shard_dir,
+                    num_readers=2,
+                    num_workers=2,
+                    start_step=start,
+                ),
+                3,
+            )
+            for want, have in zip(full[start:], got):
+                np.testing.assert_array_equal(want["label"], have["label"])
+                np.testing.assert_array_equal(want["image"], have["image"])
+
+    def test_epochs_reshuffle_records_within_shards(self, shard_dir):
+        """The record-level shuffle window: consecutive epochs must not
+        replay identical batch sequences (the tf.data path's 16*batch
+        shuffle-buffer semantics, seeded per epoch)."""
+        it = _train_iter(shard_dir, num_readers=2, num_workers=0)
+        epoch0 = [tuple(int(x) for x in b["label"]) for b in _take(it, 4)]
+        it = _train_iter(
+            shard_dir, num_readers=2, num_workers=0, start_step=4
+        )
+        epoch1 = [tuple(int(x) for x in b["label"]) for b in _take(it, 4)]
+        assert sorted(sum(epoch0, ())) == sorted(sum(epoch1, ()))  # same set
+        assert epoch0 != epoch1  # different order
+
+    def test_two_host_union_is_the_full_epoch_exactly_once(self, shard_dir):
+        # Each host holds 2 shards / 8 records -> bpe 2 at batch 4, no
+        # remainder: one epoch across hosts must cover every record
+        # exactly once (labels are unique record indices).
+        labels = []
+        for host in range(2):
+            for b in _take(
+                _train_iter(
+                    shard_dir,
+                    num_readers=2,
+                    num_workers=2,
+                    host_index=host,
+                    host_count=2,
+                ),
+                2,
+            ):
+                labels.extend(int(x) for x in b["label"])
+        assert sorted(labels) == list(range(16))
+
+    def test_fallback_decode_identical_too(self, shard_dir, monkeypatch):
+        monkeypatch.setenv("TFE_TPU_NATIVE_DECODE", "0")
+        ref = _take(_train_iter(shard_dir, num_readers=1, num_workers=0), 4)
+        got = _take(_train_iter(shard_dir, num_readers=2, num_workers=3), 4)
+        for want, have in zip(ref, got):
+            np.testing.assert_array_equal(want["image"], have["image"])
+
+    def test_eval_pads_final_batch_with_mask(self, shard_dir):
+        batches = list(
+            imagenet_data.parallel_tfrecord_iter(
+                shard_dir, "validation", 4, train=False, image_size=32,
+                num_readers=2, num_workers=2, host_index=0, host_count=1,
+            )
+        )
+        assert len(batches) == 2
+        assert batches[0]["mask"].sum() == 4
+        assert batches[1]["mask"].sum() == 2
+        assert batches[1]["image"].shape == (4, 32, 32, 3)
+
+    def test_abandoned_pipeline_leaves_no_threads(self, shard_dir):
+        started = threading.active_count()
+        it = _train_iter(shard_dir, num_readers=2, num_workers=3)
+        next(it)
+        it.close()
+        deadline = time.time() + 5
+        while threading.active_count() > started and time.time() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= started
+
+
+# ------------------------------------- prefetch: span split + depth
+
+
+class TestPrefetchSplit:
+    def _sharding(self):
+        import jax
+
+        return jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+    def _batches(self, n):
+        for i in range(n):
+            yield {"x": np.full((2,), i, np.float32)}
+
+    def test_sync_iterator_is_data_work(self, fresh_registry):
+        reg, _ = fresh_registry
+        out = list(
+            prefetch_mod.device_prefetch(self._batches(5), self._sharding())
+        )
+        assert len(out) == 5
+        assert reg.histogram("span/data_work").percentiles(95)[0] is not None
+        assert reg.histogram("span/data_wait").percentiles(95)[0] is None
+
+    def test_background_iterator_is_data_wait_and_closed(
+        self, fresh_registry
+    ):
+        reg, _ = fresh_registry
+        outer = self
+
+        class BG:
+            background = True
+            closed = False
+
+            def __init__(self):
+                self._it = outer._batches(4)
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                return next(self._it)
+
+            def close(self):
+                self.closed = True
+
+        bg = BG()
+        out = list(prefetch_mod.device_prefetch(bg, self._sharding()))
+        assert len(out) == 4 and bg.closed
+        assert reg.histogram("span/data_wait").percentiles(95)[0] is not None
+
+    def test_lookahead_bounded_by_depth(self, fresh_registry):
+        pulled = []
+
+        def src():
+            for i in range(20):
+                pulled.append(i)
+                yield {"x": np.zeros((1,), np.float32)}
+
+        it = prefetch_mod.device_prefetch(
+            src(), self._sharding(), depth=3
+        )
+        next(it)
+        # 3 primed + 1 refill after the pop; never the whole stream
+        assert len(pulled) <= 4
+
+    def test_depth_controller_grows_then_shrinks(self):
+        reg = registry_mod.MetricsRegistry()
+        ctl = prefetch_mod.DepthController(
+            2, 6, registry=reg, adapt_every=2
+        )
+        for _ in range(8):
+            reg.histogram("span/data_fetch").record(0.1)
+            reg.histogram("span/device_step").record(0.01)
+        for _ in range(12):
+            ctl.observe()
+        assert ctl.depth == 6  # input-bound: grew to the bound
+        reg2 = registry_mod.MetricsRegistry()
+        ctl2 = prefetch_mod.DepthController(
+            2, 6, registry=reg2, adapt_every=2
+        )
+        ctl2.depth = 5
+        for _ in range(8):
+            reg2.histogram("span/data_fetch").record(0.0001)
+            reg2.histogram("span/device_step").record(0.05)
+        for _ in range(12):
+            ctl2.observe()
+        assert ctl2.depth == 2  # queue ahead: decayed to the floor
+        assert reg2.gauge("data/prefetch_depth").value == 2.0
+
+    def test_fixed_depth_controller_is_inert(self):
+        reg = registry_mod.MetricsRegistry()
+        ctl = prefetch_mod.DepthController(2, 0, registry=reg)
+        for _ in range(50):
+            reg.histogram("span/data_fetch").record(1.0)
+            reg.histogram("span/device_step").record(0.001)
+            ctl.observe()
+        assert ctl.depth == 2
+
+
+# --------------------------------------------------- batched augment
+
+
+class TestBatchedAugment:
+    def test_uint8_lut_byte_identical_to_per_image_loop(self):
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, (6, 9, 7, 3), np.uint8)
+        mean = imagenet_data.MEAN_RGB
+        std = imagenet_data.STDDEV_RGB
+        got = augment_mod.normalize_images(imgs, mean, std)
+        per_image = np.stack(
+            [(im.astype(np.float32) / 255.0 - mean) / std for im in imgs]
+        )
+        assert got.dtype == np.float32
+        np.testing.assert_array_equal(got, per_image.astype(np.float32))
+
+    def test_float_branch_byte_identical(self):
+        rng = np.random.default_rng(2)
+        imgs = rng.uniform(0, 255, (3, 5, 5, 3)).astype(np.float32)
+        mean = imagenet_data.MEAN_RGB
+        std = imagenet_data.STDDEV_RGB
+        np.testing.assert_array_equal(
+            augment_mod.normalize_images(imgs, mean, std),
+            ((imgs / 255.0) - mean) / std,
+        )
+
+    def test_flip_images_matches_loop(self):
+        rng = np.random.default_rng(3)
+        imgs = rng.integers(0, 256, (5, 4, 6, 3), np.uint8)
+        flips = np.array([1, 0, 0, 1, 1], np.uint8)
+        ref = imgs.copy()
+        for i, f in enumerate(flips):
+            if f:
+                ref[i] = ref[i, :, ::-1]
+        np.testing.assert_array_equal(
+            augment_mod.flip_images(imgs, flips), ref
+        )
+
+    def test_cifar_uint8_fallback_uses_batched_normalize(self, monkeypatch):
+        """The uint8 fallback (native lib absent) must equal the
+        per-image formula under the same seeded draws."""
+        from tensorflow_examples_tpu import native
+        from tensorflow_examples_tpu.data.sources import (
+            CIFAR10_MEAN,
+            CIFAR10_STD,
+        )
+
+        monkeypatch.setattr(
+            native, "crop_flip_normalize", lambda *a, **k: None
+        )
+        rng = np.random.default_rng(7)
+        imgs = rng.integers(0, 256, (4, 32, 32, 3), np.uint8)
+        batch = {"image": imgs, "label": np.arange(4, dtype=np.int32)}
+        out = augment_mod.cifar_augment(batch, np.random.default_rng(9))
+        # replay the same draw order on the float path
+        rng2 = np.random.default_rng(9)
+        b = 4
+        pad = 4
+        ys = rng2.integers(0, 2 * pad + 1, size=b)
+        xs = rng2.integers(0, 2 * pad + 1, size=b)
+        flips = rng2.random(b) < 0.5
+        crop = augment_mod._crop_flip(
+            imgs.astype(np.float32) / 255.0, ys, xs, flips, pad=pad
+        )
+        want = ((crop - CIFAR10_MEAN) / CIFAR10_STD).astype(np.float32)
+        np.testing.assert_array_equal(out["image"], want)

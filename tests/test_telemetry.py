@@ -397,10 +397,16 @@ class TestSchema:
                        fleet=self._fleet(hosts=[{"step_time_p50": 1.0}]))
         )
         # every FLEET_HOST_KEYS entry is required (writer and validator
-        # share the tuple — fleet.VECTOR_KEYS aliases it)
+        # share the tuple — fleet.VECTOR_KEYS aliases the schema's
+        # vector, whose required prefix is FLEET_HOST_KEYS; the
+        # data_work_p95 extension is additive/optional so pre-ISSUE-6
+        # lines keep validating)
         from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
 
-        assert fleet_mod.VECTOR_KEYS is schema.FLEET_HOST_KEYS
+        assert fleet_mod.VECTOR_KEYS is schema.FLEET_VECTOR_KEYS
+        assert schema.FLEET_VECTOR_KEYS[: len(schema.FLEET_HOST_KEYS)] == (
+            schema.FLEET_HOST_KEYS
+        )
         incomplete = dict(self._fleet()["hosts"][0])
         del incomplete["data_fetch_p95"]
         assert any(
@@ -775,6 +781,46 @@ class TestFleetMonitor:
             r for r in caplog.records
             if "FLEET STRAGGLER" in r.getMessage()
         ]
+
+    def test_device_blocked_host_not_misreported_as_input_side(
+        self, fresh_telemetry
+    ):
+        """ISSUE 6 satellite: input-side verdicts read data_work (host
+        time PRODUCING batches), not data_fetch. A host whose fetch
+        time is queue back-pressure wait — big data_fetch, small
+        data_work — is compute-side; only real production time flips
+        the verdict to input."""
+        from tensorflow_examples_tpu.telemetry import fleet as fleet_mod
+
+        reg, _ = fresh_telemetry
+        self._feed(reg)
+        for _ in range(10):
+            reg.histogram("span/data_work").record(0.0005)
+        work_i = fleet_mod.VECTOR_KEYS.index("data_work_p95")
+
+        def blocked_on_device(vec):
+            slow = vec.copy()
+            slow[1] *= 5.0  # step time skewed...
+            slow[2] += slow[1]  # ...and the FETCH span shows the wait
+            # ...but data_work stays flat: the host wasn't producing.
+            return np.stack([vec, slow])
+
+        summary = self._monitor(reg, blocked_on_device).gather({})
+        assert summary["slowest_host"] == 1
+        assert summary["straggler"] is True
+        assert summary["side"] == "compute"  # pre-fix: "input"
+
+        def genuinely_input_bound(vec):
+            slow = vec.copy()
+            slow[1] *= 5.0
+            slow[2] += slow[1]
+            slow[work_i] += slow[1]  # the host really was producing
+            return np.stack([vec, slow])
+
+        summary = self._monitor(reg, genuinely_input_bound).gather({})
+        assert summary["side"] == "input"
+        # hosts entries carry the new key (numeric), schema-valid
+        assert summary["hosts"][0]["data_work_p95"] is not None
 
     def test_compute_side_straggler(self, fresh_telemetry):
         """Skewed step time with flat data-fetch time = the device side

@@ -1082,6 +1082,121 @@ class TestBenchGate:
         assert "[SKIP] peak_live_bytes: absent from record" in out
 
 
+class TestHostInputBench:
+    """ISSUE 6 CI satellite: the input-pipeline smoke — a BENCH-style
+    record from the real reader+worker pipeline, bit-identity verified,
+    on BOTH decode stages (native C++ and the tf/numpy fallback)."""
+
+    def _run(self, capsys, monkeypatch, tmp_path, native: bool):
+        import host_input_bench
+
+        monkeypatch.setenv(
+            "TFE_TPU_NATIVE_DECODE", "1" if native else "0"
+        )
+        # Pin the record-count cache into this test's tmp dir so the
+        # tool's setdefault can't leak a deleted path into the process.
+        monkeypatch.setenv("TFE_TPU_CACHE_DIR", str(tmp_path / "cache"))
+        rc = host_input_bench.main(["--smoke", "--json", "--n=16"])
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        return rc, rec
+
+    @pytest.mark.timeout(300)
+    def test_smoke_record_native_vs_fallback(
+        self, capsys, monkeypatch, tmp_path
+    ):
+        from tensorflow_examples_tpu import native
+
+        rc, rec = self._run(capsys, monkeypatch, tmp_path, native=False)
+        assert rc == 0, rec
+        assert rec["metric"] == "host_input_pipeline_images_per_sec"
+        assert rec["backend"] == "cpu" and rec["complete"] is True
+        assert rec["decoder"] == "fallback"
+        assert rec["identical"] is True  # parallel == sequential, bytewise
+        assert rec["value"] > 0 and rec["sequential_images_per_sec"] > 0
+        assert rec["fingerprint_tflops"] > 0
+        assert rec["workers"] == 4 and rec["readers"] == 2
+        assert rec["extras"][0]["metric"] == "host_input_seq_images_per_sec"
+        if native.available("fastjpeg"):
+            rc, rec = self._run(capsys, monkeypatch, tmp_path, native=True)
+            assert rc == 0 and rec["decoder"] == "native"
+            assert rec["identical"] is True and rec["complete"] is True
+
+    def test_record_gates_against_cpu_floor(self, tmp_path):
+        """The emitted record shape is gate-able by bench_gate against
+        bench.FLOORS['cpu'] (synthetic values: deterministic verdicts
+        on a box whose real throughput swings with ambient load)."""
+        import bench
+        import bench_gate
+
+        floor, floor_fp = bench.FLOORS["cpu"][
+            "host_input_pipeline_images_per_sec"
+        ]
+
+        def rec(value):
+            return {
+                "metric": "host_input_pipeline_images_per_sec",
+                "value": value, "unit": "images/sec", "backend": "cpu",
+                "fingerprint_tflops": floor_fp,
+            }
+
+        ok = tmp_path / "ok.json"
+        ok.write_text(json.dumps(rec(floor * 1.5)))
+        assert bench_gate.main([str(ok)]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(rec(floor * 0.5)))
+        assert bench_gate.main([str(bad)]) == 1
+
+    def test_pipeline_only_extra_promoted_to_metric(self, tmp_path):
+        """ISSUE 6: the buried pipeline_only_images_per_sec annotation
+        becomes a first-class gated metric — from the parsed record AND
+        from the torn-tail regex fallback."""
+        import bench_gate
+
+        doc = {
+            "parsed": {
+                "metric": "resnet50_examples_per_sec_per_chip",
+                "value": 100.0, "backend": "tpu",
+                "fingerprint_tflops": 2279.33,
+                "extras": [
+                    {
+                        "metric": "resnet50_input_examples_per_sec_per_chip",
+                        "value": 75.0,
+                        "pipeline_only_images_per_sec": 474.6,
+                    }
+                ],
+            }
+        }
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(doc))
+        recs = {r["metric"]: r for r in bench_gate.extract_records(str(p))}
+        assert (
+            recs["resnet50_input_pipeline_only_images_per_sec"]["value"]
+            == 474.6
+        )
+        assert (
+            recs["resnet50_input_pipeline_only_images_per_sec"][
+                "fingerprint"
+            ]
+            == 2279.33
+        )
+        tail = (
+            '{"metric": "resnet50_input_examples_per_sec_per_chip", '
+            '"value": 75.0, "pipeline_only_images_per_sec": 474.6, '
+            '"fingerprint_tflops_pre": 2279.33} "backend": "tpu"'
+        )
+        t = tmp_path / "t.json"
+        t.write_text(json.dumps({"tail": tail}))
+        recs = {r["metric"]: r for r in bench_gate.extract_records(str(t))}
+        assert (
+            recs["resnet50_input_pipeline_only_images_per_sec"]["value"]
+            == 474.6
+        )
+        # banked trajectory (with the floored metric) still gates green
+        assert bench_gate.main(
+            [os.path.join(REPO, "BENCH_r0*.json")]
+        ) == 0
+
+
 @pytest.mark.serving
 class TestServeBench:
     """The tier-1 serving smoke (ISSUE 5 CI satellite): stand the whole

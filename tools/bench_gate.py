@@ -98,7 +98,28 @@ def _flatten_bench_record(rec: dict) -> list[dict]:
                 "fingerprint": float(fp) if fp else None,
             }
         )
+        # Host input-throughput rider (ISSUE 6): the resnet50_input
+        # record carries the pipeline-only img/s (decode+augment with
+        # no device in the loop) as an annotation. Promote it to a
+        # first-class tracked metric so bench_gate floors it instead of
+        # leaving it a buried extras field.
+        pipeline_only = r.get("pipeline_only_images_per_sec")
+        if pipeline_only is not None:
+            out.append(
+                {
+                    "metric": _pipeline_only_metric(r["metric"]),
+                    "value": float(pipeline_only),
+                    "backend": r.get("backend", backend),
+                    "fingerprint": float(fp) if fp else None,
+                }
+            )
     return out
+
+
+def _pipeline_only_metric(parent_metric: str) -> str:
+    """Derived metric name for a record's pipeline-only annotation."""
+    base = parent_metric.replace("_examples_per_sec_per_chip", "")
+    return f"{base}_pipeline_only_images_per_sec"
 
 
 def _records_from_tail(tail: str) -> list[dict]:
@@ -133,6 +154,24 @@ def _records_from_tail(tail: str) -> list[dict]:
             {
                 "metric": metric,
                 "value": value,
+                "backend": backend,
+                "fingerprint": fp,
+            }
+        )
+    # Pipeline-only riders (ISSUE 6): attach each to the metric fragment
+    # it trails in the serialized form, mirroring _flatten_bench_record.
+    for m in re.finditer(
+        r'"pipeline_only_images_per_sec": ([-0-9.eE+]+)', tail
+    ):
+        pos = m.start()
+        parents = [name for p, name, _ in metrics if p < pos]
+        if not parents:
+            continue  # the owning fragment was lost to truncation
+        fp = next((v for p, v in fps if p > pos), None)
+        out.append(
+            {
+                "metric": _pipeline_only_metric(parents[-1]),
+                "value": float(m.group(1)),
                 "backend": backend,
                 "fingerprint": fp,
             }

@@ -51,6 +51,12 @@ flags.DEFINE_float("serve_watchdog_secs", 60.0,
 flags.DEFINE_float("stats_every", 10.0,
                    "seconds between serving.jsonl stats lines (0 disables)")
 flags.DEFINE_string("vocab_dir", "", "dir with vocab.json+merges.txt")
+flags.DEFINE_string(
+    "serve_sharding_config", "",
+    "ShardingConfig JSON for sharded serving (docs/sharding.md); "
+    "default: auto-load <workdir>/sharding.json — the config the "
+    "training run persisted — falling back to replicated params. "
+    "'off' forces replicated placement.")
 FLAGS = flags.FLAGS
 
 
@@ -92,12 +98,81 @@ def main(argv):
     cfg = _setup(gpt2, gpt2.Gpt2Config())
     if not cfg.workdir:
         raise app.UsageError("--workdir is required for serve")
+
+    # One ShardingConfig drives train AND serve (docs/sharding.md): the
+    # trainer persisted its placement spec next to the checkpoints;
+    # serving places the restored params + KV pool by the same rules
+    # instead of replicating. --serve_sharding_config overrides (or
+    # 'off' disables). Resolved BEFORE the restore so the checkpoint
+    # deserializes STRAIGHT into the sharded layout — a model that only
+    # fits split must never materialize on one device.
+    from tensorflow_examples_tpu.models.transformer import GPT2_RULES
+    from tensorflow_examples_tpu.sharding import ShardingConfig
+
+    sharding = None
+    src = FLAGS.serve_sharding_config
+    if src != "off":
+        path = src or os.path.join(cfg.workdir, "sharding.json")
+        if src or os.path.exists(path):
+            import dataclasses as _dc
+
+            sharding = ShardingConfig.load(path)
+            # Serving has no data parallelism within one process — a
+            # training config's data axis would only replicate params
+            # over devices serving never uses (and make a pod-trained
+            # config unserveable on a single chip). Collapse it.
+            sharding = _dc.replace(
+                sharding, mesh={**sharding.mesh, "data": 1}
+            )
+            try:
+                sharding.build_mesh()
+            except ValueError as e:
+                if src:
+                    # Explicitly requested config: fail loudly.
+                    raise
+                # Auto-loaded from the workdir: a host too small for
+                # the training layout serves replicated, as before.
+                print(
+                    f"sharding config {path} does not fit this host "
+                    f"({e}); serving with replicated params",
+                    file=sys.stderr,
+                )
+                sharding = None
+            else:
+                print(f"sharding config: {path}", file=sys.stderr)
+
     make_state, _ = state_factory(gpt2.make_task(cfg), cfg)
     abstract = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+    if sharding is not None:
+        # Shardings on the WHOLE template — params by the rules, the
+        # optimizer moments inheriting them — so nothing (the Adam
+        # state is 2x the param bytes) ever lands whole on one device.
+        from tensorflow_examples_tpu.sharding import state_shardings
+
+        mesh = sharding.build_mesh()
+        sh = state_shardings(
+            abstract,
+            mesh,
+            sharding.sharding_rules(default=GPT2_RULES),
+            zero1=sharding.zero1,
+            batch_axes=sharding.batch_axes,
+        )
+        abstract = jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                              sharding=s),
+            abstract,
+            sh,
+        )
     restored = CheckpointManager(cfg.workdir).restore_latest(abstract)
     if restored is None:
         raise SystemExit(f"no checkpoint under {cfg.workdir}")
-    params = jax.tree.map(jnp.asarray, restored[0].params)
+    # Already placed when sharded (the engine's device_put is then a
+    # no-op); asarray only on the replicated path.
+    params = (
+        restored[0].params
+        if sharding is not None
+        else jax.tree.map(jnp.asarray, restored[0].params)
+    )
 
     engine = InferenceEngine(
         gpt2.model_config(cfg),
@@ -108,6 +183,7 @@ def main(argv):
             max_delay_s=FLAGS.max_delay_s,
             watchdog_secs=FLAGS.serve_watchdog_secs,
         ),
+        sharding=sharding,
     )
     t0 = time.perf_counter()
     engine.warmup()

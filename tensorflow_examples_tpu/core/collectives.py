@@ -17,12 +17,61 @@ conventions documented; they are valid only inside ``shard_map`` (or
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any, Callable, Sequence
 
 import jax
 from jax import lax
 
 AxisName = str | Sequence[str]
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh=None,
+    in_specs,
+    out_specs,
+    axis_names: set | None = None,
+    check_vma: bool = True,
+):
+    """Version-portable ``shard_map`` (the framework's single spelling).
+    ``check_vma`` defaults to True to match ``jax.shard_map`` — callers
+    that need it off (every Pallas-opaque site today) say so.
+
+    Newer jax exposes ``jax.shard_map`` (manual axes named via
+    ``axis_names``, replication checking via ``check_vma``); on older
+    builds the same program spells ``jax.experimental.shard_map``
+    (manual-set complement via ``auto``, checking via ``check_rep``).
+    Every shard_map in the framework routes through here so the
+    collectives layer — not each caller — owns the translation, and a
+    jax upgrade/downgrade is one-file work.
+    """
+    if hasattr(jax, "shard_map"):
+        kw: dict = {"check_vma": check_vma}
+        if mesh is not None:
+            kw["mesh"] = mesh
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if mesh is None:
+        # The meshless form (manual axes resolved from the enclosing
+        # shard_map context) has no pre-jax.shard_map equivalent.
+        raise NotImplementedError(
+            "context-mesh shard_map (mesh=None) requires a jax build "
+            "with jax.shard_map"
+        )
+    kw = {"check_rep": check_vma}
+    if axis_names is not None:
+        # jax.shard_map names the MANUAL axes; the experimental API
+        # names the complement ("auto" axes).
+        kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
 
 
 def psum(x: Any, axis: AxisName) -> Any:
@@ -85,5 +134,9 @@ def axis_index(axis: AxisName) -> jax.Array:
 
 
 def axis_size(axis: AxisName) -> int:
-    """Number of shards along the mesh axis."""
-    return lax.axis_size(axis)
+    """Number of shards along the mesh axis. ``lax.axis_size`` where
+    the jax build has it; ``psum(1, axis)`` — which jax constant-folds
+    to the static size — on older builds."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)

@@ -95,16 +95,19 @@ def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, _filter_spec(P(*spec), mesh))
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for a [global_batch, ...] array: batch over data+fsdp axes."""
-    from tensorflow_examples_tpu.core.mesh import AxisNames
+def batch_sharding(mesh: Mesh, axes=None) -> NamedSharding:
+    """Sharding for a [global_batch, ...] array: batch over the given
+    batch-like axes (default data+fsdp), size-1 axes filtered."""
+    if axes is None:
+        from tensorflow_examples_tpu.core.mesh import AxisNames
 
-    axes = tuple(a for a in AxisNames.BATCH_AXES if mesh.shape[a] > 1)
-    return NamedSharding(mesh, P(axes if axes else None))
+        axes = AxisNames.BATCH_AXES
+    kept = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+    return NamedSharding(mesh, P(kept if kept else None))
 
 
-def bundle_sharding(mesh: Mesh) -> NamedSharding:
+def bundle_sharding(mesh: Mesh, axes=None) -> NamedSharding:
     """Sharding for a [k, global_batch, ...] step bundle: the scan axis
     (dim 0) is unsharded; the batch dim behind it shards exactly as
     ``batch_sharding`` does (derived from it, not re-filtered)."""
-    return NamedSharding(mesh, P(None, *batch_sharding(mesh).spec))
+    return NamedSharding(mesh, P(None, *batch_sharding(mesh, axes).spec))

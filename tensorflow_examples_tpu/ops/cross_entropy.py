@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tensorflow_examples_tpu.core import collectives as coll
+from tensorflow_examples_tpu.core.collectives import shard_map as _shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -230,7 +231,7 @@ def mesh_cross_entropy_per_example(
     lb_spec = P(
         batch_axes if batch_axes else None, seq_axes if seq_axes else None
     )
-    return jax.shard_map(
+    return _shard_map(
         _plain,
         mesh=mesh,
         in_specs=(lg_spec, lb_spec),
@@ -361,7 +362,7 @@ def tp_cross_entropy_from_hidden(
         gt = coll.psum(t, axis_name)
         return gm + jnp.log(jnp.maximum(gl, 1e-30)) - gt
 
-    return jax.shard_map(
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(bspec, P(axis_name, None), bspec),

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tensorflow_examples_tpu.core.collectives import shard_map as _shard_map
 from tensorflow_examples_tpu.core.mesh import AxisNames
 from tensorflow_examples_tpu.ops.attention import dot_product_attention
 from tensorflow_examples_tpu.parallel.ring import ring_attention, ulysses_attention
@@ -67,7 +68,7 @@ def mesh_decode_attention(
         return flash_decode_attention(q, k_cache, v_cache, length, sm_scale=sm_scale)
     spec = decode_spec(mesh, q.shape[0], q.shape[1])
     local = functools.partial(flash_decode_attention, sm_scale=sm_scale)
-    return jax.shard_map(
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec, P()),
@@ -86,7 +87,12 @@ def _stage_tp_axis(heads: int):
     the partitioner, which all-gathers the model-sharded heads around
     the Pallas kernel (the round-3 reason PP×TP stages had to use
     ``attention="xla"``)."""
-    am = jax.sharding.get_abstract_mesh()
+    get_am = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_am is None:
+        # jax builds without the abstract-mesh API can't express the
+        # pipe-manual nesting either — there is no stage context.
+        return None
+    am = get_am()
     manual = getattr(am, "manual_axes", ()) if am is not None else ()
     if not manual or AxisNames.MODEL in manual:
         return None
@@ -146,7 +152,7 @@ def mesh_attention(
                     "add a test with the bias grad psum before enabling"
                 )
             spec = P(None, tp, None, None)
-            return jax.shard_map(
+            return _shard_map(
                 lambda ql, kl, vl: flash_attention(
                     ql, kl, vl, causal=causal, sm_scale=sm_scale
                 ),
@@ -168,7 +174,7 @@ def mesh_attention(
         # a previously-working flash config into a trace error.
         spec = decode_spec(mesh, q.shape[0], q.shape[1])
         bias_spec = P(spec[0], None)
-        out = jax.shard_map(
+        out = _shard_map(
             lambda ql, kl, vl, bl: flash_attention(
                 ql, kl, vl, causal=causal, sm_scale=sm_scale, key_bias=bl
             ),
@@ -220,7 +226,7 @@ def mesh_attention(
     spec = attention_spec(mesh)
     # check_vma=False: the Pallas kernel's out_shape carries no
     # varying-axes type, which the vma checker (jax 0.9) rejects.
-    out = jax.shard_map(
+    out = _shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -46,6 +46,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tensorflow_examples_tpu.core.collectives import shard_map as _shard_map
+
 
 def _router(
     tokens: jax.Array,  # [n, d] f32-castable
@@ -611,7 +613,7 @@ def moe_ffn_ep(
     if rng is not None:
         args += (rng,)
         in_specs += (P(),)
-    return jax.shard_map(
+    return _shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=(x_spec, P(), P()),
         check_vma=False,
     )(*args)

@@ -53,6 +53,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tensorflow_examples_tpu.core.collectives import shard_map as _shard_map
+
 from tensorflow_examples_tpu.core import collectives as coll
 from tensorflow_examples_tpu.core.mesh import AxisNames
 
@@ -103,7 +105,7 @@ def _gpipe_local(stage_fn, params, x_mb, axis_name, rng=None):
     per (stage, microbatch) since a stage sees one microbatch per tick.
     Returns [M, mb, ...] outputs, valid on every device (psum-broadcast).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = coll.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m = x_mb.shape[0]
     fwd_perm = coll.ring_perm(n_stages)
@@ -188,7 +190,7 @@ def pipeline_apply(
     # may therefore only reference `pipe`; activations are pipe-
     # replicated (P()), their batch sharding rides the auto axes.
     if rng is None:
-        out = jax.shard_map(
+        out = _shard_map(
             lambda p, xm: _gpipe_local(stage_fn, p, xm, AxisNames.PIPE),
             mesh=mesh,
             in_specs=(param_specs, P()),
@@ -199,7 +201,7 @@ def pipeline_apply(
     else:
         # rng rides in as an explicit replicated argument (a closure
         # capture inside shard_map is not reliably supported).
-        out = jax.shard_map(
+        out = _shard_map(
             lambda p, xm, r: _gpipe_local(
                 stage_fn, p, xm, AxisNames.PIPE, rng=r
             ),
@@ -414,7 +416,7 @@ def _1f1b_local(
     dhead_local, dx_mb_local) — the caller reduces loss/dhead/dx over
     the pipe axis (each is produced on one device, zeros elsewhere).
     """
-    n_dev = lax.axis_size(axis_name)
+    n_dev = coll.axis_size(axis_name)
     dev = lax.axis_index(axis_name)
     v = n_virtual
     s_total_v = op_tbl.shape[1] * v  # == n_dev · v, static
@@ -666,7 +668,7 @@ def make_pipeline_1f1b(
 
         if rng is None:
             # A None rng can't cross the shard_map boundary as an arg.
-            return jax.shard_map(
+            return _shard_map(
                 lambda sp, hp, xm, lm: local(sp, hp, xm, lm),
                 mesh=mesh,
                 in_specs=(param_specs, head_specs, P(), P()),
@@ -674,7 +676,7 @@ def make_pipeline_1f1b(
                 axis_names={pipe_axis},
                 check_vma=False,
             )(constrained, head_params, x_mb, labels_mb)
-        return jax.shard_map(
+        return _shard_map(
             local,
             mesh=mesh,
             in_specs=(param_specs, head_specs, P(), P(), P()),

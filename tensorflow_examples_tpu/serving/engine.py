@@ -263,6 +263,7 @@ class InferenceEngine:
         *,
         cfg: ServeConfig | None = None,
         registry=None,
+        sharding=None,
     ):
         if model_cfg.moe_experts:
             raise NotImplementedError(
@@ -281,7 +282,36 @@ class InferenceEngine:
                 f"ServeConfig.attention={self.cfg.attention!r} not in "
                 "('xla', 'flash')"
             )
-        self.params = jax.tree.map(jnp.asarray, params)
+        # Sharded serving (ISSUE 7): the SAME ShardingConfig training
+        # persisted to workdir/sharding.json places the param tree by
+        # its rules (instead of replicating) and the KV pool with heads
+        # over `model`; GSPMD inserts the TP collectives into the
+        # already-compiled prefill/decode ladder, so the zero-recompile
+        # contract is untouched — the ladder is warmed with the
+        # sharded placements it will serve with. sharding=None keeps
+        # today's single-device placement exactly.
+        self.sharding = sharding
+        self.mesh = None
+        self.param_sharding_digest = None
+        if sharding is None:
+            params = jax.tree.map(jnp.asarray, params)
+        else:
+            # No asarray pre-pass: shard_params device_puts the host
+            # tree straight into the mesh layout — a model that only
+            # fits sharded must never materialize on device 0 first.
+            from tensorflow_examples_tpu.core.sharding import shard_params
+            from tensorflow_examples_tpu.models.transformer import (
+                GPT2_RULES,
+            )
+            from tensorflow_examples_tpu.sharding import resolve_params
+
+            self.mesh = sharding.build_mesh()
+            rules = sharding.sharding_rules(default=GPT2_RULES)
+            params = shard_params(params, self.mesh, rules)
+            self.param_sharding_digest = resolve_params(
+                params, self.mesh, rules
+            ).digest()
+        self.params = params
         self.registry = (
             registry if registry is not None
             else registry_mod.default_registry()
@@ -303,6 +333,7 @@ class InferenceEngine:
             head_dim=model_cfg.head_dim,
             dtype=cache_dtype,
             registry=self.registry,
+            sharding=self._kv_sharding(),
         )
         self.prefill_ladder = kv_mod.bucket_ladder(
             self.cfg.prefill_bucket_floor, model_cfg.max_len
@@ -338,6 +369,28 @@ class InferenceEngine:
         }
         self.warmed = False
         self._ref_fwd = None
+
+    def _kv_sharding(self):
+        """KV-pool NamedSharding from the ShardingConfig: heads (dim 2
+        of [L, S, H, max_len, D]) shard over ``model`` — the layout
+        that keeps per-slot attention local to the head shard the qkv
+        projection already produced. A head count the model axis
+        doesn't divide replicates instead (placement is an
+        optimization, never a shape contract). None without a config
+        (single-device placement, the pre-ISSUE-7 behavior)."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tensorflow_examples_tpu.core.mesh import AxisNames
+
+        m = int(self.mesh.shape[AxisNames.MODEL])
+        heads = (
+            AxisNames.MODEL
+            if m > 1 and self.model_cfg.num_heads % m == 0
+            else None
+        )
+        return NamedSharding(self.mesh, P(None, None, heads, None, None))
 
     # ----------------------------------------------------- compiled fns
 
@@ -413,10 +466,7 @@ class InferenceEngine:
     def post_warmup_recompiles(self) -> int:
         """Total compiles beyond each variant's warmup allowance — the
         number that must be 0 in steady state (CI asserts it)."""
-        return sum(
-            max(0, n - self.sentinel.warmup)
-            for n in self.sentinel.compile_counts().values()
-        )
+        return self.sentinel.post_warmup_recompiles()
 
     # ------------------------------------------------------ request ops
 

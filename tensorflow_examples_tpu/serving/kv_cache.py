@@ -121,6 +121,7 @@ class KVCachePool:
         head_dim: int,
         dtype=jnp.float32,
         registry=None,
+        sharding=None,
     ):
         if num_slots < 1:
             raise ValueError(f"num_slots={num_slots} must be >= 1")
@@ -131,9 +132,15 @@ class KVCachePool:
         self.head_dim = head_dim
         self.dtype = dtype
         self._registry = registry
-        shape = (num_layers, num_slots, num_heads, max_len, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        # Optional NamedSharding for the [L, S, H, max_len, D] device
+        # arrays (ISSUE 7): the engine derives it from its
+        # ShardingConfig — heads over `model` is the tensor-parallel
+        # layout — so the cache is born (and reallocated) in the same
+        # placement the compiled steps consume. None = single-device
+        # default placement, today's behavior.
+        self._sharding = sharding
+        self.k = self._zeros()
+        self.v = self._zeros()
         self.lengths = np.zeros((num_slots,), np.int32)
         self._free = list(range(num_slots - 1, -1, -1))  # pop() -> slot 0 first
         self._lock = threading.Lock()
@@ -174,17 +181,24 @@ class KVCachePool:
             self._free.append(slot)
             self._publish()
 
-    def reallocate(self) -> None:
-        """Replace ``k``/``v`` with fresh zeroed device arrays. The
-        engine calls this when a donated compiled step fails at
-        runtime: donation consumed the old buffers, so without
-        replacement every later step would hit 'Array has been
-        deleted'. Slot bookkeeping is untouched — the batcher fails and
-        frees the whole in-flight set (its KV is gone) right after."""
+    def _zeros(self):
         shape = (self.num_layers, self.num_slots, self.num_heads,
                  self.max_len, self.head_dim)
-        self.k = jnp.zeros(shape, self.dtype)
-        self.v = jnp.zeros(shape, self.dtype)
+        if self._sharding is None:
+            return jnp.zeros(shape, self.dtype)
+        # Born sharded: zeros are created per-shard in place — the full
+        # pool never materializes on one device (it may only fit split).
+        return jnp.zeros(shape, self.dtype, device=self._sharding)
+
+    def reallocate(self) -> None:
+        """Replace ``k``/``v`` with fresh zeroed device arrays (in the
+        pool's sharding). The engine calls this when a donated compiled
+        step fails at runtime: donation consumed the old buffers, so
+        without replacement every later step would hit 'Array has been
+        deleted'. Slot bookkeeping is untouched — the batcher fails and
+        frees the whole in-flight set (its KV is gone) right after."""
+        self.k = self._zeros()
+        self.v = self._zeros()
 
     def reset(self) -> None:
         """Release every slot and zero the length mirror (the device

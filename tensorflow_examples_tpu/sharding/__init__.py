@@ -1,0 +1,49 @@
+"""Unified sharding subsystem (ISSUE 7 tentpole).
+
+ONE serializable :class:`ShardingConfig` is the source of truth for
+placement everywhere: ``train/loop.Trainer`` builds its mesh, its
+param/optimizer/activation shardings, and its ZeRO-1 weight-update
+sharding from it; ``serving/engine.InferenceEngine`` places the restored
+param tree and the KV-cache pool from the very same object; checkpoints
+carry it (``workdir/sharding.json``) so a restore onto a different mesh
+is validated — same rules restore bitwise-identically onto any layout,
+drifted rules fail with a named error instead of silently misplacing.
+
+Layering: ``core/mesh.py`` owns the axis conventions and mesh
+construction, ``core/sharding.py`` owns the (regex → PartitionSpec)
+rules table. This package is the layer ABOVE both: a serializable
+config that binds a mesh shape + a rules table + batch/ZeRO-1 policy
+into one object both the trainer and the serving engine consume, plus
+the resolution machinery (param table, placement digest, per-device
+byte accounting) that makes a layout inspectable before a run
+(``tools/shard_viz.py``) and comparable across runs (the digest on the
+telemetry ``kind="final"`` line and in ``sharding.json``).
+
+See docs/sharding.md for axis conventions, the config format, ZeRO-1
+memory math, and the CPU-mesh debugging recipe
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
+"""
+
+from tensorflow_examples_tpu.sharding.config import (
+    ShardingConfig,
+    ShardingMismatchError,
+    spec_from_json,
+    spec_to_json,
+)
+from tensorflow_examples_tpu.sharding.resolve import (
+    ResolvedSharding,
+    resolve_params,
+    state_shardings,
+    zero1_spec,
+)
+
+__all__ = [
+    "ResolvedSharding",
+    "ShardingConfig",
+    "ShardingMismatchError",
+    "resolve_params",
+    "spec_from_json",
+    "spec_to_json",
+    "state_shardings",
+    "zero1_spec",
+]

@@ -1,0 +1,354 @@
+"""ShardingConfig: the one serializable placement spec (ISSUE 7).
+
+A config is four decisions, all JSON-serializable:
+
+* ``mesh`` — logical device-mesh shape over the canonical axes
+  (``core/mesh.AxisNames``): ``{"data": 2, "model": 4}``. ``data: -1``
+  means "all remaining devices"; axes left out default to 1. Unlike
+  ``MeshConfig.resolve`` (which demands the shape exactly cover every
+  device), ``build_mesh`` uses the FIRST ``prod(shape)`` devices when
+  the host has more — that is what lets tier-1 exercise 1×1, 2×2, and
+  4×2 layouts on one 8-fake-CPU-device process, and a single-chip debug
+  run consume a pod-shaped config unchanged.
+* ``rules`` — the (param-path regex → PartitionSpec) table as
+  ``[pattern, spec]`` pairs, where a spec entry is ``null`` / an axis
+  name / a list of axis names (``spec_to_json``/``spec_from_json``
+  round-trip ``jax.sharding.PartitionSpec`` losslessly). Empty rules
+  mean "inherit the task's table" for the trainer and "replicate" for
+  standalone consumers.
+* ``batch_axes`` — which mesh axes shard the batch dim of activations
+  (the ``jax.jit`` in-sharding of every train/eval batch).
+* ``zero1`` — ZeRO-1 weight-update sharding (arXiv:2004.13336): shard
+  optimizer moments over the batch axes even where the param itself is
+  replicated; XLA then emits reduce-scatter(grad) → sharded moment
+  update → all-gather(update) and per-device optimizer bytes drop by
+  the replica count (``sharding/resolve.py``).
+
+The degenerate config (1×1 mesh, zero1 off) reproduces unsharded
+behavior exactly — every pre-existing golden (preemption resume,
+serving token identity) runs through this object unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Mapping, Sequence
+
+from jax.sharding import PartitionSpec as P
+
+from tensorflow_examples_tpu.core.mesh import AxisNames, MeshConfig, create_mesh
+from tensorflow_examples_tpu.core.sharding import ShardingRules
+
+# The on-disk format version of sharding.json (NOT the telemetry schema).
+SHARDING_JSON_VERSION = 1
+
+
+class ShardingMismatchError(ValueError):
+    """A checkpoint's saved sharding config is incompatible with the
+    live one — different rules resolve params to different
+    PartitionSpecs. Mesh SHAPE differences are legal (resharding on
+    restore is the feature); rule-table drift is not, and this error
+    names the drifted param paths instead of letting a run silently
+    train/serve with a placement the checkpoint was never built for."""
+
+
+def spec_to_json(spec: P) -> list:
+    """PartitionSpec -> JSON list: entry = None | axis | [axes...]."""
+    out: list = []
+    for entry in spec:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append([str(a) for a in entry])
+    return out
+
+
+def spec_from_json(entries: Sequence) -> P:
+    """Inverse of :func:`spec_to_json` (lists become axis tuples)."""
+    out = []
+    for entry in entries:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(tuple(str(a) for a in entry))
+    return P(*out)
+
+
+def rules_to_json(rules: ShardingRules) -> list[list]:
+    """A core ShardingRules table -> [[pattern, spec-json], ...]."""
+    return [
+        [pat.pattern, spec_to_json(spec)] for pat, spec in rules.rules
+    ]
+
+
+def rules_from_json(entries: Sequence[Sequence]) -> ShardingRules:
+    return ShardingRules(
+        [(str(pat), spec_from_json(spec)) for pat, spec in entries]
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingConfig:
+    """Serializable placement spec shared by training and serving."""
+
+    # axis -> size over AxisNames.ALL; absent axes are 1, data may be -1.
+    mesh: Mapping[str, int] = dataclasses.field(
+        default_factory=lambda: {"data": -1}
+    )
+    # [(pattern, spec-json entries)] — () inherits the task's table.
+    rules: tuple = ()
+    batch_axes: tuple = AxisNames.BATCH_AXES
+    zero1: bool = False
+
+    def __post_init__(self):
+        unknown = set(self.mesh) - set(AxisNames.ALL)
+        if unknown:
+            raise ValueError(
+                f"unknown mesh axes {sorted(unknown)}; canonical axes are "
+                f"{list(AxisNames.ALL)}"
+            )
+        bad_batch = set(self.batch_axes) - set(AxisNames.ALL)
+        if bad_batch:
+            raise ValueError(
+                f"unknown batch axes {sorted(bad_batch)}; canonical axes "
+                f"are {list(AxisNames.ALL)}"
+            )
+        for axis, size in self.mesh.items():
+            if axis == AxisNames.DATA and size == -1:
+                continue
+            if not isinstance(size, int) or isinstance(size, bool) or size < 1:
+                raise ValueError(
+                    f"mesh[{axis!r}] = {size!r} must be a positive int "
+                    "(or -1 for 'data')"
+                )
+        # Normalize containers so configs built from live
+        # PartitionSpecs, from JSON (lists), and from round-trips all
+        # compare EQUAL: rule entries become tuples down to the
+        # multi-axis level. (`mesh` stays a plain dict — convenient,
+        # but it makes the dataclass unhashable despite frozen=True;
+        # nothing keys on configs today.)
+        object.__setattr__(self, "mesh", dict(self.mesh))
+
+        def norm_entry(e):
+            return tuple(str(a) for a in e) if isinstance(
+                e, (list, tuple)
+            ) else e
+
+        object.__setattr__(
+            self,
+            "rules",
+            tuple(
+                (str(p), tuple(norm_entry(e) for e in s))
+                for p, s in self.rules
+            ),
+        )
+        object.__setattr__(self, "batch_axes", tuple(self.batch_axes))
+
+    # ------------------------------------------------------ construction
+
+    @classmethod
+    def from_train_config(cls, cfg, *, rules=None) -> "ShardingConfig":
+        """Derive from the legacy TrainConfig knobs (mesh_data/.../zero1)
+        + a task's live rules table, so the ShardingConfig is the single
+        source of truth even for runs configured the old way."""
+        mc = cfg.mesh_config()
+        mesh = {
+            AxisNames.DATA: mc.data,
+            AxisNames.FSDP: mc.fsdp,
+            AxisNames.MODEL: mc.model,
+            AxisNames.CONTEXT: mc.context,
+            AxisNames.PIPE: mc.pipe,
+        }
+        return cls(
+            mesh=mesh,
+            rules=tuple(
+                (pat, tuple(spec))
+                for pat, spec in (
+                    rules_to_json(rules) if rules is not None else ()
+                )
+            ),
+            zero1=bool(getattr(cfg, "zero1", False)),
+        )
+
+    @classmethod
+    def from_mesh(cls, mesh, *, rules=None, zero1: bool = False) -> "ShardingConfig":
+        """Snapshot a live ``jax.sharding.Mesh``'s shape into a config."""
+        shape = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+        return cls(
+            mesh=shape,
+            rules=tuple(
+                (pat, tuple(spec))
+                for pat, spec in (
+                    rules_to_json(rules) if rules is not None else ()
+                )
+            ),
+            zero1=zero1,
+        )
+
+    # ------------------------------------------------------------- views
+
+    def axis_size(self, axis: str) -> int:
+        return int(self.mesh.get(axis, 1))
+
+    def mesh_config(self) -> MeshConfig:
+        return MeshConfig(
+            data=self.axis_size(AxisNames.DATA),
+            fsdp=self.axis_size(AxisNames.FSDP),
+            model=self.axis_size(AxisNames.MODEL),
+            context=self.axis_size(AxisNames.CONTEXT),
+            pipe=self.axis_size(AxisNames.PIPE),
+        )
+
+    def sharding_rules(self, default: ShardingRules | None = None) -> ShardingRules:
+        """The rules table; empty config rules fall back to ``default``
+        (the task's live table — ``from_train_config`` embeds it, so the
+        fallback only fires for hand-written configs without rules)."""
+        if self.rules:
+            return rules_from_json(self.rules)
+        return default if default is not None else ShardingRules()
+
+    def build_mesh(self, *, devices=None):
+        """Construct the mesh, using the FIRST prod(shape) devices when
+        the process has more (a 2×2 config runs on an 8-device host; the
+        canonical CPU-mesh debug recipe in docs/sharding.md)."""
+        import jax
+
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        mc = self.mesh_config()
+        fixed = mc.fsdp * mc.model * mc.context * mc.pipe
+        data = mc.data
+        if data == -1:
+            if len(devices) % fixed:
+                raise ValueError(
+                    f"{len(devices)} devices not divisible by "
+                    f"fsdp*model*context*pipe={fixed}"
+                )
+            data = len(devices) // fixed
+        total = data * fixed
+        if total > len(devices):
+            raise ValueError(
+                f"sharding config mesh {dict(self.mesh)} needs {total} "
+                f"devices; only {len(devices)} available"
+            )
+        return create_mesh(
+            MeshConfig(data=data, fsdp=mc.fsdp, model=mc.model,
+                       context=mc.context, pipe=mc.pipe),
+            devices=devices[:total],
+        )
+
+    def batch_sharding(self, mesh):
+        """NamedSharding for a [global_batch, ...] activation (the core
+        helper over THIS config's batch axes)."""
+        from tensorflow_examples_tpu.core.sharding import batch_sharding
+
+        return batch_sharding(mesh, self.batch_axes)
+
+    def bundle_sharding(self, mesh):
+        """[k, global_batch, ...] step bundle: scan dim unsharded, batch
+        dim behind it sharded exactly as :meth:`batch_sharding`."""
+        from tensorflow_examples_tpu.core.sharding import bundle_sharding
+
+        return bundle_sharding(mesh, self.batch_axes)
+
+    def mesh_shape_dict(self, mesh=None) -> dict[str, int]:
+        """Axis -> size, resolved (no -1) — the telemetry payload. Pass
+        the live mesh when one exists; otherwise data=-1 resolves
+        against the process's device count."""
+        if mesh is not None:
+            return {a: int(mesh.shape[a]) for a in mesh.axis_names}
+        import jax
+
+        mc = self.mesh_config()
+        return dict(
+            zip(AxisNames.ALL, mc.resolve(jax.device_count()))
+            if mc.data == -1
+            else {
+                AxisNames.DATA: mc.data,
+                AxisNames.FSDP: mc.fsdp,
+                AxisNames.MODEL: mc.model,
+                AxisNames.CONTEXT: mc.context,
+                AxisNames.PIPE: mc.pipe,
+            }
+        )
+
+    # ----------------------------------------------------- serialization
+
+    def to_json_dict(self) -> dict:
+        return {
+            "mesh": {a: int(s) for a, s in self.mesh.items()},
+            "rules": [[p, list(s)] for p, s in self.rules],
+            "batch_axes": list(self.batch_axes),
+            "zero1": bool(self.zero1),
+        }
+
+    @classmethod
+    def from_json_dict(cls, obj: Mapping[str, Any]) -> "ShardingConfig":
+        if not isinstance(obj, Mapping):
+            raise ValueError(
+                f"sharding config must be a JSON object, got "
+                f"{type(obj).__name__}"
+            )
+        unknown = set(obj) - {"mesh", "rules", "batch_axes", "zero1"}
+        if unknown:
+            raise ValueError(
+                f"unknown sharding config keys {sorted(unknown)}"
+            )
+        return cls(
+            mesh=dict(obj.get("mesh", {"data": -1})),
+            rules=tuple(
+                (str(p), tuple(s)) for p, s in obj.get("rules", ())
+            ),
+            batch_axes=tuple(
+                obj.get("batch_axes", AxisNames.BATCH_AXES)
+            ),
+            zero1=bool(obj.get("zero1", False)),
+        )
+
+    def save(self, path: str, *, extra: Mapping | None = None) -> None:
+        """Atomic write of ``{"version", "config", **extra}`` — the
+        ``workdir/sharding.json`` the trainer persists next to its
+        checkpoints and the serving CLI auto-loads."""
+        doc = {
+            "version": SHARDING_JSON_VERSION,
+            "config": self.to_json_dict(),
+        }
+        if extra:
+            doc.update(extra)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "ShardingConfig":
+        config, _ = cls.load_with_extra(path)
+        return config
+
+    @classmethod
+    def load_with_extra(cls, path: str) -> tuple["ShardingConfig", dict]:
+        """Load a sharding.json; returns (config, sidecar-fields) where
+        the sidecar carries whatever ``save(extra=...)`` recorded (the
+        param digest, the mesh shape at save time)."""
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: not a JSON object")
+        if "config" in doc:
+            version = doc.get("version")
+            if version != SHARDING_JSON_VERSION:
+                raise ValueError(
+                    f"{path}: sharding.json version {version!r} "
+                    f"(this build reads {SHARDING_JSON_VERSION})"
+                )
+            extra = {
+                k: v for k, v in doc.items()
+                if k not in ("version", "config")
+            }
+            return cls.from_json_dict(doc["config"]), extra
+        # A bare config object (hand-written, no wrapper) also loads.
+        return cls.from_json_dict(doc), {}

@@ -271,3 +271,12 @@ class CompilationSentinel:
 
     def compile_counts(self) -> dict[str, int]:
         return {name: r.compiles for name, r in self._fns.items()}
+
+    def post_warmup_recompiles(self) -> int:
+        """Total compiles beyond each wrapped fn's warmup allowance —
+        the number CI asserts to be 0 in steady state (the serving
+        engine's zero-recompile contract, and the sharded-training
+        smoke in tests/test_sharding.py)."""
+        return sum(
+            max(0, r.compiles - self.warmup) for r in self._fns.values()
+        )

@@ -115,6 +115,10 @@ class Telemetry:
         self.last_fleet_line: dict | None = None
         self.server = None  # MetricsServer, attached by the trainer
         self.profile_info: dict | None = None
+        # Placement provenance (ISSUE 7, schema v5): set by the trainer
+        # ({"mesh_shape", "param_sharding_digest", "zero1"}); rides the
+        # kind="final" line so a run record names the layout it ran on.
+        self.sharding_info: dict | None = None
         # Observed duty cycle is PER FIT (set by this fit's profiler
         # window, never read from the process-global gauge: a later fit
         # in the same process must not inherit an earlier fit's
@@ -316,6 +320,8 @@ class Telemetry:
             line["exit_reason"] = exit_reason or "complete"
             if self.profile_info is not None:
                 line["profile"] = dict(self.profile_info)
+            if self.sharding_info is not None:
+                line["sharding"] = dict(self.sharding_info)
         # Memory watermark fields ride every cadenced/final line (the
         # kind="memory" init snapshot carries its own via ``extra``).
         # On the watchdog-fatal path only CACHED values are used: a

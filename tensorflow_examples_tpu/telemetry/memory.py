@@ -48,23 +48,47 @@ _OOM_PATTERNS = (
 )
 
 
-def tree_bytes(tree: Any) -> int:
-    """Total array bytes of a pytree (0 for empty/None leaves)."""
+def tree_bytes(tree: Any, *, per_device: bool = False) -> int:
+    """Total array bytes of a pytree (0 for empty/None leaves).
+
+    ``per_device=True`` counts each leaf's bytes ON ONE DEVICE — a
+    leaf sharded N ways contributes 1/N of its global bytes, a
+    replicated leaf its full size (the ZeRO-1 memory claim is stated
+    in this unit: per-device optimizer bytes scale down with the
+    replica count; ISSUE 7). Sharding is read from the leaf's
+    ``.sharding`` when present (concrete jax.Arrays and abstract
+    eval_shape trees carrying shardings alike); shardless leaves count
+    full size.
+    """
     import jax
 
     import numpy as np
 
     total = 0
     for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
         nbytes = getattr(leaf, "nbytes", None)
         if nbytes is None:
             # Abstract leaves (ShapeDtypeStruct) carry shape/dtype only.
-            shape = getattr(leaf, "shape", None)
             itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 0)
             if shape is None or not itemsize:
                 continue
             nbytes = int(np.prod(shape, dtype=np.int64)) * int(itemsize)
-        total += int(nbytes)
+        nbytes = int(nbytes)
+        if per_device and shape is not None:
+            sharding = getattr(leaf, "sharding", None)
+            shard_shape = getattr(sharding, "shard_shape", None)
+            if shard_shape is not None:
+                try:
+                    local = int(
+                        np.prod(shard_shape(tuple(shape)), dtype=np.int64)
+                    )
+                    size = int(np.prod(shape, dtype=np.int64))
+                    if size:
+                        nbytes = nbytes * local // size
+                except Exception:  # pragma: no cover - exotic shardings
+                    pass
+        total += nbytes
     return total
 
 

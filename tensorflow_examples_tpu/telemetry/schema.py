@@ -1,5 +1,5 @@
 """Self-describing JSONL metrics schema (ISSUE 2 CI satellite; v2 in
-ISSUE 3; v3 in ISSUE 4; v4 in ISSUE 5).
+ISSUE 3; v3 in ISSUE 4; v4 in ISSUE 5; v5 in ISSUE 7).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -71,6 +71,16 @@ Line shape (version 3; version-1/-2 lines remain valid input)::
         "kv_occupancy": 0.375, "post_warmup_recompiles": 0,
         "draining": 0
       }
+
+      # --- version 5 additions (sharding/; train/loop.py) ---
+      "sharding": {                  # OPTIONAL, kind == "final" only:
+                                     #   placement provenance
+        "mesh_shape": {"data": 2, "model": 4, ...},  # axis -> size
+        "param_sharding_digest": "1f2e3d...",  # sharding/resolve.py
+                                     #   digest: mesh-shape independent,
+                                     #   rule-table sensitive
+        "zero1": false               # optional bool
+      }
     }
 
 Version-1/-2 lines (the pre-ISSUE-3/-4 streams) carry none of the later
@@ -83,17 +93,19 @@ from __future__ import annotations
 import numbers
 from typing import Any
 
-SCHEMA_VERSION = 3
+# Version 5 (ISSUE 7): additive — training lines may carry a
+# "sharding" object on kind="final" (mesh shape + param-sharding
+# digest). SCHEMA_VERSION is what the trainer hub stamps.
+SCHEMA_VERSION = 5
 
-# Version 4 (ISSUE 5): the serving stack's request-side line. Training
-# lines stay v3 — SCHEMA_VERSION is what the trainer hub stamps;
+# Version 4 (ISSUE 5): the serving stack's request-side line —
 # serving/batcher.py stamps SERVING_SCHEMA_VERSION on its
 # ``kind="serving"`` stats lines (a v3-shaped line plus a required
 # "serving" object: active_requests / queue_depth / kv_occupancy /
 # post_warmup_recompiles / draining, all numeric).
 SERVING_SCHEMA_VERSION = 4
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -113,6 +125,13 @@ _V3_FIELDS = ("host", "fleet")
 
 # v4-only top-level objects, same rule for v1/v2/v3 lines.
 _V4_FIELDS = ("serving",)
+
+# v5-only top-level objects, forbidden on earlier versions.
+_V5_FIELDS = ("sharding",)
+
+# Required keys of a v5 sharding object (writer: train/loop.py via
+# telemetry/hub.py sharding_info).
+SHARDING_KEYS = ("mesh_shape", "param_sharding_digest")
 
 # Required keys of a v4 serving object (the writer is
 # serving/batcher.py stats_line; every one is numeric).
@@ -213,15 +232,13 @@ def validate_line(obj: Any) -> list[str]:
         problems.append("exit_reason on a non-final line")
 
     if version == 1:
-        for key in _V2_FIELDS:
-            if key in obj:
-                problems.append(f"v2 field {key!r} on a schema-v1 line")
-        for key in _V3_FIELDS:
-            if key in obj:
-                problems.append(f"v3 field {key!r} on a schema-v1 line")
-        for key in _V4_FIELDS:
-            if key in obj:
-                problems.append(f"v4 field {key!r} on a schema-v1 line")
+        for fields, v in ((_V2_FIELDS, 2), (_V3_FIELDS, 3),
+                          (_V4_FIELDS, 4), (_V5_FIELDS, 5)):
+            for key in fields:
+                if key in obj:
+                    problems.append(
+                        f"v{v} field {key!r} on a schema-v1 line"
+                    )
         return problems
 
     # ------------------------------------------------- v2 additions
@@ -278,12 +295,13 @@ def validate_line(obj: Any) -> list[str]:
                     )
 
     if version == 2:
-        for key in _V3_FIELDS:
-            if key in obj:
-                problems.append(f"v3 field {key!r} on a schema-v2 line")
-        for key in _V4_FIELDS:
-            if key in obj:
-                problems.append(f"v4 field {key!r} on a schema-v2 line")
+        for fields, v in ((_V3_FIELDS, 3), (_V4_FIELDS, 4),
+                          (_V5_FIELDS, 5)):
+            for key in fields:
+                if key in obj:
+                    problems.append(
+                        f"v{v} field {key!r} on a schema-v2 line"
+                    )
         return problems
 
     # ------------------------------------------------- v3 additions
@@ -358,6 +376,8 @@ def validate_line(obj: Any) -> list[str]:
     if version == 3:
         if "serving" in obj:
             problems.append("v4 field 'serving' on a schema-v3 line")
+        if "sharding" in obj:
+            problems.append("v5 field 'sharding' on a schema-v3 line")
         return problems
 
     # ------------------------------------------------- v4 additions
@@ -373,6 +393,53 @@ def validate_line(obj: Any) -> list[str]:
                     )
     elif "serving" in obj:
         problems.append("serving object on a non-serving line")
+
+    if version == 4:
+        if "sharding" in obj:
+            problems.append("v5 field 'sharding' on a schema-v4 line")
+        return problems
+
+    # ------------------------------------------------- v5 additions
+    if "sharding" in obj:
+        if obj["kind"] != "final":
+            problems.append("sharding object on a non-final line")
+        elif not isinstance(obj["sharding"], dict):
+            problems.append("sharding is not an object")
+        else:
+            sh = obj["sharding"]
+            for key in SHARDING_KEYS:
+                if key not in sh:
+                    problems.append(
+                        f"sharding object is missing required key {key!r}"
+                    )
+            mesh = sh.get("mesh_shape")
+            if mesh is not None:
+                if not isinstance(mesh, dict) or not mesh:
+                    problems.append(
+                        "sharding['mesh_shape'] is not a non-empty object"
+                    )
+                else:
+                    for axis, size in mesh.items():
+                        if (
+                            not isinstance(axis, str)
+                            or not isinstance(size, int)
+                            or isinstance(size, bool)
+                            or size < 1
+                        ):
+                            problems.append(
+                                f"sharding['mesh_shape'][{axis!r}] = "
+                                f"{size!r} is not a positive int"
+                            )
+            digest = sh.get("param_sharding_digest")
+            if digest is not None and not isinstance(digest, str):
+                problems.append(
+                    f"sharding['param_sharding_digest'] = {digest!r} is "
+                    "not a string"
+                )
+            if "zero1" in sh and not isinstance(sh["zero1"], bool):
+                problems.append(
+                    f"sharding['zero1'] = {sh['zero1']!r} is not a bool"
+                )
     return problems
 
 

@@ -67,12 +67,20 @@ class CheckpointManager:
     def restore_latest(
         self, state: Any, *, validate: bool = True
     ) -> tuple[Any, int] | None:
-        """Restore into ``state``'s structure/shardings; None if no ckpt."""
+        """Restore into ``state``'s structure/shardings; None if no ckpt.
+
+        Abstract template leaves (``jax.eval_shape`` ShapeDtypeStructs,
+        the restore-only consumers' path — sampling/serving CLIs) carry
+        no sharding; orbax refuses them for checkpoints that were SAVED
+        sharded (docs/sharding.md). Such leaves get a default
+        single-device placement here, so any checkpoint — written on
+        any mesh — restores through a shardings-free template onto the
+        local default device (resharding on restore is the contract)."""
         step = self._mngr.latest_step()
         if step is None:
             return None
         with _trace_span("checkpoint_restore", step=step):
-            target = _as_dict(state)
+            target = _with_default_shardings(_as_dict(state))
             if validate:
                 self._validate_structure(step, target)
             restored = self._mngr.restore(
@@ -162,6 +170,32 @@ class CheckpointManager:
     def close(self) -> None:
         self._mngr.wait_until_finished()
         self._mngr.close()
+
+
+def _with_default_shardings(tree: Any) -> Any:
+    """Give sharding-less abstract leaves a concrete single-device
+    placement (concrete arrays and sharding-carrying structs pass
+    through untouched)."""
+    import jax
+
+    default = None
+
+    def one(leaf):
+        nonlocal default
+        if (
+            isinstance(leaf, jax.ShapeDtypeStruct)
+            and getattr(leaf, "sharding", None) is None
+        ):
+            if default is None:
+                default = jax.sharding.SingleDeviceSharding(
+                    jax.local_devices()[0]
+                )
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=default
+            )
+        return leaf
+
+    return jax.tree.map(one, tree)
 
 
 def _as_dict(state: Any) -> dict:

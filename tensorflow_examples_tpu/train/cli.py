@@ -49,9 +49,22 @@ def _setup(workload, default_cfg):
 def _build_trainer(workload, cfg):
     """Create the mesh once and hand it to both the task and the Trainer
     (models that pin activation shardings or run shard_map'd attention
-    need the concrete mesh at trace time)."""
-    mesh = create_mesh(cfg.mesh_config())
-    return Trainer(workload.make_task(cfg, mesh=mesh), cfg, mesh=mesh)
+    need the concrete mesh at trace time). With ``--sharding_config``
+    the mesh comes from the config file (docs/sharding.md) — the one
+    spec that also drives serving — and the Trainer inherits its rules
+    and ZeRO-1 policy too."""
+    sharding = None
+    if getattr(cfg, "sharding_config", ""):
+        from tensorflow_examples_tpu.sharding import ShardingConfig
+
+        sharding = ShardingConfig.load(cfg.sharding_config)
+        mesh = sharding.build_mesh()
+    else:
+        mesh = create_mesh(cfg.mesh_config())
+    return Trainer(
+        workload.make_task(cfg, mesh=mesh), cfg, mesh=mesh,
+        sharding=sharding,
+    )
 
 
 def _host_eval_batches(test_ds, eval_bs):

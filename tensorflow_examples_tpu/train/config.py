@@ -40,6 +40,12 @@ class TrainConfig:
     remat: bool = False  # jax.checkpoint the model apply
     zero1: bool = False  # shard optimizer state over the batch axes even
     #   for replicated params (ZeRO-1 / weight-update sharding)
+    sharding_config: str = ""  # path to a ShardingConfig JSON
+    #   (tensorflow_examples_tpu/sharding/; docs/sharding.md): when set,
+    #   it is the single source of truth for mesh shape, param rules,
+    #   batch axes, and ZeRO-1 — the mesh_*/zero1 knobs above are
+    #   ignored. Training persists the active config (from whichever
+    #   source) to workdir/sharding.json; serving auto-loads it.
 
     # Loop cadence
     log_every: int = 100

@@ -45,7 +45,9 @@ slowest-host/skew attribution (``straggler_skew_factor``); with
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import os
 import sys
 import time
 from typing import Any, Callable, Iterable, Iterator, Mapping
@@ -56,14 +58,13 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
 from tensorflow_examples_tpu.core.precision import PrecisionPolicy
 from tensorflow_examples_tpu.core.rng import step_rng
-from tensorflow_examples_tpu.core.sharding import (
-    _path_str,
-    batch_sharding,
-    bundle_sharding,
-    shardings_for_params,
+from tensorflow_examples_tpu.sharding import (
+    ShardingConfig,
+    ShardingMismatchError,
+    resolve_params,
+    state_shardings,
 )
 from tensorflow_examples_tpu.data.prefetch import (
     bundle_batches,
@@ -102,14 +103,54 @@ def state_factory(task: Task, config: TrainConfig):
 
 
 class Trainer:
-    """Runs a Task under a TrainConfig on a device mesh."""
+    """Runs a Task under a TrainConfig on a device mesh.
 
-    def __init__(self, task: Task, config: TrainConfig, *, mesh=None):
+    Placement (ISSUE 7): one :class:`ShardingConfig` is the source of
+    truth — pass one explicitly, point ``cfg.sharding_config`` at a
+    JSON file, or let the trainer derive it from the legacy
+    ``mesh_*``/``zero1`` knobs + the task's rules table. The mesh, the
+    param/optimizer/batch shardings, and ZeRO-1 all resolve from it;
+    ``fit`` persists it to ``workdir/sharding.json`` (so serving and a
+    resumed run consume the SAME spec) and refuses a resume whose rules
+    digest drifted (:class:`sharding.ShardingMismatchError`). Mesh
+    SHAPE may differ on resume — checkpoints reshard bitwise.
+    """
+
+    def __init__(
+        self,
+        task: Task,
+        config: TrainConfig,
+        *,
+        mesh=None,
+        sharding: ShardingConfig | None = None,
+    ):
         self.task = task
         self.config = config
-        self.mesh = mesh if mesh is not None else create_mesh(config.mesh_config())
+        if sharding is None:
+            path = getattr(config, "sharding_config", "")
+            sharding = (
+                ShardingConfig.load(path)
+                if path
+                else ShardingConfig.from_train_config(
+                    config, rules=task.sharding_rules
+                )
+            )
+        self.sharding = sharding
+        self.mesh = mesh if mesh is not None else sharding.build_mesh()
+        if mesh is not None:
+            # Snapshot the explicit mesh's shape back into the config so
+            # sharding.json / telemetry report what actually ran.
+            self.sharding = dataclasses.replace(
+                self.sharding,
+                mesh={a: int(mesh.shape[a]) for a in mesh.axis_names},
+            )
+        # Rules resolve through the config (empty config rules inherit
+        # the task's live table — the from_train_config path embeds it).
+        self._rules = self.sharding.sharding_rules(
+            default=task.sharding_rules
+        )
         self.policy = PrecisionPolicy.create(config.precision)
-        self._batch_sharding = batch_sharding(self.mesh)
+        self._batch_sharding = self.sharding.batch_sharding(self.mesh)
         self._ckpt: CheckpointManager | None = None
         self._telemetry: Telemetry | None = None  # built per fit()
         self._guard: resilience.BadStepGuard | None = None
@@ -122,6 +163,14 @@ class Trainer:
             config
         )
         self.state = self._init_state()
+        # The resolved param placement (sharding/resolve.py): drives the
+        # sharding.json persisted next to checkpoints, the restore-time
+        # rules-digest check, and the telemetry final-line digest.
+        self._resolution = resolve_params(
+            jax.eval_shape(lambda s: s, self.state).params,
+            self.mesh,
+            self._rules,
+        )
         self._train_step = self.sentinel.wrap(
             self._build_train_step(), "train_step"
         )
@@ -129,6 +178,96 @@ class Trainer:
         self._eval_step = self.sentinel.wrap(
             self._build_eval_step(), "eval_step"
         )
+
+    def sharding_digest(self) -> str:
+        """Stable hash of the param → PartitionSpec table (mesh-shape
+        independent: reshardable layouts compare equal, rule drift
+        doesn't). Published on the final telemetry line and persisted
+        in ``workdir/sharding.json``."""
+        return self._resolution.digest()
+
+    def _sync_sharding_json(self, workdir: str) -> None:
+        """Validate against (then refresh) ``workdir/sharding.json``.
+
+        A pre-existing file whose param digest differs from the live
+        resolution means the rules table drifted since the checkpoints
+        were written — restoring under different placement rules is a
+        config error, named per-path, NOT a reshard (mesh-shape changes
+        hash identically and restore fine)."""
+        path = os.path.join(workdir, "sharding.json")
+        if os.path.exists(path):
+            try:
+                saved_cfg, extra = ShardingConfig.load_with_extra(path)
+            except (ValueError, OSError) as e:
+                raise ShardingMismatchError(
+                    f"unreadable sharding config at {path}: {e} — move it "
+                    "aside if the workdir is being repurposed"
+                ) from e
+            saved_digest = extra.get("param_sharding_digest")
+            live = self._resolution
+            if saved_digest and saved_digest != live.digest():
+                from tensorflow_examples_tpu.core.sharding import (
+                    ShardingRules,
+                )
+
+                theirs = resolve_params(
+                    jax.eval_shape(lambda s: s, self.state).params,
+                    self.mesh,
+                    saved_cfg.sharding_rules(default=ShardingRules()),
+                ).spec_by_path()
+                mine = live.spec_by_path()
+                drifted = [
+                    p
+                    for p in sorted(set(mine) | set(theirs))
+                    if mine.get(p) != theirs.get(p)
+                ]
+                shown = "\n  ".join(
+                    f"{p}: saved {theirs.get(p)} vs live {mine.get(p)}"
+                    for p in drifted[:10]
+                ) or "(digest drift outside the resolvable param table)"
+                more = (
+                    f"\n  ... and {len(drifted) - 10} more"
+                    if len(drifted) > 10
+                    else ""
+                )
+                raise ShardingMismatchError(
+                    f"sharding rules drifted vs {path} (saved digest "
+                    f"{saved_digest}, live {live.digest()}): checkpoints "
+                    "in this workdir were written under different "
+                    "placement rules. Mesh-shape changes reshard fine; "
+                    "rule changes need a fresh workdir (or delete "
+                    f"sharding.json deliberately).\n  {shown}{more}"
+                )
+        if jax.process_index() == 0:
+            try:
+                from tensorflow_examples_tpu.sharding.config import (
+                    rules_to_json,
+                )
+
+                # Persist the RESOLVED rules: a config that inherited
+                # the task's live table writes it out, so the file is
+                # self-contained for serving and for restore diffs.
+                to_save = (
+                    self.sharding
+                    if self.sharding.rules
+                    else dataclasses.replace(
+                        self.sharding, rules=rules_to_json(self._rules)
+                    )
+                )
+                to_save.save(
+                    path,
+                    extra={
+                        "param_sharding_digest": self._resolution.digest(),
+                        "mesh_shape": self.sharding.mesh_shape_dict(
+                            self.mesh
+                        ),
+                    },
+                )
+            except OSError:
+                # Metadata write — never kill a training job over it.
+                log.warning(
+                    "could not persist %s (continuing)", path, exc_info=True
+                )
 
     # ------------------------------------------------------------- init
 
@@ -155,80 +294,15 @@ class Trainer:
         return state
 
     def _state_shardings(self, abstract_state) -> Any:
-        rules = self.task.sharding_rules
-        param_sh = shardings_for_params(abstract_state.params, self.mesh, rules)
-        replicated = NamedSharding(self.mesh, P())
-
-        # Optimizer moments (adam mu/nu, momentum traces, …) embed the param
-        # tree, so an opt-state leaf's key path ends with its param's path;
-        # match the longest such suffix (with equal shape) and inherit that
-        # param's sharding. Everything else (counts, scalars) replicates.
-        param_map: dict[str, tuple] = {}
-
-        def record(path, leaf, sh):
-            param_map[_path_str(path)] = (leaf.shape, sh)
-            return sh
-
-        jax.tree_util.tree_map_with_path(record, abstract_state.params, param_sh)
-
-        # ZeRO-1 (--zero1): shard optimizer moments over the batch axes
-        # even where the PARAM stays replicated (pure DP) — the
-        # weight-update sharding of arXiv:2004.13336. XLA then emits
-        # reduce-scatter(grads) → sharded moment update → all-gather of
-        # the applied update instead of replicating Adam state per chip.
-        from tensorflow_examples_tpu.core.mesh import AxisNames
-
-        batch_axes = tuple(
-            a for a in AxisNames.BATCH_AXES if self.mesh.shape[a] > 1
-        )
-        n_batch = int(np.prod([self.mesh.shape[a] for a in batch_axes] or [1]))
-        zero1 = getattr(self.config, "zero1", False) and n_batch > 1
-        z1_stats = {"sharded": 0, "total": 0}
-
-        def _zero1_spec(shape) -> NamedSharding | None:
-            """Shard the largest evenly-divisible dim over the batch axes
-            (dim 0 is often tiny — e.g. conv kernel height)."""
-            best = max(
-                (d for d in range(len(shape)) if shape[d] % n_batch == 0),
-                key=lambda d: shape[d],
-                default=None,
-            )
-            if best is None or shape[best] < n_batch:
-                return None
-            spec = [None] * len(shape)
-            spec[best] = batch_axes
-            return NamedSharding(self.mesh, P(*spec))
-
-        def opt_sharding(path, leaf):
-            parts = _path_str(path).split("/")
-            for i in range(len(parts)):
-                entry = param_map.get("/".join(parts[i:]))
-                if entry is not None and getattr(leaf, "shape", None) == entry[0]:
-                    shape, sh = entry
-                    # Replicated == every spec entry None (P() and its
-                    # filtered P(None, ...) forms compare unequal).
-                    if zero1 and all(a is None for a in sh.spec) and shape:
-                        z1_stats["total"] += int(np.prod(shape))
-                        z1 = _zero1_spec(shape)
-                        if z1 is not None:
-                            z1_stats["sharded"] += int(np.prod(shape))
-                            return z1
-                    return sh
-            return replicated
-
-        opt_sh = jax.tree_util.tree_map_with_path(
-            opt_sharding, abstract_state.opt_state
-        )
-        # Non-trainable collections (BN stats, …) follow the same path rules
-        # (unmatched → replicated, the common case for norm statistics).
-        model_state_sh = shardings_for_params(
-            abstract_state.model_state, self.mesh, rules
-        )
-        return abstract_state.replace(
-            step=replicated,
-            params=param_sh,
-            opt_state=opt_sh,
-            model_state=model_state_sh,
+        # Resolution lives in sharding/resolve.py (ISSUE 7): params by
+        # the config's rules, optimizer moments inheriting their param's
+        # sharding, ZeRO-1 escalation for replicated params' moments.
+        return state_shardings(
+            abstract_state,
+            self.mesh,
+            self._rules,
+            zero1=self.sharding.zero1,
+            batch_axes=self.sharding.batch_axes,
         )
 
     # ------------------------------------------------------------- steps
@@ -332,7 +406,7 @@ class Trainer:
         step = self.sentinel.wrap(
             jax.jit(
                 bundled,
-                in_shardings=(state_sh, bundle_sharding(self.mesh)),
+                in_shardings=(state_sh, self.sharding.bundle_sharding(self.mesh)),
                 out_shardings=(state_sh, NamedSharding(self.mesh, P())),
                 donate_argnums=(0,),
             ),
@@ -416,6 +490,14 @@ class Trainer:
         # thread/handler exists); one object per fit — sinks may be
         # workdir-backed and multiple fits on one Trainer are legal.
         telemetry = Telemetry.from_config(cfg, n_params=self._n_params)
+        # Placement provenance on the kind="final" line (ISSUE 7
+        # satellite, schema v5): which mesh this run actually used and
+        # the param-sharding digest a reader can diff across runs.
+        telemetry.sharding_info = {
+            "mesh_shape": self.sharding.mesh_shape_dict(self.mesh),
+            "param_sharding_digest": self._resolution.digest(),
+            "zero1": bool(self.sharding.zero1),
+        }
         self._telemetry = telemetry
         # Post-warmup recompiles now land as JSONL warning lines.
         self.sentinel.bind(telemetry)
@@ -474,6 +556,10 @@ class Trainer:
 
             if cfg.workdir:
                 self._ckpt = CheckpointManager(cfg.workdir)
+                # Rules-digest check BEFORE any restore (a checkpoint
+                # must never load under drifted placement rules), then
+                # persist the live config for serving/resume consumers.
+                self._sync_sharding_json(cfg.workdir)
                 if cfg.resume:
                     restored = self._ckpt.restore_latest(self.state)
                     if restored is not None:
@@ -525,7 +611,7 @@ class Trainer:
                     src if k == 1 else bundle_batches(src, k),
                     self._batch_sharding
                     if k == 1
-                    else bundle_sharding(self.mesh),
+                    else self.sharding.bundle_sharding(self.mesh),
                     local_batches=local_batches and jax.process_count() > 1,
                     max_skips=cfg.max_skipped_batches,
                     depth=max(

@@ -47,15 +47,21 @@ class TrainState:
             step=self.step + 1, params=new_params, opt_state=new_opt_state
         )
 
-    def byte_breakdown(self) -> dict[str, int]:
+    def byte_breakdown(self, *, per_device: bool = False) -> dict[str, int]:
         """Array bytes per state component — the memory-accounting
         attribution (telemetry/memory.py): params vs. optimizer moments
         vs. non-trainable collections. Works on concrete and abstract
-        (eval_shape) trees alike, since both carry size/dtype."""
+        (eval_shape) trees alike, since both carry size/dtype.
+
+        ``per_device=True`` counts one device's share of each sharded
+        leaf instead of global bytes — the unit ZeRO-1's optimizer
+        memory claim is measured in (docs/sharding.md)."""
         from tensorflow_examples_tpu.telemetry.memory import tree_bytes
 
         return {
-            "params": tree_bytes(self.params),
-            "opt_state": tree_bytes(self.opt_state),
-            "model_state": tree_bytes(self.model_state),
+            "params": tree_bytes(self.params, per_device=per_device),
+            "opt_state": tree_bytes(self.opt_state, per_device=per_device),
+            "model_state": tree_bytes(
+                self.model_state, per_device=per_device
+            ),
         }

@@ -192,7 +192,7 @@ class TestCollectivesFacade:
             }
 
         out = jax.jit(
-            jax.shard_map(
+            coll.shard_map(
                 f,
                 mesh=mesh,
                 in_specs=P("x"),

@@ -548,7 +548,9 @@ class TestSmokeRun:
         bytes to params vs. optimizer."""
         wd, _, _, _ = smoke_run
         lines = self._lines(wd)
-        assert all(l["schema_version"] == 3 for l in lines)
+        assert all(
+            l["schema_version"] == schema.SCHEMA_VERSION for l in lines
+        )
         mems = [l for l in lines if l["kind"] == "memory"]
         assert len(mems) == 1  # the fit-start snapshot
         bd = mems[0]["memory"]

@@ -57,9 +57,14 @@ DEFAULT_THRESHOLD = 0.10
 FINGERPRINT_COMPARABLE_FACTOR = 2.0
 
 # Telemetry-record gate keys: direction of the stamped bound.
+# sharded_step_time (ISSUE 7): a model-parallel run's step-time p50
+# under its own key — telemetry_report emits it only when the final
+# line's mesh_shape has a nontrivial non-data axis, so a sharded
+# layout gates against a sharded floor, never the 1-device one.
 RECORD_KEYS: dict[str, str] = {
     "step_time_p50": "max",
     "step_time_p95": "max",
+    "sharded_step_time": "max",
     "peak_live_bytes": "max",
     "mfu": "min",
     "goodput": "min",

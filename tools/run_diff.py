@@ -65,6 +65,7 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
 GATE_KEYS = (
     "step_time_p50",
     "step_time_p95",
+    "sharded_step_time",
     "peak_live_bytes",
     "mfu",
     "goodput",
@@ -202,6 +203,21 @@ def render(a_arg: str, b_arg: str, a: dict, b: dict,
             f"{label}: {arg} (steps {rec.get('first_step')}.."
             f"{rec.get('last_step')}, {rec.get('windows')} window(s), "
             f"ended: {rec.get('exit_reason') or 'UNKNOWN'})"
+        )
+    # Placement provenance (schema v5, docs/sharding.md): two runs on
+    # different meshes or under different rules are apples-to-oranges —
+    # say so FIRST, because "regression" is usually the layout.
+    mesh_a, mesh_b = a.get("mesh_shape"), b.get("mesh_shape")
+    dig_a = a.get("param_sharding_digest")
+    dig_b = b.get("param_sharding_digest")
+    if mesh_a is not None and mesh_b is not None and mesh_a != mesh_b:
+        out.append(
+            f"NOTE: mesh shape changed between runs: {mesh_a} -> {mesh_b}"
+        )
+    if dig_a is not None and dig_b is not None and dig_a != dig_b:
+        out.append(
+            "NOTE: param-sharding rules changed between runs "
+            f"(digest {dig_a} -> {dig_b})"
         )
     regressed = [d for d in deltas if d["verdict"] == "regressed"]
     improved = [d for d in deltas if d["verdict"] == "improved"]

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Visualize a ShardingConfig against a model BEFORE running it.
+
+Resolves every param of a workload's model through the sharding rules
+and prints the param → PartitionSpec table with per-device byte totals
+(replicated vs sharded), the placement digest, and — when the config
+enables ZeRO-1 — the optimizer-state per-device bytes next to the
+replicated baseline. A bad rule (a regex that matches nothing, a giant
+table left replicated) is visible here, not ten minutes into a run.
+
+    # The config a training run persisted:
+    python tools/shard_viz.py --config /run/workdir/sharding.json --workload gpt2
+
+    # An ad-hoc layout over the full GPT-2 124M table:
+    python tools/shard_viz.py --mesh data=2,model=4 --workload gpt2 --zero1
+
+    # Tiny model override (any workload-config field):
+    python tools/shard_viz.py --mesh data=2,model=2 --workload gpt2 \
+        --set num_layers=2 --set d_model=64 --set vocab_size=256
+
+Runs fine on CPU: the model is never materialized (``jax.eval_shape``
+templates only). The param table and digest resolve even when the
+config's mesh exceeds the host's device count (a pod config on a
+laptop) — only the optimizer-state per-device summary needs the real
+mesh, and degrades to a note when it can't be built.
+
+JSON output (``--json``) mirrors the table for scripting:
+``{"mesh_shape", "digest", "rows": [...], "totals", "opt_state"}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKLOADS = ("gpt2", "mnist", "cifar10", "imagenet", "bert_glue")
+
+
+def parse_mesh(text: str) -> dict[str, int]:
+    """'data=2,model=4' -> {'data': 2, 'model': 4}."""
+    out: dict[str, int] = {}
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        if "=" not in part:
+            raise ValueError(f"mesh entry {part!r} is not axis=size")
+        axis, size = part.split("=", 1)
+        out[axis.strip()] = int(size)
+    return out
+
+
+def build_workload_config(name: str, overrides: list[str]):
+    import importlib
+
+    mod = importlib.import_module(
+        f"tensorflow_examples_tpu.workloads.{name}"
+    )
+    cfg_cls = next(
+        getattr(mod, a)
+        for a in dir(mod)
+        if a.endswith("Config") and dataclasses.is_dataclass(getattr(mod, a))
+    )
+    cfg = cfg_cls()
+    fields = {f.name: f for f in dataclasses.fields(cfg)}
+    updates = {}
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"--set {item!r} is not field=value")
+        key, value = item.split("=", 1)
+        if key not in fields:
+            raise ValueError(
+                f"--set {key}: no such field on {cfg_cls.__name__}"
+            )
+        current = getattr(cfg, key)
+        if isinstance(current, bool):
+            updates[key] = value.lower() in ("1", "true", "yes")
+        elif isinstance(current, int):
+            updates[key] = int(value)
+        elif isinstance(current, float):
+            updates[key] = float(value)
+        else:
+            updates[key] = value
+    return mod, dataclasses.replace(cfg, **updates)
+
+
+class _ShapeOnlyMesh:
+    """Shape stand-in for a mesh the host cannot build (a pod-sized
+    config inspected on a laptop): enough surface for rule resolution
+    and byte math (``mesh.shape[axis]``), but it cannot back real
+    NamedShardings — the optimizer-state summary is skipped with it."""
+
+    def __init__(self, shape: dict[str, int]):
+        self.shape = dict(shape)
+        self.axis_names = tuple(shape)
+
+
+def resolve(config, workload: str, overrides: list[str]):
+    """(ShardingConfig, workload) -> (ResolvedSharding, abstract state,
+    state shardings | None). Everything abstract — no arrays
+    materialize. When the config's mesh exceeds the host's devices the
+    param table/digest still resolve (against a shape-only mesh);
+    ``shardings`` comes back None and the opt-state summary is
+    skipped."""
+    import jax
+
+    from tensorflow_examples_tpu.sharding import resolve_params
+    from tensorflow_examples_tpu.sharding.resolve import state_shardings
+    from tensorflow_examples_tpu.train.loop import state_factory
+
+    mod, cfg = build_workload_config(workload, overrides)
+    try:
+        mesh = config.build_mesh()
+    except ValueError as e:
+        print(f"note: {e}; resolving against the shape only "
+              "(opt-state summary skipped)", file=sys.stderr)
+        mesh = None
+    task = mod.make_task(cfg, mesh=mesh)
+    rules = config.sharding_rules(default=task.sharding_rules)
+    make_state, _ = state_factory(task, cfg)
+    abstract = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+    if mesh is None:
+        try:
+            shape = config.mesh_shape_dict()
+        except ValueError as e:
+            # data=-1 that doesn't divide this host either: there is no
+            # resolvable shape at all — clean error, not a traceback.
+            raise SystemExit(f"shard_viz: {e}") from e
+        return (
+            resolve_params(abstract.params, _ShapeOnlyMesh(shape), rules),
+            abstract,
+            None,
+        )
+    resolved = resolve_params(abstract.params, mesh, rules)
+    shardings = state_shardings(
+        abstract, mesh, rules,
+        zero1=config.zero1, batch_axes=config.batch_axes,
+    )
+    return resolved, abstract, shardings
+
+
+def sharded_tree_bytes(abstract_tree, shardings_tree) -> int:
+    """Per-device bytes of an abstract tree under a shardings tree —
+    the shardings are attached to the template leaves and the ONE
+    per-device byte implementation (telemetry/memory.tree_bytes, the
+    same math TrainState.byte_breakdown pins) does the accounting."""
+    import jax
+
+    from tensorflow_examples_tpu.telemetry.memory import tree_bytes
+
+    placed = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        abstract_tree,
+        shardings_tree,
+    )
+    return tree_bytes(placed, per_device=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "--config", help="ShardingConfig JSON (e.g. workdir/sharding.json)"
+    )
+    ap.add_argument(
+        "--mesh", default="",
+        help="ad-hoc mesh instead of --config: 'data=2,model=4'",
+    )
+    ap.add_argument(
+        "--zero1", action="store_true",
+        help="with --mesh: enable ZeRO-1 in the ad-hoc config",
+    )
+    ap.add_argument(
+        "--workload", default="gpt2", choices=WORKLOADS,
+        help="model template whose params the rules resolve against",
+    )
+    ap.add_argument(
+        "--set", action="append", default=[], metavar="FIELD=VALUE",
+        help="workload-config override (repeatable), e.g. num_layers=2",
+    )
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    if bool(args.config) == bool(args.mesh):
+        ap.error("exactly one of --config / --mesh is required")
+
+    from tensorflow_examples_tpu.sharding import ShardingConfig
+
+    if args.config:
+        config = ShardingConfig.load(args.config)
+    else:
+        config = ShardingConfig(
+            mesh=parse_mesh(args.mesh), zero1=args.zero1
+        )
+
+    resolved, abstract, shardings = resolve(
+        config, args.workload, args.set
+    )
+    mesh_shape = {
+        a: int(resolved.mesh.shape[a]) for a in resolved.mesh.axis_names
+    }
+    opt_per_device = (
+        sharded_tree_bytes(abstract.opt_state, shardings.opt_state)
+        if shardings is not None
+        else None
+    )
+    from tensorflow_examples_tpu.telemetry.memory import tree_bytes
+
+    opt_global = tree_bytes(abstract.opt_state)
+
+    if args.json:
+        doc = {
+            "mesh_shape": mesh_shape,
+            "zero1": bool(config.zero1),
+            "param_sharding_digest": resolved.digest(),
+            "rows": [
+                {
+                    "path": r.path,
+                    "spec": list(r.spec),
+                    "shape": list(r.shape),
+                    "replicated": r.replicated,
+                    "global_bytes": r.global_bytes,
+                    "per_device_bytes": r.per_device_bytes,
+                }
+                for r in resolved.rows
+            ],
+            "totals": resolved.byte_totals(),
+            "opt_state": {
+                "global_bytes": opt_global,
+                "per_device_bytes": opt_per_device,
+            },
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+
+    print(f"mesh: {mesh_shape}  zero1: {config.zero1}")
+    print(f"param sharding digest: {resolved.digest()}")
+    print()
+    print(resolved.table_str())
+    print()
+    if opt_per_device is None:
+        print(
+            f"optimizer state: {opt_global:,} B global (per-device "
+            "summary needs the mesh's device count locally — force a "
+            "CPU mesh, docs/sharding.md)"
+        )
+    else:
+        print(
+            f"optimizer state: {opt_global:,} B global, "
+            f"{opt_per_device:,} B/device"
+            + (
+                f" ({opt_global / max(opt_per_device, 1):.1f}x reduction)"
+                if config.zero1
+                else " (replicated; --zero1 shards it over the batch axes)"
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -197,6 +197,26 @@ def summarize(lines: list[dict], trace: dict | None) -> dict:
     record["profile"] = next(
         (l["profile"] for l in reversed(finals) if "profile" in l), None
     )
+    # ----- schema-v5 sharding provenance (None/absent on older runs) --
+    sharding = next(
+        (l["sharding"] for l in reversed(finals) if "sharding" in l), None
+    )
+    record["sharding"] = sharding
+    record["mesh_shape"] = (sharding or {}).get("mesh_shape")
+    record["param_sharding_digest"] = (sharding or {}).get(
+        "param_sharding_digest"
+    )
+    # A model-parallel run's step time under its own gate key: the
+    # bench_gate `sharded_step_time` record kind (a sharded layout's
+    # step time is not comparable to the 1-device floor, so it gets its
+    # own stamped bound).
+    mesh_shape = record["mesh_shape"] or {}
+    nontrivial = any(
+        a != "data" and int(s) > 1 for a, s in mesh_shape.items()
+    )
+    record["sharded_step_time"] = (
+        record["step_time_p50"] if nontrivial else None
+    )
     # ----- schema-v3 fleet fields (None/absent on v1/v2 runs) -----
     fleet_lines = [l for l in lines if l["kind"] == "fleet"]
     record["fleet"] = fleet_lines[-1]["fleet"] if fleet_lines else None
@@ -437,6 +457,25 @@ def render(record: dict, skipped: int) -> str:
             f"run-relative step {prof.get('start_step')} in "
             f"{_fmt(prof.get('wall_secs'), 's')} -> {prof.get('dir')}"
         )
+    # ----- schema-v5 sharding provenance (omitted for older runs) -----
+    sharding = record.get("sharding")
+    if sharding:
+        mesh_shape = sharding.get("mesh_shape") or {}
+        shape = "x".join(
+            f"{a}={s}" for a, s in mesh_shape.items() if int(s) > 1
+        ) or "1 device"
+        line = (
+            f"sharding: mesh {shape}, digest "
+            f"{sharding.get('param_sharding_digest')}"
+        )
+        if sharding.get("zero1"):
+            line += ", ZeRO-1 optimizer sharding"
+        if record.get("sharded_step_time") is not None:
+            line += (
+                "; sharded_step_time "
+                f"{_fmt(record['sharded_step_time'] * 1e3, 'ms')}"
+            )
+        out.append(line)
     # ----- schema-v3 fleet sections (omitted for v1/v2 runs) -----
     hosts = record.get("hosts")
     if hosts:

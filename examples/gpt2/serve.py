@@ -50,6 +50,22 @@ flags.DEFINE_float("serve_watchdog_secs", 60.0,
                    "serve-loop hang detection (0 disables)")
 flags.DEFINE_float("stats_every", 10.0,
                    "seconds between serving.jsonl stats lines (0 disables)")
+flags.DEFINE_integer(
+    "kv_block_size", 0,
+    "paged KV block size (docs/serving.md; 0 = dense pool). Power of "
+    "two dividing the bucket floors and max_len; slot capacity then "
+    "scales with used tokens and shared prompt prefixes prefill once.")
+flags.DEFINE_integer(
+    "kv_blocks", 0,
+    "physical KV blocks (0 = dense-equivalent worst case); shrink to "
+    "bank the memory paging saves — exhaustion sheds load loudly (503)")
+flags.DEFINE_string(
+    "kv_dtype", "",
+    "KV cache storage dtype: '' (cache dtype) or 'int8' (per-block "
+    "scales; bounded-divergence mode — requires --kv_block_size)")
+flags.DEFINE_boolean(
+    "prefix_cache", True,
+    "reuse immutable full prompt blocks across requests (paged only)")
 flags.DEFINE_string("vocab_dir", "", "dir with vocab.json+merges.txt")
 flags.DEFINE_string(
     "serve_sharding_config", "",
@@ -182,6 +198,10 @@ def main(argv):
             max_queue=FLAGS.max_queue,
             max_delay_s=FLAGS.max_delay_s,
             watchdog_secs=FLAGS.serve_watchdog_secs,
+            kv_block_size=FLAGS.kv_block_size,
+            kv_blocks=FLAGS.kv_blocks,
+            kv_dtype=FLAGS.kv_dtype,
+            prefix_cache=FLAGS.prefix_cache,
         ),
         sharding=sharding,
     )

@@ -42,3 +42,37 @@ class PrecisionPolicy:
             else x,
             tree,
         )
+
+
+# ----------------------------------------------------------- int8 KV
+#
+# Symmetric per-row int8 quantization for the serving KV cache
+# (serving/paged_kv.py): each cache row — one token's K or V for one
+# head — carries its own f32 scale, stored blockwise alongside the
+# int8 payload, so rows can be appended one decode step at a time
+# without requantizing the rest of the block. Halving (vs bf16) or
+# quartering (vs f32) KV bytes is the whole point: decode is
+# memory-bandwidth bound, so cache bytes read per step is TPOT.
+
+INT8_MAX = 127.0
+
+
+def quantize_int8_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """``x [..., D]`` -> (int8 values ``[..., D]``, f32 scales
+    ``[...]``). Symmetric absmax over the last axis; an all-zero row
+    gets scale 1 (dequantizes back to exact zeros)."""
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(amax > 0, amax / INT8_MAX, 1.0)
+    q = jnp.clip(
+        jnp.round(x / scale[..., None]), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8_rows(q: jax.Array, scale: jax.Array,
+                         dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_int8_rows` (``scale`` broadcasts over
+    the last axis of ``q``)."""
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+            ).astype(dtype)

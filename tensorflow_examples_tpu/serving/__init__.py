@@ -10,7 +10,16 @@ Layout (one module per concern, mirroring the training stack):
 * ``kv_cache.py``  — preallocated slot-granular KV cache pool with
   per-slot length tracking and the variable-length decode attention
   that reads it (the per-slot generalization of
-  ``ops/decode.flash_decode_attention``'s populated-prefix contract).
+  ``ops/decode.flash_decode_attention``'s populated-prefix contract),
+  plus its gather-by-block-table path for the paged pool.
+* ``paged_kv.py``  — ISSUE 8: the block-paged pool behind the same
+  interface — free-list block allocator with loud exhaustion, prefix
+  cache reusing immutable full prompt blocks (shared system prompts
+  prefill once), optional int8 KV with per-block scales.
+* ``router.py``    — ISSUE 8: the fleet tier — an HTTP router over N
+  engine replicas with load-aware dispatch from ``/health`` probes,
+  drain-aware rollout, retry-once-on-503, and canary per-set records
+  for ``tools/run_diff.py``.
 * ``engine.py``    — the compiled serving step: bucketed prefill +
   fixed-shape continuous decode, warmed up ahead of traffic over the
   padding-bucket ladder and wrapped in the PR-3 recompilation sentinel
@@ -43,3 +52,12 @@ from tensorflow_examples_tpu.serving.frontend import (  # noqa: F401
     run_until_preempted,
 )
 from tensorflow_examples_tpu.serving.kv_cache import KVCachePool  # noqa: F401
+from tensorflow_examples_tpu.serving.paged_kv import (  # noqa: F401
+    BlockExhausted,
+    PagedKVPool,
+)
+from tensorflow_examples_tpu.serving.router import (  # noqa: F401
+    Router,
+    RouterConfig,
+    RouterFrontend,
+)

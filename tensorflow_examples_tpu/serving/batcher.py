@@ -49,6 +49,7 @@ import threading
 import time
 
 from tensorflow_examples_tpu.serving.engine import EngineStepError
+from tensorflow_examples_tpu.serving.paged_kv import BlockExhausted
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry import schema
 from tensorflow_examples_tpu.telemetry.spans import span
@@ -322,6 +323,26 @@ class ContinuousBatcher:
                         for it in self._active.values()
                     ]
                     out = self.engine.decode(entries)
+            except BlockExhausted as e:
+                # Host-side exhaustion BEFORE the device step: no
+                # donated state was lost, so only the named slots (the
+                # requests that needed a new block) fail — loudly —
+                # and the engine keeps serving the rest. Freeing them
+                # returns their blocks, so the survivors' next growth
+                # usually succeeds.
+                log.warning(
+                    "KV block exhaustion: failing %d of %d active "
+                    "request(s): %s", len(e.slots), len(self._active), e,
+                )
+                reg.counter("serving/errors_total").inc()
+                for slot in e.slots:
+                    item = self._active.pop(slot, None)
+                    if item is None:
+                        continue
+                    self.engine.pool.free(slot)
+                    if not item.future.done():
+                        item.future.set_exception(e)
+                continue
             except Exception as e:  # noqa: BLE001 — fail the batch,
                 # keep serving: the next admissions start clean
                 log.exception("decode step failed; failing active batch")
@@ -500,10 +521,12 @@ class ContinuousBatcher:
     # ------------------------------------------------------------- stats
 
     def stats_line(self) -> dict:
-        """A schema-v4 ``kind="serving"`` JSONL line: the serving
+        """A schema-v6 ``kind="serving"`` JSONL line: the serving
         counterpart of the training window line (validated in tier-1;
         the frontend serves the latest one at ``/window`` and
-        examples/gpt2/serve.py appends them to ``serving.jsonl``)."""
+        examples/gpt2/serve.py appends them to ``serving.jsonl``).
+        Paged pools (serving/paged_kv.py) add their block/prefix-cache
+        fields to the ``serving`` object — the v6 additions."""
         reg = self.registry
         counters = {
             k: v for k, v in reg.counter_values().items()
@@ -520,6 +543,19 @@ class ContinuousBatcher:
             if h and h["count"]:
                 derived[f"{name}_p50"] = h["p50"]
                 derived[f"{name}_p95"] = h["p95"]
+        serving = {
+            "active_requests": len(self._active),
+            "queue_depth": self._q.qsize(),
+            "slots": self.engine.pool.num_slots,
+            "kv_occupancy": self.engine.pool.occupancy,
+            "post_warmup_recompiles": (
+                self.engine.post_warmup_recompiles()
+            ),
+            "draining": 1 if self._draining else 0,
+        }
+        paged = getattr(self.engine.pool, "paged_stats", None)
+        if callable(paged):
+            serving.update(paged())
         return {
             "schema_version": schema.SERVING_SCHEMA_VERSION,
             "kind": "serving",
@@ -533,16 +569,7 @@ class ContinuousBatcher:
             "counters": counters,
             "gauges": gauges,
             "derived": derived,
-            "serving": {
-                "active_requests": len(self._active),
-                "queue_depth": self._q.qsize(),
-                "slots": self.engine.pool.num_slots,
-                "kv_occupancy": self.engine.pool.occupancy,
-                "post_warmup_recompiles": (
-                    self.engine.post_warmup_recompiles()
-                ),
-                "draining": 1 if self._draining else 0,
-            },
+            "serving": serving,
         }
 
 

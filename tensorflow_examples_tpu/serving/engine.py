@@ -77,6 +77,15 @@ class ServeConfig:
     attention: str = "xla"       # xla | flash (flash: Pallas prefill attend)
     cache_dtype: str = ""        # "" -> follow the params dtype
     compile_warmup: int = 1      # expected compiles per sentinel-wrapped fn
+    # ---- paged KV (serving/paged_kv.py; ISSUE 8) ----
+    kv_block_size: int = 0       # 0 -> dense pool (legacy); else paged,
+    #                              power of two dividing both bucket
+    #                              floors and max_len
+    kv_blocks: int = 0           # physical blocks; 0 -> dense-equivalent
+    #                              worst case (slots * max_len / block)
+    kv_dtype: str = ""           # "" -> cache_dtype | "int8" (per-block
+    #                              scales, bounded-divergence mode)
+    prefix_cache: bool = True    # reuse immutable full prompt blocks
     # ---- continuous batcher (serving/batcher.py) ----
     max_batch: int = 0           # admission cap; 0 -> max_slots
     max_queue: int = 64          # bounded queue: beyond this, load-shed
@@ -193,6 +202,201 @@ def _decode_forward(cfg: TransformerConfig, params, k_cache, v_cache,
         x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
     x = _layer_norm(x, params["ln_f"])
     return k_cache, v_cache, jnp.dot(x, wte.T)
+
+
+# ---------------------------------------------------------- paged forward
+#
+# The paged mirrors of the dense cache ops (ISSUE 8): same math, but
+# K/V land in [L, NB, H, BS, D] block pools addressed through per-slot
+# block tables instead of a per-slot max_len extent. ``kv`` is the
+# pool's device-state tuple — (k, v) or, under int8, (k, v, k_scale,
+# v_scale) with per-row scales stored blockwise
+# (core/precision.quantize_int8_rows).
+
+
+def _paged_write_prompt(kv, ks, vs, block_ids, *, block_size):
+    """Scatter a prefill's freshly computed K/V ([L, bucket, H, hd])
+    into the blocks named by ``block_ids`` [bucket // BS] (pad entries
+    point at the null block; their garbage is never read)."""
+    from tensorflow_examples_tpu.core.precision import quantize_int8_rows
+
+    num_layers, bucket, h, hd = ks.shape
+    nb = bucket // block_size
+
+    def to_blocks(x):  # [L, bucket, H, hd] -> [L, nb, H, BS, hd]
+        return x.reshape(
+            num_layers, nb, block_size, h, hd
+        ).transpose(0, 1, 3, 2, 4)
+
+    kb, vb = to_blocks(ks), to_blocks(vs)
+    if len(kv) == 4:
+        k, v, ksc, vsc = kv
+        qk, sk = quantize_int8_rows(kb)
+        qv, sv = quantize_int8_rows(vb)
+        return (
+            k.at[:, block_ids].set(qk),
+            v.at[:, block_ids].set(qv),
+            ksc.at[:, block_ids].set(sk),
+            vsc.at[:, block_ids].set(sv),
+        )
+    k, v = kv
+    return (
+        k.at[:, block_ids].set(kb.astype(k.dtype)),
+        v.at[:, block_ids].set(vb.astype(v.dtype)),
+    )
+
+
+def _paged_write_rows(kv, layer, write_blocks, offsets, k, v):
+    """One decode step's per-slot rows ([S, H, hd]) into block
+    ``write_blocks[s]`` at row ``offsets[s]``. Parked slots write into
+    the null block (their table entry is 0) — discarded by masking."""
+    from tensorflow_examples_tpu.core.precision import quantize_int8_rows
+
+    if len(kv) == 4:
+        kk, vv, ksc, vsc = kv
+        qk, sk = quantize_int8_rows(k)
+        qv, sv = quantize_int8_rows(v)
+        return (
+            kk.at[layer, write_blocks, :, offsets, :].set(qk),
+            vv.at[layer, write_blocks, :, offsets, :].set(qv),
+            ksc.at[layer, write_blocks, :, offsets].set(sk),
+            vsc.at[layer, write_blocks, :, offsets].set(sv),
+        )
+    kk, vv = kv
+    return (
+        kk.at[layer, write_blocks, :, offsets, :].set(k.astype(kk.dtype)),
+        vv.at[layer, write_blocks, :, offsets, :].set(v.astype(vv.dtype)),
+    )
+
+
+def _paged_gather_dequant(kv, layer, tables, dtype):
+    """int8 path: gather blocks + blockwise scales by table, dequantize
+    to ``dtype`` -> (k, v) [S, H, nb*BS, D] (the fp paths instead hand
+    ``varlen_decode_attention`` the raw pool via ``block_tables=``)."""
+    from tensorflow_examples_tpu.core.precision import dequantize_int8_rows
+
+    k, v, ksc, vsc = kv
+    s, nb = tables.shape
+    _, _, h, bs, d = k.shape
+
+    def gather(blocks, scales):
+        g = dequantize_int8_rows(blocks[layer][tables],
+                                 scales[layer][tables], dtype)
+        return g.transpose(0, 2, 1, 3, 4).reshape(s, h, nb * bs, d)
+
+    return gather(k, ksc), gather(v, vsc)
+
+
+def _paged_decode_forward(cfg: TransformerConfig, params, kv, tokens,
+                          positions, tables, *, block_size: int):
+    """The paged twin of ``_decode_forward``: writes route through the
+    block table, attention gathers by it (the
+    ``varlen_decode_attention`` block-table path)."""
+    wte = params["wte"]["embedding"]
+    x = wte[tokens] + params["wpe"]["embedding"][positions]
+    lengths = positions + 1
+    write_blocks = jnp.take_along_axis(
+        tables, (positions // block_size)[:, None], axis=1
+    )[:, 0]
+    offsets = positions % block_size
+    for layer in range(cfg.num_layers):
+        p = params[f"h_{layer}"]
+        y = _layer_norm(x, p["ln_1"])
+        q, k, v = _qkv(y, p["attn"])  # [S, H, hd]
+        kv = _paged_write_rows(kv, layer, write_blocks, offsets, k, v)
+        if len(kv) == 4:
+            kk, vv = _paged_gather_dequant(kv, layer, tables, q.dtype)
+            att = kv_mod.varlen_decode_attention(q, kk, vv, lengths)
+        else:
+            att = kv_mod.varlen_decode_attention(
+                q, kv[0][layer], kv[1][layer], lengths,
+                block_tables=tables,
+            )
+        x = x + _attn_out(att, p["attn"])
+        x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
+    x = _layer_norm(x, params["ln_f"])
+    return kv, jnp.dot(x, wte.T)
+
+
+def _extend_forward(cfg: TransformerConfig, params, kv, ctx_table,
+                    tail_ids, tokens, ctx_len, *, block_size: int):
+    """Chunked prefill on top of a cached context: run only the prompt
+    TAIL (``tokens`` [1, tb], absolute positions ``ctx_len + i``), with
+    each tail row attending over (a) the cached context gathered by
+    ``ctx_table`` [max_blocks], masked to ``ctx_len`` columns, and (b)
+    the tail itself, causally. This is what makes a prefix-cache hit a
+    compute saving, not just a memory one: the shared prefix's layers
+    are never re-run. Tail K/V is scattered into ``tail_ids``
+    [tb // BS]. Numerics mirror ``varlen_decode_attention`` (f32
+    scores/softmax, probabilities cast to the value dtype, f32
+    accumulation) so hits stay token-identical at fp32 (test-pinned).
+    """
+    from tensorflow_examples_tpu.core.precision import dequantize_int8_rows
+
+    wte = params["wte"]["embedding"]
+    tb = tokens.shape[1]
+    sm_scale = cfg.head_dim ** -0.5
+    positions = ctx_len + jnp.arange(tb, dtype=jnp.int32)
+    # Pad rows past the true tail may index past max_len; clip — they
+    # are causally downstream of every real row and discarded.
+    x = wte[tokens] + params["wpe"]["embedding"][
+        jnp.minimum(positions, cfg.max_len - 1)
+    ][None]
+    quantized = len(kv) == 4
+    nb = ctx_table.shape[0]
+    ctx_cols = nb * block_size
+    colc = jax.lax.broadcasted_iota(jnp.int32, (1, 1, tb, ctx_cols), 3)
+    rowt = jax.lax.broadcasted_iota(jnp.int32, (1, 1, tb, tb), 2)
+    colt = jax.lax.broadcasted_iota(jnp.int32, (1, 1, tb, tb), 3)
+    ks, vs = [], []
+    for layer in range(cfg.num_layers):
+        p = params[f"h_{layer}"]
+        y = _layer_norm(x, p["ln_1"])
+        q, k, v = _qkv(y, p["attn"])  # [1, tb, H, hd]
+        ks.append(k[0])
+        vs.append(v[0])
+        if quantized:
+            kc = dequantize_int8_rows(
+                kv[0][layer][ctx_table], kv[2][layer][ctx_table], q.dtype
+            )
+            vc = dequantize_int8_rows(
+                kv[1][layer][ctx_table], kv[3][layer][ctx_table], q.dtype
+            )
+        else:
+            kc = kv[0][layer][ctx_table].astype(q.dtype)
+            vc = kv[1][layer][ctx_table].astype(q.dtype)
+        # [nb, H, BS, hd] -> [H, nb*BS, hd]
+        kc = kc.transpose(1, 0, 2, 3).reshape(-1, ctx_cols, cfg.head_dim)
+        vc = vc.transpose(1, 0, 2, 3).reshape(-1, ctx_cols, cfg.head_dim)
+        qh = q.transpose(0, 2, 1, 3)  # [1, H, tb, hd]
+        s_ctx = jnp.einsum(
+            "bhtd,hkd->bhtk", qh, kc, preferred_element_type=jnp.float32
+        ) * sm_scale
+        s_ctx = jnp.where(colc < ctx_len, s_ctx, NEG_INF)
+        kh = k.transpose(0, 2, 1, 3)
+        s_tail = jnp.einsum(
+            "bhtd,bhkd->bhtk", qh, kh, preferred_element_type=jnp.float32
+        ) * sm_scale
+        s_tail = jnp.where(rowt >= colt, s_tail, NEG_INF)
+        prob = jax.nn.softmax(
+            jnp.concatenate([s_ctx, s_tail], axis=-1), axis=-1
+        )
+        p_ctx, p_tail = prob[..., :ctx_cols], prob[..., ctx_cols:]
+        out = jnp.einsum(
+            "bhtk,hkd->bhtd", p_ctx.astype(vc.dtype), vc,
+            preferred_element_type=jnp.float32,
+        ) + jnp.einsum(
+            "bhtk,bhkd->bhtd", p_tail.astype(v.dtype),
+            v.transpose(0, 2, 1, 3), preferred_element_type=jnp.float32,
+        )
+        att = out.astype(q.dtype).transpose(0, 2, 1, 3)
+        x = x + _attn_out(att, p["attn"])
+        x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
+    x = _layer_norm(x, params["ln_f"])
+    kv = _paged_write_prompt(
+        kv, jnp.stack(ks), jnp.stack(vs), tail_ids, block_size=block_size
+    )
+    return kv, jnp.dot(x, wte.T)
 
 
 # -------------------------------------------------------------- sampling
@@ -325,48 +529,124 @@ class InferenceEngine:
             if self.cfg.cache_dtype
             else param_dtype
         )
-        self.pool = kv_mod.KVCachePool(
-            num_layers=model_cfg.num_layers,
-            num_slots=self.cfg.max_slots,
-            num_heads=model_cfg.num_heads,
-            max_len=model_cfg.max_len,
-            head_dim=model_cfg.head_dim,
-            dtype=cache_dtype,
-            registry=self.registry,
-            sharding=self._kv_sharding(),
-        )
+        self.paged = self.cfg.kv_block_size > 0
+        if self.paged:
+            bs = self.cfg.kv_block_size
+            for name, val in (
+                ("prefill_bucket_floor", self.cfg.prefill_bucket_floor),
+                ("kv_bucket_floor", self.cfg.kv_bucket_floor),
+                ("max_len", model_cfg.max_len),
+            ):
+                if val % bs:
+                    raise ValueError(
+                        f"kv_block_size={bs} must divide {name}={val} "
+                        "(every compiled bucket is a whole number of "
+                        "blocks)"
+                    )
+            from tensorflow_examples_tpu.serving.paged_kv import (
+                PagedKVPool,
+            )
+
+            self.pool = PagedKVPool(
+                num_layers=model_cfg.num_layers,
+                num_slots=self.cfg.max_slots,
+                num_heads=model_cfg.num_heads,
+                max_len=model_cfg.max_len,
+                head_dim=model_cfg.head_dim,
+                block_size=bs,
+                num_blocks=self.cfg.kv_blocks,
+                dtype=cache_dtype,
+                kv_dtype=self.cfg.kv_dtype,
+                prefix_cache=self.cfg.prefix_cache,
+                registry=self.registry,
+                sharding=self._kv_sharding(),
+            )
+        else:
+            if self.cfg.kv_dtype:
+                raise ValueError(
+                    "kv_dtype (quantized KV) requires the paged pool — "
+                    "set kv_block_size"
+                )
+            self.pool = kv_mod.KVCachePool(
+                num_layers=model_cfg.num_layers,
+                num_slots=self.cfg.max_slots,
+                num_heads=model_cfg.num_heads,
+                max_len=model_cfg.max_len,
+                head_dim=model_cfg.head_dim,
+                dtype=cache_dtype,
+                registry=self.registry,
+                sharding=self._kv_sharding(),
+            )
         self.prefill_ladder = kv_mod.bucket_ladder(
             self.cfg.prefill_bucket_floor, model_cfg.max_len
         )
         self.kv_ladder = kv_mod.bucket_ladder(
             self.cfg.kv_bucket_floor, model_cfg.max_len
         )
-        # The KV caches are donated (args 1/2 after partial binds the
-        # bucket): both steps return the updated caches and the pool
-        # unconditionally reassigns from the outputs, so XLA can alias
-        # in place instead of copying two [L, slots, H, max_len, D]
-        # buffers per generated token. Backends without donation
-        # support just ignore the hint.
-        self._prefill_fns = {
-            lb: self.sentinel.wrap(
-                jax.jit(
-                    functools.partial(self._prefill_impl, lb),
-                    donate_argnums=(1, 2),
-                ),
-                f"serve_prefill_L{lb}",
-            )
-            for lb in self.prefill_ladder
-        }
-        self._decode_fns = {
-            kb: self.sentinel.wrap(
-                jax.jit(
-                    functools.partial(self._decode_impl, kb),
-                    donate_argnums=(1, 2),
-                ),
-                f"serve_decode_K{kb}",
-            )
-            for kb in self.kv_ladder
-        }
+        # The KV caches are donated (the dense steps take k/v as args
+        # 1/2 after partial binds the bucket; the paged steps take the
+        # pool's whole device-state tuple as arg 1): every step returns
+        # the updated caches and the pool unconditionally reassigns
+        # from the outputs, so XLA can alias in place instead of
+        # copying the pool per generated token. Backends without
+        # donation support just ignore the hint.
+        if self.paged:
+            self._prefill_fns = {
+                lb: self.sentinel.wrap(
+                    jax.jit(
+                        functools.partial(self._paged_prefill_impl, lb),
+                        donate_argnums=(1,),
+                    ),
+                    f"serve_prefill_L{lb}",
+                )
+                for lb in self.prefill_ladder
+            }
+            self._decode_fns = {
+                kb: self.sentinel.wrap(
+                    jax.jit(
+                        functools.partial(self._paged_decode_impl, kb),
+                        donate_argnums=(1,),
+                    ),
+                    f"serve_decode_K{kb}",
+                )
+                for kb in self.kv_ladder
+            }
+            # One extend program per TAIL bucket; the cached context
+            # always rides in as the slot's full block table (masked to
+            # the true context length) — |prefill ladder| programs, not
+            # a ladder product.
+            self._extend_fns = {
+                tb: self.sentinel.wrap(
+                    jax.jit(
+                        functools.partial(self._extend_impl, tb),
+                        donate_argnums=(1,),
+                    ),
+                    f"serve_extend_T{tb}",
+                )
+                for tb in self.prefill_ladder
+            } if self.cfg.prefix_cache else {}
+        else:
+            self._prefill_fns = {
+                lb: self.sentinel.wrap(
+                    jax.jit(
+                        functools.partial(self._prefill_impl, lb),
+                        donate_argnums=(1, 2),
+                    ),
+                    f"serve_prefill_L{lb}",
+                )
+                for lb in self.prefill_ladder
+            }
+            self._decode_fns = {
+                kb: self.sentinel.wrap(
+                    jax.jit(
+                        functools.partial(self._decode_impl, kb),
+                        donate_argnums=(1, 2),
+                    ),
+                    f"serve_decode_K{kb}",
+                )
+                for kb in self.kv_ladder
+            }
+            self._extend_fns = {}
         self.warmed = False
         self._ref_fwd = None
 
@@ -425,6 +705,49 @@ class InferenceEngine:
         keys = _request_key_batch(seeds, positions + 1)
         return k_cache, v_cache, _sample_batch(keys, logits, temps, top_ks)
 
+    # --------------------------------------------- compiled fns (paged)
+
+    def _paged_prefill_impl(self, bucket, params, kv, block_ids, tokens,
+                            length, key, temp, top_k):
+        """The paged twin of ``_prefill_impl``: same forward, K/V
+        scattered into the slot's blocks instead of its dense extent."""
+        logits, ks, vs = forward_full(
+            self.model_cfg, params, tokens, impl=self.cfg.attention
+        )
+        kv = _paged_write_prompt(
+            kv, ks[:, 0], vs[:, 0], block_ids,
+            block_size=self.cfg.kv_block_size,
+        )
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], length - 1, keepdims=False
+        )
+        return kv, _sample_row(key, last, temp, top_k), last
+
+    def _paged_decode_impl(self, bucket, params, kv, tokens, positions,
+                           tables, seeds, temps, top_ks):
+        del bucket  # static: encoded in tables.shape
+        kv, logits = _paged_decode_forward(
+            self.model_cfg, params, kv, tokens, positions, tables,
+            block_size=self.cfg.kv_block_size,
+        )
+        keys = _request_key_batch(seeds, positions + 1)
+        return kv, _sample_batch(keys, logits, temps, top_ks)
+
+    def _extend_impl(self, tail_bucket, params, kv, ctx_table, tail_ids,
+                     tokens, ctx_len, tail_len, key, temp, top_k):
+        """Prefix-cache hit path: prefill only the prompt tail over the
+        cached context (see ``_extend_forward``); samples the first
+        token from the tail's last true row."""
+        del tail_bucket  # static: encoded in tokens.shape
+        kv, logits = _extend_forward(
+            self.model_cfg, params, kv, ctx_table, tail_ids, tokens,
+            ctx_len, block_size=self.cfg.kv_block_size,
+        )
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], tail_len - 1, keepdims=False
+        )
+        return kv, _sample_row(key, last, temp, top_k), last
+
     # --------------------------------------------------------- lifecycle
 
     def warmup(self) -> dict[str, int]:
@@ -436,20 +759,54 @@ class InferenceEngine:
         zero = jnp.zeros((), jnp.int32)
         key = jax.random.PRNGKey(0)
         ftemp = jnp.float32(0.0)
-        for lb in self.prefill_ladder:
-            self.pool.k, self.pool.v, tok, _ = self._prefill_fns[lb](
-                self.params, self.pool.k, self.pool.v, zero,
-                jnp.zeros((1, lb), jnp.int32), zero + 1, key, ftemp, zero,
-            )
-            tok.block_until_ready()
-        for kb in self.kv_ladder:
-            self.pool.k, self.pool.v, toks = self._decode_fns[kb](
-                self.params, self.pool.k, self.pool.v,
-                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
-                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.float32),
-                jnp.zeros((s,), jnp.int32),
-            )
-            toks.block_until_ready()
+        if self.paged:
+            bs = self.cfg.kv_block_size
+            for lb in self.prefill_ladder:
+                kv, tok, _ = self._prefill_fns[lb](
+                    self.params, self.pool.kv_state(),
+                    jnp.zeros((lb // bs,), jnp.int32),
+                    jnp.zeros((1, lb), jnp.int32), zero + 1, key, ftemp,
+                    zero,
+                )
+                self.pool.set_kv_state(kv)
+                tok.block_until_ready()
+            for kb in self.kv_ladder:
+                kv, toks = self._decode_fns[kb](
+                    self.params, self.pool.kv_state(),
+                    jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+                    jnp.zeros((s, kb // bs), jnp.int32),
+                    jnp.zeros((s,), jnp.int32),
+                    jnp.zeros((s,), jnp.float32),
+                    jnp.zeros((s,), jnp.int32),
+                )
+                self.pool.set_kv_state(kv)
+                toks.block_until_ready()
+            for tb in self._extend_fns:
+                kv, tok, _ = self._extend_fns[tb](
+                    self.params, self.pool.kv_state(),
+                    jnp.zeros((self.pool.max_blocks_per_slot,), jnp.int32),
+                    jnp.zeros((tb // bs,), jnp.int32),
+                    jnp.zeros((1, tb), jnp.int32), zero + bs, zero + 1,
+                    key, ftemp, zero,
+                )
+                self.pool.set_kv_state(kv)
+                tok.block_until_ready()
+        else:
+            for lb in self.prefill_ladder:
+                self.pool.k, self.pool.v, tok, _ = self._prefill_fns[lb](
+                    self.params, self.pool.k, self.pool.v, zero,
+                    jnp.zeros((1, lb), jnp.int32), zero + 1, key, ftemp,
+                    zero,
+                )
+                tok.block_until_ready()
+            for kb in self.kv_ladder:
+                self.pool.k, self.pool.v, toks = self._decode_fns[kb](
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+                    jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.float32),
+                    jnp.zeros((s,), jnp.int32),
+                )
+                toks.block_until_ready()
         self.pool.reset()
         self.warmed = True
         counts = self.sentinel.compile_counts()
@@ -461,7 +818,10 @@ class InferenceEngine:
         return counts
 
     def expected_compiles(self) -> int:
-        return len(self.prefill_ladder) + len(self.kv_ladder)
+        return (
+            len(self.prefill_ladder) + len(self.kv_ladder)
+            + len(self._extend_fns)
+        )
 
     def post_warmup_recompiles(self) -> int:
         """Total compiles beyond each variant's warmup allowance — the
@@ -473,7 +833,13 @@ class InferenceEngine:
     def prefill(self, slot: int, prompt: Sequence[int], *, seed: int = 0,
                 temperature: float = 0.0, top_k: int = 0):
         """Run a prompt into ``slot``; returns (first generated token,
-        last-position logits as numpy — the classify payload)."""
+        last-position logits as numpy — the classify payload).
+
+        Paged mode allocates exactly the blocks the prompt needs
+        (``paged_kv.BlockExhausted`` propagates BEFORE any device call
+        — no donation happened, so only THIS request fails) and, on a
+        prefix-cache hit, maps the shared blocks into the slot's table
+        and prefills only the tail (``_extend_impl``)."""
         n = len(prompt)
         if n < 1:
             raise ValueError("empty prompt")
@@ -481,25 +847,93 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt length {n} exceeds max_len {self.model_cfg.max_len}"
             )
-        bucket = kv_mod.pick_bucket(self.prefill_ladder, n)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = prompt
-        try:
-            self.pool.k, self.pool.v, tok, last = self._prefill_fns[bucket](
-                self.params, self.pool.k, self.pool.v,
-                jnp.int32(slot), jnp.asarray(tokens), jnp.int32(n),
-                request_key(seed, n), jnp.float32(temperature),
-                jnp.int32(top_k),
+        if self.paged:
+            tok, last = self._paged_prefill(
+                slot, prompt, seed=seed, temperature=temperature,
+                top_k=top_k,
             )
+        else:
+            bucket = kv_mod.pick_bucket(self.prefill_ladder, n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = prompt
+            try:
+                (self.pool.k, self.pool.v, tok, last) = (
+                    self._prefill_fns[bucket](
+                        self.params, self.pool.k, self.pool.v,
+                        jnp.int32(slot), jnp.asarray(tokens), jnp.int32(n),
+                        request_key(seed, n), jnp.float32(temperature),
+                        jnp.int32(top_k),
+                    )
+                )
+            except Exception as e:
+                self.pool.reallocate()
+                raise EngineStepError(
+                    f"compiled prefill step failed (KV caches "
+                    f"reallocated): {type(e).__name__}: {e}"
+                ) from e
+        self.pool.lengths[slot] = n
+        self.registry.counter("serving/prefill_tokens").inc(n)
+        return int(tok), np.asarray(last)
+
+    def _paged_prefill(self, slot, prompt, *, seed, temperature, top_k):
+        from tensorflow_examples_tpu.serving import paged_kv
+
+        n = len(prompt)
+        bs = self.cfg.kv_block_size
+        reused, ctx = self.pool.prefix_lookup(prompt)
+        if ctx and not self._extend_fns:  # prefix_cache=False never hits
+            self.pool.release_prefix(reused)
+            reused, ctx = [], 0
+        total_blocks = -(-n // bs)
+        try:
+            fresh = self.pool.alloc_blocks(total_blocks - len(reused))
+        except paged_kv.BlockExhausted:
+            self.pool.release_prefix(reused)
+            raise
+        self.pool.assign(slot, reused + fresh)
+        key = request_key(seed, n)
+        ftemp, ftk = jnp.float32(temperature), jnp.int32(top_k)
+        try:
+            if ctx == 0:
+                bucket = kv_mod.pick_bucket(self.prefill_ladder, n)
+                ids = np.zeros((bucket // bs,), np.int32)
+                ids[:total_blocks] = self.pool.block_tables[
+                    slot, :total_blocks
+                ]
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :n] = prompt
+                kv, tok, last = self._prefill_fns[bucket](
+                    self.params, self.pool.kv_state(), jnp.asarray(ids),
+                    jnp.asarray(tokens), jnp.int32(n), key, ftemp, ftk,
+                )
+            else:
+                tail = n - ctx
+                tb = kv_mod.pick_bucket(self.prefill_ladder, tail)
+                tail_blocks = total_blocks - ctx // bs
+                tail_ids = np.zeros((tb // bs,), np.int32)
+                tail_ids[:tail_blocks] = self.pool.block_tables[
+                    slot, ctx // bs:total_blocks
+                ]
+                tokens = np.zeros((1, tb), np.int32)
+                tokens[0, :tail] = prompt[ctx:]
+                kv, tok, last = self._extend_fns[tb](
+                    self.params, self.pool.kv_state(),
+                    jnp.asarray(self.pool.block_tables[slot]),
+                    jnp.asarray(tail_ids), jnp.asarray(tokens),
+                    jnp.int32(ctx), jnp.int32(tail), key, ftemp, ftk,
+                )
+                self.registry.counter(
+                    "serving/prefix_reused_tokens"
+                ).inc(ctx)
         except Exception as e:
             self.pool.reallocate()
             raise EngineStepError(
                 f"compiled prefill step failed (KV caches reallocated): "
                 f"{type(e).__name__}: {e}"
             ) from e
-        self.pool.lengths[slot] = n
-        self.registry.counter("serving/prefill_tokens").inc(n)
-        return int(tok), np.asarray(last)
+        self.pool.set_kv_state(kv)
+        self.pool.insert_prefix(slot, prompt)
+        return tok, last
 
     def decode(self, entries: Sequence[tuple[int, int, int, float, int]]):
         """One continuous-decode step. ``entries`` is the active set:
@@ -526,19 +960,59 @@ class InferenceEngine:
         bucket = kv_mod.pick_bucket(
             self.kv_ladder, int(positions.max(initial=0)) + 1
         )
-        try:
-            self.pool.k, self.pool.v, out = self._decode_fns[bucket](
-                self.params, self.pool.k, self.pool.v,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(seeds), jnp.asarray(temps),
-                jnp.asarray(top_ks),
+        if self.paged:
+            from tensorflow_examples_tpu.serving import paged_kv
+
+            # Grow block tables BEFORE the device step: an exhaustion
+            # here has consumed nothing (no donation yet), so only the
+            # requests that could not grow fail — the engine keeps
+            # serving the rest (the batcher handles the partition).
+            exhausted = []
+            for slot in slots:
+                try:
+                    self.pool.ensure_position(
+                        slot, int(positions[slot])
+                    )
+                except paged_kv.BlockExhausted:
+                    exhausted.append(slot)
+            if exhausted:
+                raise paged_kv.BlockExhausted(
+                    "KV block pool exhausted mid-decode for slot(s) "
+                    f"{exhausted}; pool is serving at capacity",
+                    slots=tuple(exhausted),
+                )
+            bs = self.cfg.kv_block_size
+            tables = np.ascontiguousarray(
+                self.pool.block_tables[:, :bucket // bs]
             )
-        except Exception as e:
-            self.pool.reallocate()
-            raise EngineStepError(
-                f"compiled decode step failed (KV caches reallocated): "
-                f"{type(e).__name__}: {e}"
-            ) from e
+            try:
+                kv, out = self._decode_fns[bucket](
+                    self.params, self.pool.kv_state(),
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(tables), jnp.asarray(seeds),
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                )
+            except Exception as e:
+                self.pool.reallocate()
+                raise EngineStepError(
+                    f"compiled decode step failed (KV caches "
+                    f"reallocated): {type(e).__name__}: {e}"
+                ) from e
+            self.pool.set_kv_state(kv)
+        else:
+            try:
+                self.pool.k, self.pool.v, out = self._decode_fns[bucket](
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(seeds), jnp.asarray(temps),
+                    jnp.asarray(top_ks),
+                )
+            except Exception as e:
+                self.pool.reallocate()
+                raise EngineStepError(
+                    f"compiled decode step failed (KV caches "
+                    f"reallocated): {type(e).__name__}: {e}"
+                ) from e
         out = np.asarray(out)
         for slot in slots:
             self.pool.lengths[slot] += 1

@@ -59,6 +59,7 @@ from tensorflow_examples_tpu.serving.batcher import (
     QueueFull,
     Request,
 )
+from tensorflow_examples_tpu.serving.paged_kv import BlockExhausted
 from tensorflow_examples_tpu.telemetry.serve import (
     json_safe,
     render_prometheus,
@@ -177,6 +178,11 @@ class ServingFrontend:
             return 503, {"error": str(e), "draining": True}
         except QueueFull as e:
             return 503, {"error": str(e), "retry": True}
+        except BlockExhausted as e:
+            # Paged-KV capacity shed: same contract as QueueFull — the
+            # pool cannot back the request's tokens right now; a load
+            # balancer should retry elsewhere/later.
+            return 503, {"error": str(e), "retry": True}
         except DeadlineExceeded as e:
             return 504, {"error": str(e)}
         except ValueError as e:
@@ -214,10 +220,17 @@ class ServingFrontend:
             "draining": batcher.draining,
             "active_requests": len(batcher._active),
             "queue_depth": batcher._q.qsize(),
+            "slots": engine.pool.num_slots,
             "kv_occupancy": engine.pool.occupancy,
             "post_warmup_recompiles": engine.post_warmup_recompiles(),
             "warmed": engine.warmed,
         }
+        paged = getattr(engine.pool, "paged_stats", None)
+        if callable(paged):
+            stats = paged()
+            body["kv_block_occupancy"] = stats["kv_block_occupancy"]
+            body["kv_slot_occupancy"] = stats["kv_slot_occupancy"]
+            body["prefix_hit_rate"] = stats["prefix_hit_rate"]
         wd = batcher._watchdog
         if wd is not None:
             status = wd.status()

@@ -67,6 +67,23 @@ def pick_bucket(ladder: list[int], needed: int) -> int:
     )
 
 
+def gather_block_kv(blocks: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """Gather per-slot contiguous cache views out of a paged block pool.
+
+    blocks: [NB, H, BS, D] — one layer's block pool (NB physical
+    blocks of BS token rows each). block_tables: [S, nb] int32 — each
+    slot's logical-block -> physical-block map for the active KV
+    bucket (nb = bucket // BS; entries past a slot's allocation point
+    at the reserved null block 0, whose rows length-masking never
+    lets through). Returns [S, H, nb*BS, D] — exactly the dense-pool
+    slice :func:`varlen_decode_attention` consumes.
+    """
+    s, nb = block_tables.shape
+    _, h, bs, d = blocks.shape
+    g = blocks[block_tables]             # [S, nb, H, BS, D]
+    return g.transpose(0, 2, 1, 3, 4).reshape(s, h, nb * bs, d)
+
+
 def varlen_decode_attention(
     q: jax.Array,
     k_cache: jax.Array,
@@ -74,6 +91,7 @@ def varlen_decode_attention(
     lengths: jax.Array,
     *,
     sm_scale: float | None = None,
+    block_tables: jax.Array | None = None,
 ) -> jax.Array:
     """Single-token attention over per-slot populated cache prefixes.
 
@@ -83,11 +101,20 @@ def varlen_decode_attention(
     KV bucket; slots' rows >= their length are garbage and masked.
     lengths: [S] int32 populated lengths INCLUDING the new token.
 
+    With ``block_tables`` ([S, nb] int32, ISSUE 8), k_cache/v_cache
+    are instead a paged block pool ([NB, H, BS, D]) and each slot's
+    view is gathered by its block table first
+    (:func:`gather_block_kv`) — the paged mirror of the dense slice,
+    same masking contract downstream.
+
     Returns [S, H, D]. Numerics mirror
     ``ops/decode.decode_attention_reference`` (f32 scores/softmax,
     output cast back to q.dtype) with the scalar length promoted to a
     vector — slot s sees columns < lengths[s], nothing else.
     """
+    if block_tables is not None:
+        k_cache = gather_block_kv(k_cache, block_tables)
+        v_cache = gather_block_kv(v_cache, block_tables)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
@@ -158,7 +185,12 @@ class KVCachePool:
     def _publish(self) -> None:
         reg = self._reg()
         active = self.num_slots - len(self._free)
+        # Dense pool: a claimed slot IS max_len of committed cache, so
+        # slot occupancy and capacity occupancy are the same number.
+        # The paged pool (paged_kv.py) splits them — kv_occupancy
+        # becomes used-block fraction there — and publishes both.
         reg.gauge("serving/kv_occupancy").set(active / self.num_slots)
+        reg.gauge("serving/kv_slot_occupancy").set(active / self.num_slots)
         reg.gauge("serving/kv_slots_active").set(active)
         reg.gauge("serving/kv_tokens").set(int(self.lengths.sum()))
 
@@ -223,3 +255,26 @@ class KVCachePool:
         engine picks the decode KV bucket from this."""
         with self._lock:
             return int(self.lengths.max(initial=0))
+
+    # -------------------------------------------------- byte accounting
+
+    @property
+    def kv_bits(self) -> int:
+        """Storage bits per cache element (uniform with the paged
+        pool's quantization-aware figure)."""
+        return jnp.dtype(self.dtype).itemsize * 8
+
+    def bytes_per_slot(self) -> int:
+        """K+V device bytes one claimed slot commits (the dense pool
+        commits the full ``max_len`` extent per slot, used or not —
+        the economics the paged pool exists to beat)."""
+        return int(
+            2 * self.num_layers * self.num_heads * self.max_len
+            * self.head_dim * jnp.dtype(self.dtype).itemsize
+        )
+
+    def used_bytes(self) -> int:
+        """Cache bytes committed to the currently active request set
+        (tier-1 asserts the paged pool's figure for a mixed-length set
+        is <= 1/2 of this one at equal concurrency)."""
+        return self.active_slots * self.bytes_per_slot()

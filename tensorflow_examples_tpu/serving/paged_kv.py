@@ -1,0 +1,506 @@
+"""Block-paged KV cache pool with prefix reuse + int8 KV (ISSUE 8).
+
+The dense ``KVCachePool`` commits ``max_len`` rows per slot the moment
+the slot is claimed: a 12-token request holds as much cache as a
+1024-token one, and concurrency is capped by the worst case, not the
+workload. This module replaces the storage layer behind the same
+interface the engine/batcher already speak:
+
+* **Paged blocks** — the device arrays are ``[L, NB, H, BS, D]`` pools
+  of ``NB`` physical blocks of ``BS`` (power-of-two) token rows each.
+  A slot holds a *block table* (logical block index -> physical block
+  id); capacity scales with the tokens a request has actually used,
+  so a mixed short/long request set commits a fraction of the dense
+  pool's bytes (tier-1 asserts <= 1/2 via ``used_bytes()``).
+  Physical block 0 is reserved as the **null block**: pad entries of
+  every table point at it, parked decode slots write their discarded
+  rows into it, and length masking guarantees its garbage is never
+  read into a real request's attention.
+* **Free-list allocator** — blocks are claimed from a free list and
+  refcounted (prefix sharing means a block can back several slots).
+  Exhaustion is LOUD: :class:`BlockExhausted` (after evicting
+  reusable-but-unreferenced prefix blocks, LRU first) — admission
+  rejects the request (HTTP 503) instead of anything silently
+  stalling, and a mid-decode exhaustion fails only the requests that
+  needed new blocks while the engine keeps serving the rest
+  (tests pin both, mirroring the PR 5 ``EngineStepError`` contract).
+* **Prefix cache** — immutable FULL blocks of a request's prompt are
+  published for reuse, keyed by an exact chained key
+  ``(parent physical block id, the BS token ids in this block)`` — a
+  walk from the root reproduces the whole token prefix, so a hit can
+  never serve another prompt's cache (no hash collisions by
+  construction). A later request whose prompt starts with the same
+  full blocks maps them into its table (refcount++) and prefills only
+  the tail (``engine._extend_impl``): shared system prompts prefill
+  once. The partial tail is copy-on-write by construction — cached
+  blocks cover only ``[0, c)`` with ``c`` block-aligned and strictly
+  below the prompt length, and every write a request ever makes lands
+  at positions ``>= prompt_len > c``, i.e. in its own private blocks;
+  a shared block is never written again while published.
+* **int8 KV** (``kv_dtype="int8"``) — blocks store int8 with per-row
+  f32 scales kept blockwise (``[L, NB, H, BS]``,
+  ``core/precision.quantize_int8_rows``): rows append one decode step
+  at a time without requantizing the block. fp32/bf16 paged serving
+  stays token-identical to the dense reference; int8 is a measured
+  bounded-divergence mode (tests pin both).
+
+Occupancy telemetry splits what the dense pool conflated (ISSUE 8
+satellite): ``serving/kv_occupancy`` is the **used-block fraction**
+(the capacity signal the router tier load-balances on), while
+``serving/kv_slot_occupancy`` tracks claimed slots — a pool with every
+slot busy on short prompts no longer reads as full.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+
+log = logging.getLogger(__name__)
+
+NULL_BLOCK = 0  # physical block 0: pad/garbage target, never allocated
+
+
+class BlockExhausted(RuntimeError):
+    """The block free list is empty (even after evicting unreferenced
+    prefix-cache blocks). At admission this rejects the request (503);
+    mid-decode it names the slots that could not grow (``slots``) so
+    the batcher fails exactly those and keeps serving the rest."""
+
+    def __init__(self, msg: str, *, slots: tuple[int, ...] = ()):
+        super().__init__(msg)
+        self.slots = tuple(slots)
+
+
+class PagedKVPool:
+    """Paged drop-in for ``kv_cache.KVCachePool``: same slot interface
+    (``alloc``/``free``/``reset``/``reallocate``/``lengths``/
+    ``max_active_length``/``occupancy``), block-granular storage.
+
+    Host bookkeeping (all under one lock; the batcher loop is the only
+    writer, frontend threads read occupancy):
+
+    * ``block_tables`` — int32 ``[num_slots, max_len // BS]``, physical
+      block ids, ``NULL_BLOCK`` where unallocated.
+    * ``_refcount``   — per physical block; prefix sharing makes this
+      > 1. A block at refcount 0 returns to the free list unless it is
+      published in the prefix cache, in which case it parks in the
+      LRU evictable set (still hittable, reclaimed on pressure).
+    * prefix cache    — chained exact-token map, see module docstring.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_layers: int,
+        num_slots: int,
+        num_heads: int,
+        max_len: int,
+        head_dim: int,
+        block_size: int = 16,
+        num_blocks: int = 0,
+        dtype=jnp.float32,
+        kv_dtype: str = "",
+        prefix_cache: bool = True,
+        registry=None,
+        sharding=None,
+    ):
+        if num_slots < 1:
+            raise ValueError(f"num_slots={num_slots} must be >= 1")
+        if block_size < 1 or block_size & (block_size - 1):
+            raise ValueError(
+                f"block_size={block_size} must be a power of two"
+            )
+        if max_len % block_size:
+            raise ValueError(
+                f"block_size={block_size} must divide max_len={max_len}"
+            )
+        self.num_layers = num_layers
+        self.num_slots = num_slots
+        self.num_heads = num_heads
+        self.max_len = max_len
+        self.head_dim = head_dim
+        self.block_size = block_size
+        self.max_blocks_per_slot = max_len // block_size
+        # Default capacity matches the dense pool's worst case (every
+        # slot at max_len) so nothing that served before can fail now;
+        # operators shrink it (ServeConfig.kv_blocks) to bank the
+        # memory the paging exists to save. +1 for the null block.
+        self.num_blocks = (
+            int(num_blocks) if num_blocks
+            else num_slots * self.max_blocks_per_slot + 1
+        )
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must leave at least one "
+                             "allocatable block beyond the null block")
+        self.dtype = dtype
+        self.kv_dtype = kv_dtype or ""
+        if self.kv_dtype not in ("", "int8"):
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r} not in ('', 'int8')"
+            )
+        self.quantized = self.kv_dtype == "int8"
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self._registry = registry
+        self._sharding = sharding
+        self._alloc_arrays()
+        self.lengths = np.zeros((num_slots,), np.int32)
+        self.block_tables = np.full(
+            (num_slots, self.max_blocks_per_slot), NULL_BLOCK, np.int32
+        )
+        self._slot_blocks = np.zeros((num_slots,), np.int32)
+        self._free_slots = list(range(num_slots - 1, -1, -1))
+        self._free_blocks = list(range(self.num_blocks - 1, 0, -1))
+        self._refcount = np.zeros((self.num_blocks,), np.int32)
+        # Prefix cache: (parent physical id | -1, tokens tuple) -> id;
+        # reverse map for eviction; LRU order over refcount-0 cached
+        # blocks ("evictable": published but unreferenced).
+        self._cache: dict[tuple, int] = {}
+        self._cache_key: dict[int, tuple] = {}
+        self._evictable: OrderedDict[int, None] = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self._lock = threading.Lock()
+        self._publish()
+
+    # ------------------------------------------------------ device state
+
+    def _alloc_arrays(self) -> None:
+        shape = (self.num_layers, self.num_blocks, self.num_heads,
+                 self.block_size, self.head_dim)
+        store = jnp.int8 if self.quantized else self.dtype
+        kw = {} if self._sharding is None else {"device": self._sharding}
+        self.k = jnp.zeros(shape, store, **kw)
+        self.v = jnp.zeros(shape, store, **kw)
+        if self.quantized:
+            self.k_scale = jnp.ones(shape[:-1], jnp.float32, **kw)
+            self.v_scale = jnp.ones(shape[:-1], jnp.float32, **kw)
+        else:
+            self.k_scale = self.v_scale = None
+
+    def kv_state(self) -> tuple:
+        """The device-array tuple the engine's compiled steps donate
+        and return (``set_kv_state`` reassigns from the outputs)."""
+        if self.quantized:
+            return (self.k, self.v, self.k_scale, self.v_scale)
+        return (self.k, self.v)
+
+    def set_kv_state(self, state: tuple) -> None:
+        if self.quantized:
+            self.k, self.v, self.k_scale, self.v_scale = state
+        else:
+            self.k, self.v = state
+
+    def reallocate(self) -> None:
+        """Fresh zeroed device arrays after a failed donated step (the
+        ``EngineStepError`` path — the old buffers were consumed).
+        Every cached prefix lived in those buffers, so the prefix
+        cache is invalidated wholesale; slot bookkeeping is untouched
+        because the batcher fails and frees the whole in-flight set
+        right after."""
+        self._alloc_arrays()
+        with self._lock:
+            self._drop_cache_locked()
+            self._publish()
+
+    def _drop_cache_locked(self) -> None:
+        for bid in list(self._evictable):
+            self._free_blocks.append(bid)
+        self._evictable.clear()
+        self._cache.clear()
+        self._cache_key.clear()
+
+    # ------------------------------------------------------------- slots
+
+    def _reg(self):
+        return (
+            self._registry
+            if self._registry is not None
+            else registry_mod.default_registry()
+        )
+
+    def _publish(self) -> None:
+        reg = self._reg()
+        active = self.num_slots - len(self._free_slots)
+        usable = self.num_blocks - 1
+        used = int((self._refcount > 0).sum())
+        reg.gauge("serving/kv_occupancy").set(used / usable)
+        reg.gauge("serving/kv_slot_occupancy").set(active / self.num_slots)
+        reg.gauge("serving/kv_slots_active").set(active)
+        reg.gauge("serving/kv_blocks_used").set(used)
+        reg.gauge("serving/kv_blocks_total").set(usable)
+        reg.gauge("serving/kv_tokens").set(int(self.lengths.sum()))
+        reg.gauge("serving/prefix_cache_blocks").set(len(self._cache))
+
+    def alloc(self) -> int | None:
+        """Claim a free slot (None when every slot is taken). No blocks
+        are committed yet — the engine's prefill allocates exactly what
+        the prompt needs."""
+        with self._lock:
+            if not self._free_slots:
+                return None
+            slot = self._free_slots.pop()
+            self.lengths[slot] = 0
+            self.block_tables[slot, :] = NULL_BLOCK
+            self._slot_blocks[slot] = 0
+            self._publish()
+            return slot
+
+    def free(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._free_slots:  # double-free is a caller bug
+                raise ValueError(f"slot {slot} is already free")
+            for i in range(int(self._slot_blocks[slot])):
+                self._release_block_locked(int(self.block_tables[slot, i]))
+            self.block_tables[slot, :] = NULL_BLOCK
+            self._slot_blocks[slot] = 0
+            self.lengths[slot] = 0
+            self._free_slots.append(slot)
+            self._publish()
+
+    def reset(self) -> None:
+        """Release every slot and every block (post-warmup; the device
+        arrays keep their garbage — unpopulated rows are never read)."""
+        with self._lock:
+            self.lengths[:] = 0
+            self.block_tables[:, :] = NULL_BLOCK
+            self._slot_blocks[:] = 0
+            self._free_slots = list(range(self.num_slots - 1, -1, -1))
+            # Cache drop FIRST (it returns parked evictable blocks to
+            # the free list), then the wholesale rebuild — the other
+            # order would append those ids on top of a full list and
+            # hand the same physical block out twice.
+            self._drop_cache_locked()
+            self._free_blocks = list(range(self.num_blocks - 1, 0, -1))
+            self._refcount[:] = 0
+            self.prefix_hits = 0
+            self.prefix_misses = 0
+            self._publish()
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return self.num_slots - len(self._free_slots)
+
+    @property
+    def occupancy(self) -> float:
+        """Used-block fraction — what ``/health`` reports and the
+        router load-balances on. A full-slots pool of short prompts is
+        NOT full (that is the satellite fix: slot occupancy is
+        published separately as ``serving/kv_slot_occupancy``)."""
+        with self._lock:
+            return float((self._refcount > 0).sum()) / (self.num_blocks - 1)
+
+    def max_active_length(self) -> int:
+        with self._lock:
+            return int(self.lengths.max(initial=0))
+
+    # ------------------------------------------------------------ blocks
+
+    def _alloc_block_locked(self) -> int:
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        if self._evictable:
+            # Reclaim the least-recently-published unreferenced prefix
+            # block: cache reuse is an optimization, never a reason to
+            # refuse admission.
+            bid, _ = self._evictable.popitem(last=False)
+            key = self._cache_key.pop(bid)
+            del self._cache[key]
+            return bid
+        self._reg().counter("serving/kv_exhausted_total").inc()
+        log.warning(
+            "KV block pool exhausted (%d/%d blocks referenced by "
+            "active requests) — shedding",
+            int((self._refcount > 0).sum()), self.num_blocks - 1,
+        )
+        raise BlockExhausted(
+            f"KV block pool exhausted: {self.num_blocks - 1} blocks "
+            f"({self.block_size} tokens each) all referenced by active "
+            "requests — admission must shed load"
+        )
+
+    def _release_block_locked(self, bid: int) -> None:
+        if bid == NULL_BLOCK:
+            return
+        self._refcount[bid] -= 1
+        if self._refcount[bid] > 0:
+            return
+        if bid in self._cache_key:
+            self._evictable[bid] = None  # published: park, reclaimable
+        else:
+            self._free_blocks.append(bid)
+
+    def alloc_blocks(self, n: int) -> list[int]:
+        """Claim ``n`` fresh private blocks (refcount 1 each) or raise
+        :class:`BlockExhausted` having claimed none (all-or-nothing, so
+        a rejected admission leaks nothing)."""
+        with self._lock:
+            got: list[int] = []
+            try:
+                for _ in range(n):
+                    got.append(self._alloc_block_locked())
+            except BlockExhausted:
+                for bid in got:
+                    self._free_blocks.append(bid)
+                raise
+            for bid in got:
+                self._refcount[bid] = 1
+            self._publish()
+            return got
+
+    def assign(self, slot: int, blocks: list[int]) -> None:
+        """Install a slot's block table (reused prefix blocks first,
+        then its private blocks — refcounts were already taken by
+        ``prefix_lookup``/``alloc_blocks``)."""
+        with self._lock:
+            if len(blocks) > self.max_blocks_per_slot:
+                raise ValueError(
+                    f"{len(blocks)} blocks exceed the per-slot table "
+                    f"({self.max_blocks_per_slot})"
+                )
+            self.block_tables[slot, :] = NULL_BLOCK
+            self.block_tables[slot, :len(blocks)] = blocks
+            self._slot_blocks[slot] = len(blocks)
+            self._publish()
+
+    def ensure_position(self, slot: int, position: int) -> None:
+        """Grow the slot's table to cover ``position`` (one block at a
+        time during decode). Raises :class:`BlockExhausted` when the
+        pool cannot back the growth — the caller fails THAT request."""
+        need = position // self.block_size + 1
+        with self._lock:
+            have = int(self._slot_blocks[slot])
+            if need <= have:
+                return
+            if need > self.max_blocks_per_slot:
+                raise ValueError(
+                    f"position {position} exceeds max_len {self.max_len}"
+                )
+            bid = self._alloc_block_locked()
+            self._refcount[bid] = 1
+            self.block_tables[slot, have] = bid
+            self._slot_blocks[slot] = have + 1
+            self._publish()
+
+    # ------------------------------------------------------ prefix cache
+
+    def prefix_lookup(self, prompt) -> tuple[list[int], int]:
+        """Longest reusable cached prefix of ``prompt``: (physical
+        block ids with refcounts ALREADY taken, covered token count
+        ``c``). ``c`` is block-aligned and capped strictly below
+        ``len(prompt)`` — at least one tail token always prefills, so
+        the extend step has a real query row to sample the first token
+        from."""
+        if not self.prefix_cache_enabled:
+            return [], 0
+        bs = self.block_size
+        max_full = (len(prompt) - 1) // bs  # cap: tail keeps >= 1 token
+        with self._lock:
+            blocks: list[int] = []
+            parent = -1
+            for i in range(max_full):
+                block = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
+                bid = self._cache.get((parent, block))
+                if bid is None:
+                    break
+                blocks.append(bid)
+                parent = bid
+            if blocks:
+                for bid in blocks:
+                    if self._refcount[bid] == 0:
+                        self._evictable.pop(bid, None)
+                    self._refcount[bid] += 1
+                self.prefix_hits += 1
+                self._reg().counter("serving/prefix_hits").inc()
+            else:
+                self.prefix_misses += 1
+                self._reg().counter("serving/prefix_misses").inc()
+            self._publish()
+            return blocks, len(blocks) * bs
+
+    def release_prefix(self, blocks: list[int]) -> None:
+        """Undo a ``prefix_lookup``'s refcounts (the admission that
+        followed it failed before ``assign``)."""
+        with self._lock:
+            for bid in blocks:
+                self._release_block_locked(bid)
+            self._publish()
+
+    def insert_prefix(self, slot: int, prompt) -> None:
+        """Publish the slot's FULL prompt blocks for reuse. Idempotent
+        per chain link; a block already published under a different
+        physical id (a racing identical prompt) is left alone — first
+        writer wins, both copies serve."""
+        if not self.prefix_cache_enabled:
+            return
+        bs = self.block_size
+        with self._lock:
+            parent = -1
+            for i in range(len(prompt) // bs):
+                block = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
+                key = (parent, block)
+                existing = self._cache.get(key)
+                if existing is not None:
+                    parent = existing
+                    continue
+                bid = int(self.block_tables[slot, i])
+                if bid == NULL_BLOCK:
+                    break
+                self._cache[key] = bid
+                self._cache_key[bid] = key
+                parent = bid
+            self._publish()
+
+    # -------------------------------------------------- byte accounting
+
+    def bytes_per_block(self) -> int:
+        """K+V device bytes one physical block commits (int8 payload +
+        its blockwise f32 row scales when quantized)."""
+        row = self.num_heads * self.head_dim
+        if self.quantized:
+            per = self.block_size * row * 1 + self.block_size * self.num_heads * 4
+        else:
+            per = self.block_size * row * jnp.dtype(self.dtype).itemsize
+        return int(2 * self.num_layers * per)
+
+    def used_bytes(self) -> int:
+        """Cache bytes committed to the active request set — blocks
+        actually referenced, not slots claimed. The number the tier-1
+        memory-claim test compares against the dense pool's."""
+        with self._lock:
+            return int((self._refcount > 0).sum()) * self.bytes_per_block()
+
+    # ------------------------------------------------------------- stats
+
+    @property
+    def kv_bits(self) -> int:
+        return 8 if self.quantized else jnp.dtype(self.dtype).itemsize * 8
+
+    def paged_stats(self) -> dict:
+        """Numeric paged-pool fields for the schema-v6 serving stats
+        line (serving/batcher.stats_line) and the bench record."""
+        with self._lock:
+            used = int((self._refcount > 0).sum())
+            usable = self.num_blocks - 1
+            hits, misses = self.prefix_hits, self.prefix_misses
+        looked = hits + misses
+        return {
+            "block_size": self.block_size,
+            "blocks_total": usable,
+            "blocks_used": used,
+            "kv_block_occupancy": used / usable,
+            "kv_slot_occupancy": (
+                self.active_slots / self.num_slots
+            ),
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "prefix_hit_rate": (hits / looked) if looked else 0.0,
+            "kv_bits": self.kv_bits,
+        }

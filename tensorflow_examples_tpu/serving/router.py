@@ -1,0 +1,671 @@
+"""Multi-replica router tier: one endpoint over N serving replicas.
+
+PR 5 ended with one engine process per endpoint; the ROADMAP's
+millions-of-users traffic needs N replicas behind one address with the
+operational verbs a fleet actually uses (ISSUE 8). This module is that
+tier, deliberately stdlib-only like every HTTP surface in the repo:
+
+* **Load-aware dispatch** — a background thread probes each replica's
+  ``/health`` (the PR 5 frontend already publishes queue depth, KV
+  occupancy, active requests, drain state); requests go to the
+  eligible replica with the lowest load score
+  ``queue_depth + kv_occupancy`` (queue pressure dominates; the paged
+  pool's ``kv_occupancy`` is used-block fraction, so short-prompt
+  replicas correctly read as roomy — the ISSUE 8 gauge-semantics fix
+  is what makes this signal honest), ties broken by fewest dispatches.
+* **Drain-aware rollout** — ``drain(url)`` (or ``POST /drain``) stops
+  NEW dispatch to a replica while its in-flight requests finish on the
+  replica itself; a replica that starts draining on its own (SIGTERM —
+  its ``/health`` flips 503 with ``draining: true``) is detected by
+  the probe and likewise rotated out without failing anything. Roll a
+  fleet by draining one replica, restarting it, undraining, repeating.
+* **Retry-once-on-503** — a dispatch answered 503 (shed/draining) or a
+  transport failure is retried ONCE on a different replica of the same
+  set, within a per-request wall budget (``retry_budget_s``); anything
+  else (400/404/504/500) passes through untouched — the router never
+  re-runs a request a replica actually executed.
+* **Canary compare** — replicas are grouped into sets (``base`` and
+  ``canary``); a configured fraction of traffic goes to the canary
+  set and per-set latency/throughput records
+  (:meth:`Router.canary_records`) feed ``tools/run_diff.py``, whose
+  serving-aware GATE_KEYS rank TTFT/TPOT/prefix-hit regressions first.
+
+The router publishes its own observability surface
+(:class:`RouterFrontend`): ``/metrics`` (Prometheus), ``/health``,
+``/replicas``, ``/window`` (a schema-v6 ``kind="serving"`` line whose
+serving object carries the v6 router fields), and the admin verbs
+``POST /drain`` / ``POST /undrain``. ``tools/serve_fleet.py`` is the
+CLI wrapper; ``tools/serve_bench.py --router`` measures the whole tier
+and banks the ``serve_router`` record ``bench_gate`` accepts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.server
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry.serve import (
+    json_safe,
+    render_prometheus,
+)
+
+log = logging.getLogger(__name__)
+
+_MAX_BODY = 1 << 20
+_MAX_SAMPLES = 8192  # per-set latency samples kept for canary records
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    probe_interval_s: float = 0.5   # /health poll cadence per replica
+    probe_timeout_s: float = 2.0
+    request_timeout_s: float = 120.0
+    retry_budget_s: float = 10.0    # wall budget for the retry attempt
+    max_retries: int = 1            # retry-ONCE is the contract
+    unhealthy_after: int = 3        # consecutive probe failures
+    canary_fraction: float = 0.25   # traffic share when a canary set
+    #                                 is configured
+
+
+def _get_json(url: str, timeout: float) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read() or b"{}")
+        except (ValueError, OSError):
+            return e.code, {}
+    except (OSError, ValueError) as e:
+        return 0, {"error": f"{type(e).__name__}: {e}"}
+
+
+def post_json(url: str, body: dict, timeout: float) -> tuple[int, dict]:
+    """POST a JSON body, always returning ``(status, reply_dict)`` —
+    status 0 on transport failure (reset, timeout, refused, torn
+    body). The one JSON-over-HTTP client in the serving stack: the
+    dispatcher, the probe loop's writes, and tools/serve_bench.py all
+    route through it, so the status-0 contract cannot drift."""
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read() or b"{}")
+        except (ValueError, OSError):
+            return e.code, {}
+    except (OSError, ValueError) as e:
+        # Transport failure: status 0 — the dispatcher treats it like a
+        # 503 (retryable on another replica) and the probe loop will
+        # notice a dead replica on its own.
+        return 0, {"error": f"{type(e).__name__}: {e}"}
+
+
+class ReplicaState:
+    """One replica as the router sees it: probe-sourced load numbers +
+    router-side rollout state."""
+
+    def __init__(self, url: str, set_name: str = "base"):
+        self.url = url.rstrip("/")
+        self.set_name = set_name
+        self.drained = False          # router-side: operator rollout
+        self.draining_remote = False  # replica-side: its own SIGTERM
+        self.failures = 0             # consecutive probe failures
+        self.probed = False
+        self.last_probe_unix = 0.0
+        self.queue_depth = 0.0
+        self.kv_occupancy = 0.0
+        self.active_requests = 0.0
+        self.slots = 0
+        self.post_warmup_recompiles = 0
+        self.dispatched = 0
+        self.completed = 0
+        self.errors = 0
+
+    def eligible(self, unhealthy_after: int) -> bool:
+        return (
+            not self.drained
+            and not self.draining_remote
+            and self.failures < unhealthy_after
+        )
+
+    def load_score(self) -> float:
+        """Least-loaded dispatch key: queued requests dominate, KV
+        pressure (used-block fraction under paging) breaks near-ties."""
+        return float(self.queue_depth) + float(self.kv_occupancy)
+
+    def snapshot(self) -> dict:
+        return {
+            "url": self.url,
+            "set": self.set_name,
+            "drained": self.drained,
+            "draining_remote": self.draining_remote,
+            "probe_failures": self.failures,
+            "queue_depth": self.queue_depth,
+            "kv_occupancy": self.kv_occupancy,
+            "active_requests": self.active_requests,
+            "slots": self.slots,
+            "post_warmup_recompiles": self.post_warmup_recompiles,
+            "dispatched": self.dispatched,
+            "completed": self.completed,
+            "errors": self.errors,
+        }
+
+
+class _SetStats:
+    """Per-replica-set client-side latency aggregates (the canary
+    compare's raw material). Replies already carry the replica-measured
+    ttft_s/total_s; tokens give TPOT."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.completed = 0
+        self.errors = 0
+        self.ttft: list[float] = []
+        self.tpot: list[float] = []
+        self.e2e: list[float] = []
+        self.tokens = 0
+        self.t0 = time.monotonic()
+
+    def record(self, status: int, reply: dict) -> None:
+        with self.lock:
+            self.requests += 1
+            if status != 200:
+                self.errors += 1
+                return
+            self.completed += 1
+            toks = len(reply.get("tokens") or ())
+            self.tokens += toks
+            ttft = reply.get("ttft_s")
+            total = reply.get("total_s")
+            if isinstance(ttft, (int, float)):
+                self.ttft.append(float(ttft))
+                if isinstance(total, (int, float)) and toks > 1:
+                    self.tpot.append(
+                        (float(total) - float(ttft)) / (toks - 1)
+                    )
+            if isinstance(total, (int, float)):
+                self.e2e.append(float(total))
+            for samples in (self.ttft, self.tpot, self.e2e):
+                if len(samples) > _MAX_SAMPLES:
+                    del samples[: len(samples) - _MAX_SAMPLES]
+
+    @staticmethod
+    def _pct(samples: list[float], q: float) -> float | None:
+        if not samples:
+            return None
+        s = sorted(samples)
+        idx = max(0, min(len(s) - 1, round(q / 100 * len(s) + 0.5) - 1))
+        return round(s[int(idx)] * 1e3, 3)
+
+    def record_doc(self, set_name: str) -> dict:
+        with self.lock:
+            wall = max(time.monotonic() - self.t0, 1e-9)
+            return {
+                "bench": "serve_router_set",
+                "set": set_name,
+                "requests": self.requests,
+                "completed": self.completed,
+                "errors": self.errors,
+                "generated_tokens": self.tokens,
+                "req_per_s": round(self.completed / wall, 3),
+                "tok_per_s": round(self.tokens / wall, 3),
+                "ttft_p50_ms": self._pct(self.ttft, 50),
+                "ttft_p95_ms": self._pct(self.ttft, 95),
+                "tpot_p50_ms": self._pct(self.tpot, 50),
+                "tpot_p95_ms": self._pct(self.tpot, 95),
+                "e2e_p95_ms": self._pct(self.e2e, 95),
+            }
+
+
+class Router:
+    """Dispatcher + probe loop over replica sets (no sockets of its
+    own — :class:`RouterFrontend` is the HTTP surface; tests drive
+    ``handle()`` directly too)."""
+
+    def __init__(
+        self,
+        replicas: list[str],
+        *,
+        canary: list[str] | None = None,
+        cfg: RouterConfig | None = None,
+        registry=None,
+    ):
+        if not replicas:
+            raise ValueError("router needs at least one replica URL")
+        self.cfg = cfg or RouterConfig()
+        self.registry = (
+            registry if registry is not None
+            else registry_mod.MetricsRegistry()
+        )
+        self.replicas = [ReplicaState(u, "base") for u in replicas]
+        self.replicas += [
+            ReplicaState(u, "canary") for u in (canary or [])
+        ]
+        self.has_canary = any(
+            r.set_name == "canary" for r in self.replicas
+        )
+        self._set_stats = {"base": _SetStats(), "canary": _SetStats()}
+        self._lock = threading.Lock()
+        self._req_counter = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._start_unix = time.time()
+
+    # ------------------------------------------------------------ probes
+
+    def probe_once(self) -> None:
+        """One synchronous sweep (the background loop's body; tests
+        call it directly for determinism)."""
+        for r in self.replicas:
+            status, body = _get_json(
+                r.url + "/health", self.cfg.probe_timeout_s
+            )
+            r.last_probe_unix = time.time()
+            if status == 0:
+                r.failures += 1
+                if r.failures == self.cfg.unhealthy_after:
+                    log.warning(
+                        "replica %s unreachable after %d probes — "
+                        "rotating out", r.url, r.failures,
+                    )
+                continue
+            # Any HTTP answer means the process is alive; a 503 with
+            # draining=true is the replica's own drain, not a failure.
+            r.failures = 0
+            r.probed = True
+            r.draining_remote = bool(body.get("draining"))
+            for field in ("queue_depth", "kv_occupancy",
+                          "active_requests"):
+                v = body.get(field)
+                if isinstance(v, (int, float)):
+                    setattr(r, field, float(v))
+            for field in ("slots", "post_warmup_recompiles"):
+                v = body.get(field)
+                if isinstance(v, (int, float)):
+                    setattr(r, field, int(v))
+        self.registry.gauge("router/replicas_eligible").set(
+            sum(r.eligible(self.cfg.unhealthy_after)
+                for r in self.replicas)
+        )
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the probe must survive
+                log.exception("replica probe sweep failed")
+            self._stop.wait(self.cfg.probe_interval_s)
+
+    def start(self) -> "Router":
+        self.probe_once()  # synchronous first sweep: never dispatch blind
+        self._thread = threading.Thread(
+            target=self._probe_loop, name="router-probe", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ---------------------------------------------------------- rollout
+
+    def _find(self, url: str) -> ReplicaState | None:
+        url = url.rstrip("/")
+        for r in self.replicas:
+            if r.url == url:
+                return r
+        return None
+
+    def drain(self, url: str) -> bool:
+        """Stop dispatching to ``url`` (in-flight requests finish on
+        the replica; nothing is cancelled). The rollout verb."""
+        r = self._find(url)
+        if r is None:
+            return False
+        r.drained = True
+        log.info("replica %s drained (router-side)", r.url)
+        return True
+
+    def undrain(self, url: str) -> bool:
+        r = self._find(url)
+        if r is None:
+            return False
+        r.drained = False
+        r.failures = 0
+        return True
+
+    # --------------------------------------------------------- dispatch
+
+    def pick(self, *, set_name: str | None = None,
+             exclude: tuple = ()) -> ReplicaState | None:
+        """Least-loaded eligible replica (of ``set_name`` when the
+        canary split is routing), ties broken by fewest dispatches."""
+        with self._lock:
+            pool = [
+                r for r in self.replicas
+                if r.eligible(self.cfg.unhealthy_after)
+                and r not in exclude
+                and (set_name is None or r.set_name == set_name)
+            ]
+            if not pool:
+                return None
+            best = min(
+                pool, key=lambda r: (r.load_score(), r.dispatched)
+            )
+            best.dispatched += 1
+            return best
+
+    def _route_set(self) -> str | None:
+        """Which set this request goes to (None = no split): the canary
+        set receives ``canary_fraction`` of traffic, interleaved
+        deterministically rather than sampled."""
+        if not self.has_canary:
+            return None
+        with self._lock:
+            n = self._req_counter
+            self._req_counter += 1
+        f = min(max(self.cfg.canary_fraction, 0.0), 1.0)
+        return "canary" if int((n + 1) * f) != int(n * f) else "base"
+
+    def handle(self, body: dict, *, kind: str) -> tuple[int, dict]:
+        """Dispatch one generate/classify request: least-loaded pick,
+        retry once on 503/transport failure (same set, different
+        replica, within the per-request budget)."""
+        reg = self.registry
+        reg.counter("router/requests_total").inc()
+        set_name = self._route_set()
+        t0 = time.monotonic()
+        tried: list[ReplicaState] = []
+        attempts = 0
+        while True:
+            r = self.pick(set_name=set_name, exclude=tuple(tried))
+            if r is None and tried and set_name is not None:
+                # The preferred set has no second replica: the retry
+                # may cross sets rather than fail the request (the
+                # canary compare just loses one sample).
+                r = self.pick(exclude=tuple(tried))
+            if r is None:
+                reg.counter("router/no_replica_total").inc()
+                status, reply = 503, {
+                    "error": "no live replica available", "retry": True,
+                }
+                break
+            tried.append(r)
+            reg.counter("router/dispatched_total").inc()
+            status, reply = post_json(
+                r.url + "/" + kind, body, self.cfg.request_timeout_s
+            )
+            if status == 200:
+                r.completed += 1
+                break
+            if status in (0, 503):
+                r.errors += 1
+                if status == 0:
+                    r.failures += 1
+                attempts += 1
+                within_budget = (
+                    time.monotonic() - t0 < self.cfg.retry_budget_s
+                )
+                if attempts <= self.cfg.max_retries and within_budget:
+                    reg.counter("router/retries_total").inc()
+                    continue
+                status = 503
+                break
+            # 400/404/500/504: the replica processed (or rejected) the
+            # request — never re-run it elsewhere.
+            r.errors += 1
+            break
+        stats = self._set_stats[
+            (tried[-1].set_name if tried else None) or set_name or "base"
+        ]
+        stats.record(status, reply)
+        self.registry.histogram("router/e2e").record(
+            time.monotonic() - t0
+        )
+        return status, reply
+
+    # ------------------------------------------------------------ stats
+
+    def canary_records(self) -> tuple[dict, dict]:
+        """(base record, canary record) — two ``serve_router_set``
+        docs ``tools/run_diff.py`` compares directly (its load_record
+        accepts bench records; the serving GATE_KEYS rank TTFT/TPOT/
+        prefix-hit regressions first)."""
+        return (
+            self._set_stats["base"].record_doc("base"),
+            self._set_stats["canary"].record_doc("canary"),
+        )
+
+    def stats_line(self) -> dict:
+        """A schema-v6 ``kind="serving"`` line for the router process:
+        fleet-aggregated serving object plus the v6 router fields."""
+        counters = {
+            k: v for k, v in self.registry.counter_values().items()
+            if k.startswith("router/")
+        }
+        gauges = {
+            k: v for k, v in self.registry.gauge_values().items()
+            if k.startswith("router/")
+        }
+        probed = [r for r in self.replicas if r.probed]
+        occ = [r.kv_occupancy for r in probed]
+        serving = {
+            "active_requests": int(
+                sum(r.active_requests for r in probed)
+            ),
+            "queue_depth": int(sum(r.queue_depth for r in probed)),
+            "slots": int(sum(r.slots for r in probed)),
+            "kv_occupancy": (sum(occ) / len(occ)) if occ else 0.0,
+            "post_warmup_recompiles": int(
+                sum(r.post_warmup_recompiles for r in probed)
+            ),
+            "draining": 0,
+            "replicas": len(self.replicas),
+            "router_dispatched": int(
+                counters.get("router/dispatched_total", 0)
+            ),
+            "router_retries": int(
+                counters.get("router/retries_total", 0)
+            ),
+            "router_no_replica": int(
+                counters.get("router/no_replica_total", 0)
+            ),
+        }
+        return {
+            "schema_version": schema.SERVING_SCHEMA_VERSION,
+            "kind": "serving",
+            "step": serving["router_dispatched"],
+            "time_unix": time.time(),
+            "session_start_unix": self._start_unix,
+            "host": 0,
+            "metrics": {},
+            "counters": counters,
+            "gauges": gauges,
+            "derived": {},
+            "serving": serving,
+        }
+
+    def health_payload(self) -> tuple[int, dict]:
+        eligible = [
+            r for r in self.replicas
+            if r.eligible(self.cfg.unhealthy_after)
+        ]
+        body = {
+            "ok": bool(eligible),
+            "role": "router",
+            "replicas": len(self.replicas),
+            "eligible": len(eligible),
+            "sets": sorted({r.set_name for r in self.replicas}),
+        }
+        return (200 if body["ok"] else 503), body
+
+
+class RouterFrontend:
+    """The router's HTTP surface: proxied POST /generate //classify,
+    GET /metrics //health //replicas //window (+ /canary with a canary
+    set), admin POST /drain //undrain {"replica": url}."""
+
+    def __init__(self, router: Router, *, port: int = 0,
+                 bind_host: str = ""):
+        self.router = router
+        self.requested_port = int(port)
+        self.bind_host = bind_host
+        self.port: int | None = None
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    def start(self) -> "RouterFrontend":
+        router = self.router
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, status, content_type, payload: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _send_json(self, status, obj):
+                self._send(
+                    status,
+                    "application/json",
+                    (json.dumps(json_safe(obj)) + "\n").encode(),
+                )
+
+            def _body(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    return None
+                if n < 0 or n > _MAX_BODY:
+                    return None
+                try:
+                    return json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    return None
+
+            def do_POST(self):  # noqa: N802 - http.server contract
+                path = self.path.split("?", 1)[0].rstrip("/")
+                try:
+                    body = self._body()
+                    if body is None or not isinstance(body, dict):
+                        self._send_json(
+                            400, {"error": "malformed JSON body"}
+                        )
+                        return
+                    if path in ("/generate", "/classify"):
+                        status, reply = router.handle(
+                            body, kind=path[1:]
+                        )
+                        self._send_json(status, reply)
+                    elif path in ("/drain", "/undrain"):
+                        url = body.get("replica", "")
+                        op = (
+                            router.drain if path == "/drain"
+                            else router.undrain
+                        )
+                        if not isinstance(url, str) or not op(url):
+                            self._send_json(
+                                404,
+                                {"error": f"unknown replica {url!r}"},
+                            )
+                        else:
+                            self._send_json(
+                                200, {"ok": True, "replica": url}
+                            )
+                    else:
+                        self._send_json(
+                            404,
+                            {"error": "POST: /generate /classify "
+                                      "/drain /undrain"},
+                        )
+                except ConnectionError:
+                    pass
+
+            def do_GET(self):  # noqa: N802 - http.server contract
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            render_prometheus(router.registry).encode(),
+                        )
+                    elif path == "/health":
+                        self._send_json(*router.health_payload())
+                    elif path == "/replicas":
+                        self._send_json(
+                            200,
+                            {"replicas": [
+                                r.snapshot() for r in router.replicas
+                            ]},
+                        )
+                    elif path == "/window":
+                        self._send_json(200, router.stats_line())
+                    elif path == "/canary":
+                        base, canary = router.canary_records()
+                        self._send_json(
+                            200, {"base": base, "canary": canary}
+                        )
+                    else:
+                        self._send(
+                            404,
+                            "text/plain; charset=utf-8",
+                            b"GET: /metrics /health /replicas /window "
+                            b"/canary   POST: /generate /classify "
+                            b"/drain /undrain\n",
+                        )
+                except ConnectionError:
+                    pass
+
+            def log_message(self, fmt, *args):  # quiet under load
+                log.debug("router frontend: " + fmt, *args)
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.bind_host, self.requested_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="router-frontend",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info(
+            "router live on port %d over %d replica(s)",
+            self.port, len(self.router.replicas),
+        )
+        return self
+
+    def url(self, path: str = "/generate") -> str:
+        host = self.bind_host or "127.0.0.1"
+        return f"http://{host}:{self.port}{path}"
+
+    def close(self) -> None:
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)

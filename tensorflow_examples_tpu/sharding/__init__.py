@@ -34,6 +34,7 @@ from tensorflow_examples_tpu.sharding.resolve import (
     ResolvedSharding,
     resolve_params,
     state_shardings,
+    verify_digest_agreement,
     zero1_spec,
 )
 
@@ -45,5 +46,6 @@ __all__ = [
     "spec_from_json",
     "spec_to_json",
     "state_shardings",
+    "verify_digest_agreement",
     "zero1_spec",
 ]

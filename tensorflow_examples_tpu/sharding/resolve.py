@@ -186,6 +186,75 @@ def resolve_params(
 # ----------------------------------------------------------------- ZeRO-1
 
 
+def verify_digest_agreement(
+    digest: str,
+    *,
+    allgather=None,
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> None:
+    """Fail fast when the fleet disagrees on param placement (ISSUE 8
+    satellite, ROADMAP 1d).
+
+    ``workdir/sharding.json`` is written by process 0 only and the
+    restore-time rules check is per-process: a host launched with a
+    stale config file or drifted flags would sail past its own local
+    validation and corrupt the run at the first collective (or, worse,
+    silently train under a different layout). Every process allgathers
+    its placement digest at fit start — a tiny fixed-shape collective,
+    same discipline as ``telemetry/fleet.py`` — and a mismatch raises
+    :class:`~...config.ShardingMismatchError` NAMING the disagreeing
+    host(s) and both digests, before any restore or step runs.
+
+    ``allgather``/``process_index``/``process_count`` are injectable
+    for tests (mirroring ``FleetMonitor``); single-process runs return
+    immediately without importing multihost machinery.
+    """
+    if process_count is None:
+        import jax
+
+        process_count = jax.process_count()
+    if process_count <= 1:
+        return
+    if process_index is None:
+        import jax
+
+        process_index = jax.process_index()
+    if allgather is None:
+        from jax.experimental import multihost_utils
+
+        allgather = multihost_utils.process_allgather
+    # Fixed-shape wire format: the 16-hex-char digest as 8 bytes.
+    local = np.frombuffer(bytes.fromhex(digest), np.uint8).astype(
+        np.int32
+    )
+    matrix = np.asarray(allgather(local), np.int32).reshape(
+        process_count, local.size
+    )
+    mismatched = [
+        (host, bytes(matrix[host].astype(np.uint8)).hex())
+        for host in range(process_count)
+        if not np.array_equal(matrix[host], local)
+    ]
+    if not mismatched:
+        return
+    from tensorflow_examples_tpu.sharding.config import (
+        ShardingMismatchError,
+    )
+
+    shown = ", ".join(f"host {h}: {d}" for h, d in mismatched[:8])
+    more = (
+        f" (and {len(mismatched) - 8} more)" if len(mismatched) > 8 else ""
+    )
+    raise ShardingMismatchError(
+        f"param-sharding digest disagrees across the fleet: host "
+        f"{process_index} resolved {digest} but {shown}{more}. Every "
+        "process must run the same rules/config — check for a stale "
+        "sharding.json or drifted flags on the named host(s) before "
+        "any checkpoint is touched."
+    )
+
+
 def zero1_spec(shape: tuple, mesh: Mesh, batch_axes: tuple) -> NamedSharding | None:
     """ZeRO-1 moment spec: shard the largest evenly-divisible dim over
     the batch axes (dim 0 is often tiny — e.g. conv kernel height).

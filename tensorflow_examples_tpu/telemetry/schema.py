@@ -1,5 +1,7 @@
 """Self-describing JSONL metrics schema (ISSUE 2 CI satellite; v2 in
-ISSUE 3; v3 in ISSUE 4; v4 in ISSUE 5; v5 in ISSUE 7).
+ISSUE 3; v3 in ISSUE 4; v4 in ISSUE 5; v5 in ISSUE 7; v6 in ISSUE 8 —
+paged-KV block/prefix-cache fields and router-tier fields on the
+``serving`` object, see ``SERVING_KEYS_V6``).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -98,14 +100,19 @@ from typing import Any
 # digest). SCHEMA_VERSION is what the trainer hub stamps.
 SCHEMA_VERSION = 5
 
-# Version 4 (ISSUE 5): the serving stack's request-side line —
-# serving/batcher.py stamps SERVING_SCHEMA_VERSION on its
-# ``kind="serving"`` stats lines (a v3-shaped line plus a required
-# "serving" object: active_requests / queue_depth / kv_occupancy /
-# post_warmup_recompiles / draining, all numeric).
-SERVING_SCHEMA_VERSION = 4
+# Version 6 (ISSUE 8): additive — the serving object may carry
+# paged-KV fields (block_size / blocks_total / blocks_used /
+# kv_block_occupancy / kv_slot_occupancy / prefix_hits /
+# prefix_misses / prefix_hit_rate / kv_bits) and router-tier fields
+# (replicas / router_dispatched / router_retries / router_no_replica),
+# all numeric. serving/batcher.py and serving/router.py stamp
+# SERVING_SCHEMA_VERSION on their ``kind="serving"`` stats lines (a
+# v3-shaped line plus the required "serving" object introduced in v4:
+# active_requests / queue_depth / slots / kv_occupancy /
+# post_warmup_recompiles / draining).
+SERVING_SCHEMA_VERSION = 6
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -137,6 +144,17 @@ SHARDING_KEYS = ("mesh_shape", "param_sharding_digest")
 # serving/batcher.py stats_line; every one is numeric).
 SERVING_KEYS = ("active_requests", "queue_depth", "slots",
                 "kv_occupancy", "post_warmup_recompiles", "draining")
+
+# v6-only serving-object keys (optional on write — a dense-pool line
+# carries none of the paged fields, a single-engine line none of the
+# router fields — but FORBIDDEN on v4/v5 serving lines: a "v4" line
+# carrying them is a mislabeled v6 line, same rule as every earlier
+# version bump's top-level objects).
+SERVING_KEYS_V6 = ("block_size", "blocks_total", "blocks_used",
+                   "kv_block_occupancy", "kv_slot_occupancy",
+                   "prefix_hits", "prefix_misses", "prefix_hit_rate",
+                   "kv_bits", "replicas", "router_dispatched",
+                   "router_retries", "router_no_replica")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -391,6 +409,13 @@ def validate_line(obj: Any) -> list[str]:
                     problems.append(
                         f"serving object is missing required key {key!r}"
                     )
+            if version < 6:
+                for key in SERVING_KEYS_V6:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v6 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
     elif "serving" in obj:
         problems.append("serving object on a non-serving line")
 

@@ -65,6 +65,7 @@ from tensorflow_examples_tpu.sharding import (
     ShardingMismatchError,
     resolve_params,
     state_shardings,
+    verify_digest_agreement,
 )
 from tensorflow_examples_tpu.data.prefetch import (
     bundle_batches,
@@ -553,6 +554,14 @@ class Trainer:
                             server.requested_port,
                             e,
                         )
+
+            # Cross-host digest agreement BEFORE anything else touches
+            # state (ISSUE 8 satellite, ROADMAP 1d): sharding.json is
+            # written by process 0 only and _sync_sharding_json
+            # validates per-process — a host running drifted rules
+            # would pass its own check and diverge at the first
+            # collective. The allgather fails fast NAMING the host.
+            verify_digest_agreement(self.sharding_digest())
 
             if cfg.workdir:
                 self._ckpt = CheckpointManager(cfg.workdir)

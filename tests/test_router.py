@@ -1,0 +1,385 @@
+"""Router tier (ISSUE 8): load-aware dispatch, drain-aware rollout,
+retry-once-on-503, canary compare via tools/run_diff.py.
+
+The load-bearing test is
+:class:`TestDrainMidLoad::test_drain_one_replica_zero_failed_requests`
+— the acceptance contract: 2 replicas under concurrent load, one
+drained mid-stream, every request completes 200 and the drained
+replica takes no new dispatch.
+
+Replicas here are device-free fake engines behind REAL HTTP frontends:
+the router only ever speaks HTTP, so this is end-to-end for everything
+the router tier owns while staying O(ms) per request. The real-engine
+tier (2 warmed paged replicas behind the router over HTTP) is covered
+by ``serve_bench --smoke --router`` in tests/test_tools.py.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflow_examples_tpu.serving import kv_cache
+from tensorflow_examples_tpu.serving.batcher import (
+    ContinuousBatcher,
+    Request,
+)
+from tensorflow_examples_tpu.serving.engine import ServeConfig
+from tensorflow_examples_tpu.serving.frontend import ServingFrontend
+from tensorflow_examples_tpu.serving.router import (
+    ReplicaState,
+    Router,
+    RouterConfig,
+    RouterFrontend,
+)
+from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+class _FakeEngine:
+    """Deterministic device-free engine (mirrors test_serving's): token
+    stream is prompt[-1]+1, +2, ... — so any replica serves identical
+    output and the router's routing cannot change results."""
+
+    def __init__(self, *, max_slots=4, max_queue=32, max_len=64,
+                 step_delay=0.0):
+        self.cfg = ServeConfig(
+            max_slots=max_slots, max_queue=max_queue, max_delay_s=0.0,
+            request_timeout_s=30.0,
+        )
+        import serve_bench
+
+        from tensorflow_examples_tpu.models import transformer
+
+        base = dict(serve_bench.SMOKE_MODEL)
+        base["max_len"] = max_len
+        self.model_cfg = transformer.TransformerConfig(**base)
+        self.registry = MetricsRegistry()
+        self.pool = kv_cache.KVCachePool(
+            num_layers=1, num_slots=max_slots, num_heads=1,
+            max_len=max_len, head_dim=2, registry=self.registry,
+        )
+        self.step_delay = step_delay
+        self.warmed = True
+
+    def post_warmup_recompiles(self):
+        return 0
+
+    def prefill(self, slot, prompt, *, seed=0, temperature=0.0, top_k=0):
+        self.pool.lengths[slot] = len(prompt)
+        last = np.zeros((self.model_cfg.vocab_size,), np.float32)
+        return (prompt[-1] + 1) % self.model_cfg.vocab_size, last
+
+    def decode(self, entries):
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        out = {}
+        for slot, token, _seed, _temp, _tk in entries:
+            self.pool.lengths[slot] += 1
+            out[slot] = (token + 1) % self.model_cfg.vocab_size
+        return out
+
+
+def _replica(**kw):
+    eng = _FakeEngine(**kw)
+    batcher = ContinuousBatcher(eng).start()
+    frontend = ServingFrontend(batcher, port=0).start()
+    return eng, batcher, frontend
+
+
+def _close(replicas):
+    for _, batcher, frontend in replicas:
+        batcher.close(drain=True)
+        frontend.close()
+
+
+def _post(url, body, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestPick:
+    """Dispatch policy units — no sockets, states set by hand."""
+
+    def _router(self):
+        r = Router(["http://a:1", "http://b:2"])
+        for rep in r.replicas:
+            rep.probed = True
+        return r
+
+    def test_least_loaded_by_queue_then_occupancy(self):
+        r = self._router()
+        a, b = r.replicas
+        a.queue_depth, b.queue_depth = 3.0, 0.0
+        assert r.pick() is b
+        a.queue_depth = b.queue_depth = 0.0
+        a.kv_occupancy, b.kv_occupancy = 0.9, 0.1
+        assert r.pick() is b
+
+    def test_tie_breaks_to_fewest_dispatched(self):
+        r = self._router()
+        a, b = r.replicas
+        picked = {r.pick().url for _ in range(2)}
+        assert picked == {a.url, b.url}  # alternates on the tiebreak
+
+    def test_drained_and_unhealthy_excluded(self):
+        r = self._router()
+        a, b = r.replicas
+        a.drained = True
+        assert r.pick() is b
+        b.failures = r.cfg.unhealthy_after
+        assert r.pick() is None
+        assert r.undrain(a.url) and r.pick() is a
+
+    def test_remote_draining_excluded(self):
+        r = self._router()
+        a, b = r.replicas
+        a.draining_remote = True
+        for _ in range(3):
+            assert r.pick() is b
+
+    def test_replica_state_snapshot_shape(self):
+        s = ReplicaState("http://x:9/").snapshot()
+        assert s["url"] == "http://x:9" and s["set"] == "base"
+
+
+class TestRouterE2E:
+    @pytest.mark.timeout(120)
+    def test_dispatch_spreads_and_proxies(self):
+        replicas = [_replica(), _replica()]
+        urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe in replicas]
+        router = Router(
+            urls, cfg=RouterConfig(probe_interval_s=0.05)
+        ).start()
+        rfront = RouterFrontend(router, port=0).start()
+        try:
+            for i in range(8):
+                status, reply = _post(
+                    rfront.url("/generate"),
+                    {"prompt": [10 + i], "max_new_tokens": 3},
+                )
+                assert status == 200
+                assert reply["tokens"] == [
+                    (10 + i + k + 1) % 211 for k in range(3)
+                ]
+            # Both replicas took work (least-loaded ties alternate).
+            assert all(r.dispatched > 0 for r in router.replicas)
+            # Observability surface.
+            line = router.stats_line()
+            assert schema.validate_line(json.loads(json.dumps(line))) == []
+            assert line["serving"]["replicas"] == 2
+            assert line["serving"]["router_dispatched"] == 8
+            with urllib.request.urlopen(
+                rfront.url("/replicas"), timeout=10
+            ) as resp:
+                snap = json.loads(resp.read())
+            assert len(snap["replicas"]) == 2
+            with urllib.request.urlopen(
+                rfront.url("/health"), timeout=10
+            ) as resp:
+                health = json.loads(resp.read())
+            assert health["ok"] and health["eligible"] == 2
+        finally:
+            rfront.close()
+            router.close()
+            _close(replicas)
+
+    @pytest.mark.timeout(120)
+    def test_retry_once_on_503_lands_on_other_replica(self):
+        """Replica A is draining (its frontend answers 503) but the
+        router has not probed since: the dispatch hits A, gets the
+        503, and retries ONCE onto B — the client sees 200."""
+        replicas = [_replica(), _replica()]
+        urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe in replicas]
+        # No probe thread (start() not called): the router's view is
+        # frozen at one manual sweep, so it provably dispatches to the
+        # already-draining replica first.
+        router = Router(urls, cfg=RouterConfig())
+        router.probe_once()
+        try:
+            a, b = router.replicas
+            replicas[0][1].close(drain=True)  # A drains itself
+            # Force the first pick onto A (fewest dispatched).
+            b.dispatched = 5
+            status, reply = router.handle(
+                {"prompt": [7], "max_new_tokens": 2}, kind="generate"
+            )
+            assert status == 200 and reply["tokens"] == [8, 9]
+            assert a.errors == 1
+            counters = router.registry.counter_values()
+            assert counters["router/retries_total"] == 1
+        finally:
+            router.close()
+            _close(replicas[1:])
+            replicas[0][2].close()
+
+    @pytest.mark.timeout(120)
+    def test_no_replica_is_503_not_hang(self):
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe in replicas]
+        router = Router(
+            urls, cfg=RouterConfig(probe_interval_s=60.0)
+        ).start()
+        try:
+            router.drain(urls[0])
+            status, reply = router.handle(
+                {"prompt": [1]}, kind="generate"
+            )
+            assert status == 503 and reply.get("retry")
+            assert (
+                router.registry.counter_values()[
+                    "router/no_replica_total"
+                ] == 1
+            )
+        finally:
+            router.close()
+            _close(replicas)
+
+
+class TestDrainMidLoad:
+    @pytest.mark.timeout(180)
+    def test_drain_one_replica_zero_failed_requests(self):
+        """Acceptance: 2 replicas, concurrent load, one drained via the
+        admin endpoint mid-stream -> every request completes, zero
+        failures, and the drained replica takes no dispatch after the
+        drain settles."""
+        replicas = [
+            _replica(step_delay=0.01), _replica(step_delay=0.01)
+        ]
+        urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe in replicas]
+        router = Router(
+            urls, cfg=RouterConfig(probe_interval_s=0.05)
+        ).start()
+        rfront = RouterFrontend(router, port=0).start()
+        n, statuses = 24, [None] * 24
+        drained_at_dispatch: list[int] = []
+        next_i = [0]
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = next_i[0]
+                    if i >= n:
+                        return
+                    next_i[0] += 1
+                if i == 8:
+                    # Mid-load rollout drain via the admin verb.
+                    status, reply = _post(
+                        rfront.url("/drain"), {"replica": urls[0]}
+                    )
+                    assert status == 200 and reply["ok"]
+                    drained_at_dispatch.append(
+                        router.replicas[0].dispatched
+                    )
+                s, _ = _post(
+                    rfront.url("/generate"),
+                    {"prompt": [i % 200], "max_new_tokens": 4},
+                )
+                statuses[i] = s
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(4)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert statuses.count(200) == n, statuses  # ZERO failures
+            # Post-drain, replica 0 took at most the requests already
+            # being picked concurrently with the drain call.
+            assert router.replicas[0].dispatched <= (
+                drained_at_dispatch[0] + 4
+            )
+            # ...and the survivor carried the rest.
+            assert router.replicas[1].dispatched >= n // 2
+        finally:
+            rfront.close()
+            router.close()
+            _close(replicas)
+
+
+class TestCanary:
+    @pytest.mark.timeout(120)
+    def test_canary_split_and_run_diff_record(self, tmp_path):
+        """Acceptance: canary compare produces a run_diff doc — two
+        per-set records through tools/run_diff.py with the serving
+        keys ranked."""
+        import run_diff
+
+        replicas = [_replica(), _replica(step_delay=0.01)]
+        urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe in replicas]
+        router = Router(
+            [urls[0]], canary=[urls[1]],
+            cfg=RouterConfig(
+                probe_interval_s=0.05, canary_fraction=0.5
+            ),
+        ).start()
+        rfront = RouterFrontend(router, port=0).start()
+        try:
+            for i in range(10):
+                status, _ = _post(
+                    rfront.url("/generate"),
+                    {"prompt": [i + 1], "max_new_tokens": 3},
+                )
+                assert status == 200
+            base, canary = router.canary_records()
+            assert base["completed"] == 5 and canary["completed"] == 5
+            assert base["set"] == "base" and canary["set"] == "canary"
+            # /canary serves the same records.
+            with urllib.request.urlopen(
+                rfront.url("/canary"), timeout=10
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["base"]["completed"] == 5
+        finally:
+            rfront.close()
+            router.close()
+            _close(replicas)
+        a_path, b_path = tmp_path / "base.json", tmp_path / "canary.json"
+        a_path.write_text(json.dumps(base))
+        b_path.write_text(json.dumps(canary))
+        out = tmp_path / "diff.json"
+        rc = run_diff.main([str(a_path), str(b_path), "--json", str(out)])
+        assert rc == 0
+        with open(out) as f:
+            diff = json.load(f)
+        ranked = {d["metric"] for d in diff["ranked"]}
+        assert "ttft_p95_ms" in ranked and "tok_per_s" in ranked
+        # The canary's gateable serving figures are flattened on top
+        # (bench_gate --record consumes this doc directly).
+        assert diff["ttft_p95_ms"] == canary["ttft_p95_ms"]
+
+
+class TestRouterSchema:
+    def test_v6_serving_keys_flagged_on_older_versions(self):
+        r = Router(["http://a:1"])
+        line = json.loads(json.dumps(r.stats_line()))
+        assert schema.validate_line(line) == []
+        v5 = dict(line, schema_version=5)
+        assert any(
+            "v6 serving key" in p for p in schema.validate_line(v5)
+        )
+        v4 = dict(line, schema_version=4)
+        assert any(
+            "v6 serving key" in p for p in schema.validate_line(v4)
+        )

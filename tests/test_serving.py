@@ -26,13 +26,17 @@ import numpy as np
 import pytest
 
 from tensorflow_examples_tpu.models import transformer
-from tensorflow_examples_tpu.serving import kv_cache
+from tensorflow_examples_tpu.serving import kv_cache, paged_kv
 from tensorflow_examples_tpu.serving.batcher import (
     ContinuousBatcher,
     DeadlineExceeded,
     Draining,
     QueueFull,
     Request,
+)
+from tensorflow_examples_tpu.serving.paged_kv import (
+    BlockExhausted,
+    PagedKVPool,
 )
 from tensorflow_examples_tpu.serving.engine import (
     EngineStepError,
@@ -800,6 +804,385 @@ class TestFrontend:
         eng.gate.set()
         b.start()
         b.close(drain=True)
+
+
+# ------------------------------------------------------- paged KV (ISSUE 8)
+
+
+def _tiny_params(cfg):
+    import jax
+    import jax.numpy as jnp
+
+    model = transformer.Transformer(cfg)
+    return model.init(
+        {"params": jax.random.PRNGKey(1)}, jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def paged_engine():
+    """One warmed PAGED engine (fp32, block 8) for the module — same
+    smoke model and ladder floors as ``warm_engine``, so every paged
+    claim is measured against the exact dense baseline."""
+    cfg = tiny_cfg()
+    engine = InferenceEngine(
+        cfg,
+        _tiny_params(cfg),
+        cfg=ServeConfig(
+            max_slots=4,
+            prefill_bucket_floor=16,
+            kv_bucket_floor=32,
+            max_queue=64,
+            max_delay_s=0.002,
+            kv_block_size=8,
+        ),
+        registry=MetricsRegistry(),
+    )
+    counts = engine.warmup()
+    assert sum(counts.values()) == engine.expected_compiles()
+    yield engine
+    assert engine.pool.active_slots == 0, "a test leaked KV slots"
+
+
+class TestPagedPool:
+    def _pool(self, *, slots=3, blocks=0, block=8, registry=None, **kw):
+        return PagedKVPool(
+            num_layers=1, num_slots=slots, num_heads=2, max_len=64,
+            head_dim=4, block_size=block, num_blocks=blocks,
+            registry=registry or MetricsRegistry(), **kw,
+        )
+
+    def test_block_size_must_divide_max_len(self):
+        with pytest.raises(ValueError, match="power of two"):
+            self._pool(block=12)
+        with pytest.raises(ValueError, match="divide max_len"):
+            PagedKVPool(
+                num_layers=1, num_slots=2, num_heads=2, max_len=60,
+                head_dim=4, block_size=8, registry=MetricsRegistry(),
+            )
+
+    def test_alloc_assign_free_returns_blocks(self):
+        pool = self._pool()
+        slot = pool.alloc()
+        blocks = pool.alloc_blocks(3)
+        assert paged_kv.NULL_BLOCK not in blocks
+        pool.assign(slot, blocks)
+        assert pool.used_bytes() == 3 * pool.bytes_per_block()
+        pool.free(slot)
+        assert pool.used_bytes() == 0
+        # Freed blocks are reusable immediately (free-list reuse).
+        slot2 = pool.alloc()
+        blocks2 = pool.alloc_blocks(3)
+        assert set(blocks2) <= set(blocks)
+        pool.assign(slot2, blocks2)
+        pool.free(slot2)
+
+    def test_exhaustion_is_loud_and_all_or_nothing(self):
+        reg = MetricsRegistry()
+        pool = self._pool(blocks=4, registry=reg)  # 3 usable
+        slot = pool.alloc()
+        pool.assign(slot, pool.alloc_blocks(2))
+        with pytest.raises(BlockExhausted, match="exhausted"):
+            pool.alloc_blocks(2)  # only 1 left: claim nothing
+        assert reg.counter_values()["serving/kv_exhausted_total"] == 1
+        # The failed claim leaked nothing: the single block remains.
+        assert len(pool.alloc_blocks(1)) == 1
+        pool.free(slot)
+
+    def test_ensure_position_grows_one_block(self):
+        pool = self._pool(blocks=4)
+        slot = pool.alloc()
+        pool.assign(slot, pool.alloc_blocks(1))
+        pool.ensure_position(slot, 7)   # still inside block 0
+        assert pool.paged_stats()["blocks_used"] == 1
+        pool.ensure_position(slot, 8)   # crosses into block 1
+        assert pool.paged_stats()["blocks_used"] == 2
+        pool.free(slot)
+
+    def test_occupancy_gauge_split(self):
+        """THE satellite fix: every slot claimed on short prompts must
+        NOT read as a full pool — kv_occupancy is used-block fraction,
+        slot occupancy is published separately."""
+        reg = MetricsRegistry()
+        pool = self._pool(slots=2, blocks=17, registry=reg)  # 16 usable
+        for _ in range(2):
+            s = pool.alloc()
+            pool.assign(s, pool.alloc_blocks(1))  # 8-token request
+        g = reg.gauge_values()
+        assert g["serving/kv_slot_occupancy"] == 1.0
+        assert g["serving/kv_occupancy"] == pytest.approx(2 / 16)
+        assert pool.occupancy == pytest.approx(2 / 16)
+        for s in range(2):
+            pool.free(s)
+
+    def test_prefix_cache_hit_miss_and_partial_tail(self):
+        pool = self._pool(slots=3, blocks=33)
+        prompt = list(range(20))  # blocks [0:8), [8:16), partial tail
+        blocks, c = pool.prefix_lookup(prompt)
+        assert (blocks, c) == ([], 0) and pool.prefix_misses == 1
+        slot = pool.alloc()
+        pool.assign(slot, pool.alloc_blocks(3))
+        pool.insert_prefix(slot, prompt)
+        # Same full-block prefix, different tail: 2-block hit.
+        hit_blocks, c = pool.prefix_lookup(list(range(16)) + [99, 98])
+        assert c == 16 and len(hit_blocks) == 2
+        assert hit_blocks == list(pool.block_tables[slot, :2])
+        pool.release_prefix(hit_blocks)
+        # A prompt that IS exactly the cached blocks caps at n-1: at
+        # least one tail token must prefill to sample from.
+        hb, c = pool.prefix_lookup(list(range(16)))
+        assert c == 8 and len(hb) == 1
+        pool.release_prefix(hb)
+        # Diverging first block: miss.
+        assert pool.prefix_lookup([7] * 16) == ([], 0)
+        # The partial tail block (tokens 16..19) was never published.
+        assert len(pool._cache) == 2
+        pool.free(slot)
+
+    def test_shared_blocks_survive_owner_free_then_evict(self):
+        """COW discipline: a published block outlives its owner (parked
+        evictable, still hittable), is never handed out while
+        referenced, and is reclaimed under pressure."""
+        pool = self._pool(slots=3, blocks=5)  # 4 usable
+        prompt = list(range(8))
+        a = pool.alloc()
+        pool.assign(a, pool.alloc_blocks(1))
+        pool.insert_prefix(a, prompt)
+        shared = int(pool.block_tables[a, 0])
+        pool.free(a)  # refcount 0 but published: parked, NOT free
+        hb, c = pool.prefix_lookup(prompt + [50])
+        assert hb == [shared] and c == 8
+        # While referenced, an allocation storm cannot reclaim it.
+        got = pool.alloc_blocks(3)
+        assert shared not in got
+        with pytest.raises(BlockExhausted):
+            pool.alloc_blocks(1)
+        pool.release_prefix(hb)
+        for b in got:
+            pool._refcount[b] = 0  # simulate frees
+            pool._free_blocks.append(b)
+        # Unreferenced now: pressure evicts it out of the cache.
+        got2 = pool.alloc_blocks(4)
+        assert shared in got2
+        assert pool.prefix_lookup(prompt + [50]) == ([], 0)
+
+    def test_reset_after_eviction_has_no_duplicate_free_blocks(self):
+        """Regression: reset() used to rebuild the free list and THEN
+        return parked evictable blocks onto it — the same physical
+        block id twice, i.e. two requests silently sharing (and
+        overwriting) one block."""
+        pool = self._pool(slots=2, blocks=5)
+        s = pool.alloc()
+        pool.assign(s, pool.alloc_blocks(1))
+        pool.insert_prefix(s, list(range(8)))
+        pool.free(s)  # published + unreferenced: parked evictable
+        pool.reset()
+        assert sorted(pool._free_blocks) == [1, 2, 3, 4]  # no dupes
+        s = pool.alloc()
+        got = pool.alloc_blocks(4)
+        assert len(set(got)) == 4
+        pool.assign(s, got)
+        pool.free(s)
+
+    def test_memory_claim_mixed_lengths_half_of_dense(self):
+        """Acceptance: a mixed short/long request set commits <= 1/2 of
+        the dense pool's bytes at equal concurrency, by the pools' own
+        byte accounting."""
+        lengths = [4, 8, 12, 4, 60, 8, 4, 8]
+        dense = kv_cache.KVCachePool(
+            num_layers=2, num_slots=8, num_heads=2, max_len=64,
+            head_dim=16, registry=MetricsRegistry(),
+        )
+        paged = PagedKVPool(
+            num_layers=2, num_slots=8, num_heads=2, max_len=64,
+            head_dim=16, block_size=8, registry=MetricsRegistry(),
+        )
+        for ln in lengths:
+            ds = dense.alloc()
+            dense.lengths[ds] = ln
+            ps = paged.alloc()
+            paged.assign(ps, paged.alloc_blocks(-(-ln // 8)))
+            paged.lengths[ps] = ln
+        assert dense.active_slots == paged.active_slots == 8
+        assert paged.used_bytes() <= dense.used_bytes() / 2, (
+            f"paged {paged.used_bytes()} vs dense {dense.used_bytes()}"
+        )
+        for s in range(8):
+            dense.free(s)
+            paged.free(s)
+
+
+class TestPagedGolden:
+    @pytest.mark.timeout(300)
+    def test_batched_identical_to_unbatched_reference(self, paged_engine):
+        """Acceptance: the PR 5 concurrent-request batcher golden on
+        the PAGED pool — 12 mixed-length requests through the
+        continuous batcher, token-identical to the unbatched reference
+        replay, zero post-warmup recompiles via the sentinel."""
+        eng = paged_engine
+        reqs = _mixed_requests(12, eng.model_cfg)
+        compiles_before = dict(eng.sentinel.compile_counts())
+
+        batcher = ContinuousBatcher(eng).start()
+        try:
+            futs = [batcher.submit(r) for r in reqs]
+            results = [f.result(timeout=120) for f in futs]
+        finally:
+            batcher.close(drain=True)
+
+        for req, res in zip(reqs, results):
+            ref = eng.reference_generate(
+                req.prompt, max_new=req.max_new_tokens, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+            )
+            assert res.tokens == ref, (
+                f"paged batched != reference for "
+                f"prompt_len={len(req.prompt)} temp={req.temperature}"
+            )
+            assert res.truncated is None
+        assert eng.sentinel.compile_counts() == compiles_before
+        assert eng.post_warmup_recompiles() == 0
+        assert eng.pool.active_slots == 0
+        assert eng.pool.used_bytes() == 0  # every block returned
+
+    @pytest.mark.timeout(120)
+    def test_prefix_hit_extends_token_identical_and_cow(self, paged_engine):
+        """A prefix-cache hit must change nothing observable: request B
+        reusing A's cached blocks serves the exact reference tokens
+        (the extend program's chunked attention), and A's published
+        blocks are bit-identical after B ran (copy-on-write: shared
+        full blocks are never written)."""
+        import numpy as np
+
+        eng = paged_engine
+        rng = np.random.default_rng(11)
+        prefix = [int(t) for t in rng.integers(0, 211, 16)]
+        a_req = Request(prompt=prefix + [3, 1, 4], max_new_tokens=3,
+                        seed=21)
+        b_req = Request(prompt=prefix + [9, 2, 6, 5], max_new_tokens=4,
+                        seed=22, temperature=0.9)
+        hits_before = eng.pool.prefix_hits
+        batcher = ContinuousBatcher(eng).start()
+        try:
+            res_a = batcher.submit(a_req).result(timeout=60)
+            # A retired; its full prefix blocks stay published.
+            shared = [
+                bid for bid, key in eng.pool._cache_key.items()
+                if list(key[1]) == prefix[:8] or list(key[1]) == prefix[8:]
+            ]
+            assert len(shared) == 2
+            k_before = np.asarray(eng.pool.k[:, shared]).copy()
+            res_b = batcher.submit(b_req).result(timeout=60)
+        finally:
+            batcher.close(drain=True)
+        assert eng.pool.prefix_hits == hits_before + 1
+        assert res_a.tokens == eng.reference_generate(
+            a_req.prompt, max_new=3, seed=21
+        )
+        assert res_b.tokens == eng.reference_generate(
+            b_req.prompt, max_new=4, seed=22, temperature=0.9
+        )
+        np.testing.assert_array_equal(
+            np.asarray(eng.pool.k[:, shared]), k_before,
+            err_msg="a shared prefix block was written (COW violated)",
+        )
+        assert eng.post_warmup_recompiles() == 0
+
+
+class TestPagedExhaustionServing:
+    @pytest.mark.timeout(120)
+    def test_mid_decode_exhaustion_fails_loudly_engine_keeps_serving(self):
+        """Satellite: block exhaustion mid-decode fails THAT request
+        with BlockExhausted (no device state was lost — no donation
+        happened), its blocks return to the free list, and the engine
+        keeps serving new requests — mirroring the PR 5
+        EngineStepError contract without the blast radius."""
+        cfg = tiny_cfg()
+        eng = InferenceEngine(
+            cfg,
+            _tiny_params(cfg),
+            cfg=ServeConfig(
+                max_slots=2, prefill_bucket_floor=16, kv_bucket_floor=32,
+                max_delay_s=0.0, kv_block_size=8,
+                kv_blocks=4,  # 3 usable blocks = 24 token rows
+            ),
+            registry=MetricsRegistry(),
+        )
+        eng.warmup()
+        batcher = ContinuousBatcher(eng).start()
+        try:
+            # 16-token prompt (2 blocks) + enough generation to need a
+            # 4th block the pool cannot back.
+            doomed = batcher.submit(
+                Request(prompt=list(range(100, 116)),
+                        max_new_tokens=20, seed=1)
+            )
+            with pytest.raises(BlockExhausted, match="exhausted"):
+                doomed.result(timeout=60)
+            assert eng.pool.used_bytes() == 0  # blocks came back
+            # The engine serves the next request cleanly.
+            ok = batcher.submit(
+                Request(prompt=[5, 6, 7], max_new_tokens=3, seed=2)
+            ).result(timeout=60)
+        finally:
+            batcher.close(drain=True)
+        assert ok.tokens == eng.reference_generate(
+            [5, 6, 7], max_new=3, seed=2
+        )
+        assert eng.post_warmup_recompiles() == 0
+        assert (
+            eng.registry.counter_values()["serving/kv_exhausted_total"]
+            >= 1
+        )
+
+
+class TestInt8KV:
+    @pytest.mark.timeout(180)
+    def test_bounded_divergence_vs_fp32_reference(self):
+        """The int8 golden: quantized-KV generation tracks the fp32
+        reference within a measured bound — first generated token
+        exact (prefill attends over fresh unquantized K/V), and >= 75%
+        of each stream agreeing — with zero post-warmup recompiles.
+        Divergence is bounded and measured, never assumed away."""
+        import numpy as np
+
+        cfg = tiny_cfg(num_layers=1, d_model=16, max_len=32)
+        eng = InferenceEngine(
+            cfg,
+            _tiny_params(cfg),
+            cfg=ServeConfig(
+                max_slots=2, prefill_bucket_floor=16, kv_bucket_floor=16,
+                kv_block_size=8, kv_dtype="int8",
+            ),
+            registry=MetricsRegistry(),
+        )
+        eng.warmup()
+        assert eng.pool.kv_bits == 8
+        rng = np.random.default_rng(5)
+        for i in range(4):
+            prompt = [int(t) for t in rng.integers(0, 211, 5 + i * 6)]
+            slot = eng.pool.alloc()
+            tok, _ = eng.prefill(slot, prompt, seed=i)
+            seq = [tok]
+            for _ in range(5):
+                seq.append(eng.decode([(slot, seq[-1], i, 0.0, 0)])[slot])
+            eng.pool.free(slot)
+            ref = eng.reference_generate(prompt, max_new=6, seed=i)
+            assert seq[0] == ref[0], "first token must be exact"
+            agree = sum(a == b for a, b in zip(seq, ref))
+            assert agree >= 0.75 * len(ref), (
+                f"int8 diverged beyond bound: {seq} vs {ref}"
+            )
+        assert eng.post_warmup_recompiles() == 0
+
+    def test_int8_requires_paged_pool(self):
+        cfg = tiny_cfg()
+        with pytest.raises(ValueError, match="paged"):
+            InferenceEngine(
+                cfg, _tiny_params(cfg),
+                cfg=ServeConfig(kv_dtype="int8"),
+                registry=MetricsRegistry(),
+            )
 
 
 # ------------------------------------------------------------ SIGTERM drain

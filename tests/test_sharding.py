@@ -238,6 +238,70 @@ class TestResolve:
 # -------------------------------------------------- training acceptance
 
 
+class TestDigestAgreement:
+    """ISSUE 8 satellite (ROADMAP 1d): sharding.json is written by
+    process 0 only and restore validation is per-process — the fit-
+    start allgather is the cross-host agreement check, failing with
+    the mismatching host NAMED before any restore runs."""
+
+    DIGEST_A = "0123456789abcdef"
+    DIGEST_B = "fedcba9876543210"
+
+    def _gather(self, rows):
+        def allgather(vec):
+            return np.stack(
+                [np.frombuffer(bytes.fromhex(d), np.uint8).astype(
+                    np.int32
+                ) for d in rows]
+            )
+
+        return allgather
+
+    def test_agreement_passes(self):
+        from tensorflow_examples_tpu.sharding import (
+            verify_digest_agreement,
+        )
+
+        verify_digest_agreement(
+            self.DIGEST_A,
+            allgather=self._gather([self.DIGEST_A] * 4),
+            process_index=0,
+            process_count=4,
+        )
+
+    def test_single_process_never_gathers(self):
+        from tensorflow_examples_tpu.sharding import (
+            verify_digest_agreement,
+        )
+
+        def boom(vec):
+            raise AssertionError("collective entered on 1 process")
+
+        verify_digest_agreement(
+            self.DIGEST_A, allgather=boom, process_count=1
+        )
+
+    def test_mismatch_names_the_host(self):
+        from tensorflow_examples_tpu.sharding import (
+            ShardingMismatchError,
+            verify_digest_agreement,
+        )
+
+        rows = [self.DIGEST_A, self.DIGEST_A, self.DIGEST_B,
+                self.DIGEST_A]
+        with pytest.raises(ShardingMismatchError) as ei:
+            verify_digest_agreement(
+                self.DIGEST_A,
+                allgather=self._gather(rows),
+                process_index=0,
+                process_count=4,
+            )
+        msg = str(ei.value)
+        assert "host 2" in msg and self.DIGEST_B in msg
+        assert self.DIGEST_A in msg  # both digests shown
+        assert "host 1" not in msg  # agreeing hosts are not accused
+
+
 class TestShardedTraining:
     def test_2d_mesh_matches_1device_loss_trajectory(self):
         """THE tentpole training claim: 2x2 and 4x2 (data, model) GSPMD

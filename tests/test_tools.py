@@ -929,6 +929,38 @@ class TestRunDiff:
             ["--record", str(self_json), "--floors", str(floors)]
         ) == 0
 
+    def test_serving_records_rank_serving_regressions_first(
+        self, tmp_path, capsys
+    ):
+        """ISSUE 8 satellite: run_diff consumes serving bench records
+        (the router's canary per-set docs) and ranks TTFT/TPOT/
+        prefix-hit regressions first — the canary-compare path."""
+        import run_diff
+
+        base = {
+            "bench": "serve_router_set", "ttft_p95_ms": 50.0,
+            "tpot_p95_ms": 10.0, "req_per_s": 40.0,
+            "tok_per_s": 300.0, "prefix_hit_rate": 0.25,
+        }
+        canary = dict(base, ttft_p95_ms=100.0, prefix_hit_rate=0.05,
+                      tok_per_s=310.0)
+        a, b = tmp_path / "base.json", tmp_path / "canary.json"
+        a.write_text(json.dumps(base))
+        b.write_text(json.dumps(canary))
+        rc = run_diff.main(
+            [str(a), str(b), "--fail-on-regression"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1  # the canary regressed; compare says so
+        lines = [l for l in out.splitlines() if "REGRESSED" in l]
+        # Both serving regressions found, largest relative change
+        # first (2x TTFT = +100% outranks the -80% hit-rate loss),
+        # improvements after.
+        assert len(lines) == 2
+        assert "ttft_p95_ms" in lines[0]
+        assert "prefix_hit_rate" in lines[1]
+        assert "improved " in out and "tok_per_s" in out
+
 
 def test_ci_perf_gates_run_in_tier1(tmp_path):
     """ISSUE 4 CI satellite, at the subprocess level the CI would use:
@@ -1080,6 +1112,56 @@ class TestBenchGate:
         ) == 0
         out = capsys.readouterr().out
         assert "[SKIP] peak_live_bytes: absent from record" in out
+
+    def _serve_record(self, tmp_path, name="serve.json", **over):
+        rec = {
+            "bench": "serve_router",
+            "ttft_p50_ms": 30.0,
+            "ttft_p95_ms": 60.0,
+            "tpot_p50_ms": 8.0,
+            "tpot_p95_ms": 14.0,
+            "e2e_p95_ms": 150.0,
+            "req_per_s": 40.0,
+            "tok_per_s": 320.0,
+            "prefix_hit_rate": 0.2,
+            "post_warmup_recompiles": 0,
+        }
+        rec.update(over)
+        p = tmp_path / name
+        p.write_text(json.dumps(rec))
+        return p
+
+    def test_serve_router_record_stamps_and_gates(self, tmp_path, capsys):
+        """ISSUE 8 satellite: bench_gate accepts the serve_router
+        record keys — latency maxima, throughput/prefix-hit minima,
+        recompiles pinned — in both --stamp and --record modes."""
+        good = self._serve_record(tmp_path)
+        floors = tmp_path / "serve_floors.json"
+        assert self._gate(
+            ["--stamp", str(good), "--floors", str(floors)]
+        ) == 0
+        with open(floors) as f:
+            stamped = json.load(f)
+        assert stamped["ttft_p95_ms"] == {"max": 60.0}
+        assert stamped["tok_per_s"] == {"min": 320.0}
+        assert stamped["prefix_hit_rate"] == {"min": 0.2}
+        assert self._gate(
+            ["--record", str(good), "--floors", str(floors)]
+        ) == 0
+        # A 2x TTFT regression fails; so does a prefix-cache collapse.
+        bad = self._serve_record(
+            tmp_path, "bad.json", ttft_p95_ms=120.0
+        )
+        assert self._gate(
+            ["--record", str(bad), "--floors", str(floors)]
+        ) == 1
+        assert "[FAIL] ttft_p95_ms" in capsys.readouterr().out
+        bad = self._serve_record(
+            tmp_path, "bad2.json", prefix_hit_rate=0.0
+        )
+        assert self._gate(
+            ["--record", str(bad), "--floors", str(floors)]
+        ) == 1
 
 
 class TestHostInputBench:
@@ -1234,6 +1316,42 @@ class TestServeBench:
                     "tpot_p95_ms", "e2e_p95_ms", "queue_wait_p95_ms"):
             assert isinstance(rec[key], (int, float)) and rec[key] > 0, key
 
+    @pytest.mark.timeout(300)
+    def test_router_smoke_two_paged_replicas(self, tmp_path):
+        """ISSUE 8 CI satellite: ``--smoke --router`` spins 2 in-proc
+        PAGED replicas behind serving/router.py, drives real HTTP
+        through the router, and banks a well-formed ``serve_router``
+        record — verified tokens, >= 1 prefix-cache hit, and zero
+        post-warmup recompiles summed over every replica."""
+        import serve_bench
+
+        out = tmp_path / "router_record.json"
+        rc = serve_bench.main(
+            ["--smoke", "--router", "--requests", "12",
+             "--out", str(out)]
+        )
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        assert rec["bench"] == "serve_router" and rec["replicas"] == 2
+        assert rec["requests"] == 12 and rec["completed"] == 12
+        assert rec["errors"] == 0 and rec["ok"] is True
+        assert rec["transport"] == "router-http"
+        # The paged tier: block size banked, >= 1 prefix-cache hit
+        # from the shared-prefix prompt set.
+        assert rec["kv_block_size"] == 16
+        assert rec["prefix_hits"] >= 1
+        assert 0 < rec["prefix_hit_rate"] <= 1
+        # Zero-recompile steady state ACROSS the fleet.
+        assert rec["post_warmup_recompiles"] == 0
+        assert rec["compiles"] == rec["expected_compiles"]
+        assert rec["verified"] == 3 and rec["verify_ok"] is True
+        assert rec["router_dispatched"] >= 12
+        assert rec["router_no_replica"] == 0
+        for key in ("req_per_s", "tok_per_s", "ttft_p95_ms",
+                    "tpot_p95_ms", "e2e_p95_ms"):
+            assert isinstance(rec[key], (int, float)) and rec[key] > 0
+
     def test_make_prompts_spans_buckets(self):
         import serve_bench
 
@@ -1243,6 +1361,16 @@ class TestServeBench:
         lengths = {len(p) for p in prompts}
         assert min(lengths) == 1 and max(lengths) == 56
         assert all(0 <= t < 97 for p in prompts for t in p)
+
+    def test_make_prompts_shared_prefix(self):
+        import serve_bench
+
+        prompts = serve_bench.make_prompts(
+            16, vocab=97, max_len=64, max_new=8, shared_prefix_every=4
+        )
+        shared = [prompts[i] for i in range(1, 16, 4)]
+        pre = shared[0][:28]
+        assert all(p[:28] == pre for p in shared)
 
     def test_requires_a_target(self):
         import serve_bench

@@ -69,6 +69,20 @@ RECORD_KEYS: dict[str, str] = {
     "mfu": "min",
     "goodput": "min",
     "examples_per_sec_mean": "min",
+    # Serving-tier records (ISSUE 8): serve_bench --router banks a
+    # ``serve_router`` record (and tools/run_diff.py flattens the same
+    # keys from a canary diff doc) — latency maxima, throughput and
+    # prefix-cache minima, recompiles pinned at their stamped count
+    # (zero on a healthy tier).
+    "ttft_p50_ms": "max",
+    "ttft_p95_ms": "max",
+    "tpot_p50_ms": "max",
+    "tpot_p95_ms": "max",
+    "e2e_p95_ms": "max",
+    "req_per_s": "min",
+    "tok_per_s": "min",
+    "prefix_hit_rate": "min",
+    "post_warmup_recompiles": "max",
 }
 
 

@@ -47,6 +47,10 @@ import telemetry_report  # noqa: E402
 
 # (record key, direction, unit, scale) — direction says which way is a
 # regression; scale is display-only (step times print as ms).
+# The serving keys (ISSUE 8) make this the canary-compare engine for
+# the router tier: a serve_bench / router per-set record diffs the
+# same way a training run does, with TTFT/TPOT/prefix-hit regressions
+# ranked first like everything else.
 DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("step_time_p50", "lower", "ms", 1e3),
     ("step_time_p95", "lower", "ms", 1e3),
@@ -58,6 +62,17 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("peak_live_bytes", "lower", "MiB", 1.0 / 2**20),
     ("compiles", "lower", "", 1.0),
     ("recompiles", "lower", "", 1.0),
+    # ---- serving records (serve_bench / router canary sets) ----
+    ("ttft_p50_ms", "lower", "ms", 1.0),
+    ("ttft_p95_ms", "lower", "ms", 1.0),
+    ("tpot_p50_ms", "lower", "ms", 1.0),
+    ("tpot_p95_ms", "lower", "ms", 1.0),
+    ("e2e_p95_ms", "lower", "ms", 1.0),
+    ("queue_wait_p95_ms", "lower", "ms", 1.0),
+    ("req_per_s", "higher", "/s", 1.0),
+    ("tok_per_s", "higher", "/s", 1.0),
+    ("prefix_hit_rate", "higher", "", 1.0),
+    ("post_warmup_recompiles", "lower", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -70,6 +85,13 @@ GATE_KEYS = (
     "mfu",
     "goodput",
     "examples_per_sec_mean",
+    # serving gate keys (ISSUE 8): bench_gate.RECORD_KEYS accepts them
+    # so a canary diff doc gates straight against serving floors.
+    "ttft_p95_ms",
+    "tpot_p95_ms",
+    "req_per_s",
+    "tok_per_s",
+    "prefix_hit_rate",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a
@@ -82,8 +104,10 @@ _INF_MAGNITUDE = 1e9
 
 
 def load_record(arg: str) -> tuple[dict | None, str]:
-    """(record, error). Accepts a telemetry_report --json file or
-    anything telemetry_report resolves as a run dir."""
+    """(record, error). Accepts a telemetry_report --json file, a
+    serving bench record (serve_bench / router canary set — anything
+    carrying a ``"bench"`` key), or anything telemetry_report resolves
+    as a run dir."""
     if os.path.isfile(arg) and not arg.endswith(".jsonl"):
         try:
             with open(arg) as f:
@@ -91,6 +115,8 @@ def load_record(arg: str) -> tuple[dict | None, str]:
         except (json.JSONDecodeError, UnicodeDecodeError):
             doc = None
         if isinstance(doc, dict) and "windows" in doc and "counters" in doc:
+            return doc, ""
+        if isinstance(doc, dict) and "bench" in doc:
             return doc, ""
     record, _, err = telemetry_report.build_record(arg)
     return record, err

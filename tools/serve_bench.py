@@ -33,6 +33,17 @@ Modes:
   restore; serve those via ``examples/gpt2/serve.py``, which takes the
   full flag surface) and measure serving throughput/latency at
   ``--concurrency`` on the local accelerator.
+* ``--router`` (ISSUE 8) — stand up ``--replicas`` N full serving
+  stacks IN THIS PROCESS (each its own engine + batcher + HTTP
+  frontend on a loopback port), put ``serving/router.py`` in front,
+  and drive the whole tier through the router. Replicas default to the
+  paged KV pool (``--kv-block-size``, ``--kv-dtype``) and a quarter of
+  the prompts share a common prefix so the prefix cache takes real
+  hits; the record (``"bench": "serve_router"``) adds replica count,
+  router retry counters, and ``prefix_hit_rate`` to the latency/
+  throughput keys, and ``ok`` additionally requires zero post-warmup
+  recompiles summed over EVERY replica. ``--smoke --router`` is the
+  tier-1 fleet smoke.
 
 ``--inproc`` skips the HTTP hop (batcher futures driven directly) to
 separate transport cost from engine cost; ``--out`` banks the record
@@ -47,8 +58,6 @@ import os
 import sys
 import threading
 import time
-import urllib.error
-import urllib.request
 
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
@@ -125,9 +134,15 @@ def build_checkpoint_engine(workdir: str, serve_cfg, *, registry=None):
 
 
 def make_prompts(n: int, *, vocab: int, max_len: int, max_new: int,
-                 seed: int = 0) -> list[list[int]]:
+                 seed: int = 0,
+                 shared_prefix_every: int = 0) -> list[list[int]]:
     """Mixed-length prompts spanning the prefill buckets (that's the
-    continuous-batching claim under test: different lengths coalesce)."""
+    continuous-batching claim under test: different lengths coalesce).
+
+    ``shared_prefix_every=k`` gives every k-th prompt one common
+    system-prompt-style prefix (half the prompt budget) plus a random
+    tail — the traffic shape the paged pool's prefix cache exists for
+    (the first such prompt prefills it, later ones hit)."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
@@ -135,27 +150,29 @@ def make_prompts(n: int, *, vocab: int, max_len: int, max_new: int,
     lengths = [int(rng.integers(1, cap + 1)) for _ in range(n)]
     # Force the extremes so every run exercises bucket 1 and the top.
     lengths[0], lengths[-1] = 1, cap
-    return [
+    prompts = [
         [int(t) for t in rng.integers(0, vocab, (ln,))] for ln in lengths
     ]
+    if shared_prefix_every:
+        pre_len = max(1, cap // 2)
+        prefix = [int(t) for t in rng.integers(0, vocab, (pre_len,))]
+        for i in range(1, n, shared_prefix_every):
+            tail = 1 + int(rng.integers(0, max(1, cap - pre_len)))
+            prompts[i] = prefix + [
+                int(t) for t in rng.integers(0, vocab, (tail,))
+            ]
+    return prompts
 
 
 def _post_json(url: str, body: dict, timeout: float) -> tuple[int, dict]:
-    data = json.dumps(body).encode()
-    req = urllib.request.Request(
-        url, data=data, headers={"Content-Type": "application/json"}
-    )
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, json.loads(resp.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read() or b"{}")
-    except (OSError, ValueError) as e:
-        # Transport-level failure (URLError, reset, timeout, torn JSON
-        # body): count it as THIS request's error instead of letting it
-        # kill the worker thread and strand every prompt it would have
-        # pulled next.
-        return 0, {"error": f"{type(e).__name__}: {e}"}
+    # The serving stack's one JSON-over-HTTP client: transport-level
+    # failures (URLError, reset, timeout, torn JSON body) come back as
+    # status 0 and count as THIS request's error instead of killing
+    # the worker thread and stranding every prompt it would have
+    # pulled next.
+    from tensorflow_examples_tpu.serving.router import post_json
+
+    return post_json(url, body, timeout)
 
 
 def drive(frontend, prompts, *, concurrency: int, max_new: int,
@@ -242,10 +259,231 @@ def bench_record(engine, registry, outcome, prompts, *, concurrency,
         "verified": verified,
         "verify_ok": verify_ok,
     }
+    paged = getattr(engine.pool, "paged_stats", None)
+    if callable(paged):
+        stats = paged()
+        rec["kv_block_size"] = stats["block_size"]
+        rec["kv_bits"] = stats["kv_bits"]
+        rec["prefix_hits"] = stats["prefix_hits"]
+        rec["prefix_misses"] = stats["prefix_misses"]
+        rec["prefix_hit_rate"] = stats["prefix_hit_rate"]
     rec["ok"] = bool(
         errors == 0
         and verify_ok
         and rec["post_warmup_recompiles"] == 0
+    )
+    return rec
+
+
+def _pct_from_values(values, q):
+    """Client-side percentile over per-reply values, in ms (router mode
+    has no shared registry to read — every replica owns its own)."""
+    import numpy as np
+
+    vals = [v for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return None
+    return round(float(np.percentile(vals, q)) * 1e3, 3)
+
+
+def run_router_bench(args) -> dict:
+    """Stand up --replicas in-proc serving stacks behind the router and
+    drive the tier end-to-end; returns the ``serve_router`` record."""
+    import jax
+
+    from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
+    from tensorflow_examples_tpu.serving.engine import ServeConfig
+    from tensorflow_examples_tpu.serving.frontend import ServingFrontend
+    from tensorflow_examples_tpu.serving.router import (
+        Router,
+        RouterConfig,
+        RouterFrontend,
+    )
+    from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+
+    kv_block = args.kv_block_size if args.kv_block_size >= 0 else 16
+    serve_kw = dict(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        kv_block_size=kv_block,
+        kv_dtype=args.kv_dtype,
+    )
+    if args.smoke:
+        serve_kw.update(prefill_bucket_floor=16, kv_bucket_floor=32)
+
+    replicas: list = [None] * args.replicas
+    t0 = time.perf_counter()
+
+    def build_one(k: int) -> None:
+        reg = MetricsRegistry()
+        serve_cfg = ServeConfig(**serve_kw)
+        if args.workdir:
+            engine = build_checkpoint_engine(
+                args.workdir, serve_cfg, registry=reg
+            )
+        else:
+            engine = build_smoke_engine(serve_cfg, registry=reg)
+        engine.warmup()
+        batcher = ContinuousBatcher(engine, registry=reg).start()
+        frontend = ServingFrontend(batcher, port=0).start()
+        replicas[k] = (engine, batcher, frontend, reg)
+
+    # Replica warmups run concurrently: XLA compilation releases the
+    # GIL, so N replicas warm in roughly one replica's wall time.
+    warm_threads = [
+        threading.Thread(target=build_one, args=(k,), daemon=True)
+        for k in range(args.replicas)
+    ]
+    for t in warm_threads:
+        t.start()
+    for t in warm_threads:
+        t.join()
+    warmup_s = time.perf_counter() - t0
+    print(
+        f"# {args.replicas} replicas warm "
+        f"({replicas[0][0].expected_compiles()} programs each, paged "
+        f"block={kv_block}, kv_dtype={args.kv_dtype or 'fp'}) in "
+        f"{warmup_s:.1f}s",
+        file=sys.stderr,
+    )
+
+    urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe, _ in replicas]
+    router = Router(
+        urls,
+        cfg=RouterConfig(
+            probe_interval_s=0.2, request_timeout_s=args.timeout
+        ),
+    ).start()
+    rfront = RouterFrontend(router, port=0).start()
+
+    n = args.requests or (20 if args.smoke else 64)
+    verify = args.verify if args.verify >= 0 else (3 if args.smoke else 0)
+    model_cfg = replicas[0][0].model_cfg
+    # Every 4th prompt shares a system-prompt-style prefix: the first
+    # one prefills the prefix cache, later ones hit it (the record's
+    # prefix_hit_rate is the measured claim, and the tier-1 smoke
+    # asserts >= 1 hit).
+    prompts = make_prompts(
+        n,
+        vocab=model_cfg.vocab_size,
+        max_len=model_cfg.max_len,
+        max_new=args.max_new_tokens,
+        shared_prefix_every=4,
+    )
+    try:
+        outcome = drive(
+            None, prompts,
+            concurrency=args.concurrency, max_new=args.max_new_tokens,
+            temperature=args.temperature, top_k=args.top_k,
+            http_url=rfront.url("/generate"), timeout=args.timeout,
+        )
+        verify_ok = True
+        for i in range(min(verify, n)):
+            reply = outcome["replies"][i]
+            if reply is None or reply[0] != 200:
+                verify_ok = False
+                continue
+            ref = replicas[0][0].reference_generate(
+                prompts[i], max_new=args.max_new_tokens, seed=i,
+                temperature=args.temperature, top_k=args.top_k,
+            )
+            if reply[1]["tokens"] != ref:
+                verify_ok = False
+                print(
+                    f"# VERIFY FAIL req {i}: served "
+                    f"{reply[1]['tokens']} != reference {ref}",
+                    file=sys.stderr,
+                )
+    finally:
+        rfront.close()
+        router.close()
+        for _, batcher, frontend, _ in replicas:
+            batcher.close(drain=True)
+            frontend.close()
+
+    replies = outcome["replies"]
+    done = [r for r in replies if r is not None and r[0] == 200]
+    toks = sum(len(r[1].get("tokens", ())) for r in done)
+    wall = outcome["wall_s"]
+    errors = len(replies) - len(done)
+
+    def field(name):
+        return [r[1].get(name) for r in done]
+
+    tpots = [
+        (r[1]["total_s"] - r[1]["ttft_s"]) / (len(r[1]["tokens"]) - 1)
+        for r in done
+        if isinstance(r[1].get("ttft_s"), (int, float))
+        and isinstance(r[1].get("total_s"), (int, float))
+        and len(r[1].get("tokens", ())) > 1
+    ]
+    # --kv-block-size 0 runs DENSE replicas behind the router: the
+    # prefix-cache fields degrade to zero instead of crashing the
+    # record assembly after a full benchmark run.
+    hits = sum(
+        getattr(e.pool, "prefix_hits", 0) for e, _, _, _ in replicas
+    )
+    misses = sum(
+        getattr(e.pool, "prefix_misses", 0) for e, _, _, _ in replicas
+    )
+    recompiles = sum(
+        e.post_warmup_recompiles() for e, _, _, _ in replicas
+    )
+    router_counters = router.registry.counter_values()
+    rec = {
+        "bench": "serve_router",
+        "backend": jax.default_backend(),
+        "replicas": args.replicas,
+        "requests": len(prompts),
+        "completed": len(done),
+        "errors": errors,
+        "concurrency": args.concurrency,
+        "max_slots": args.max_slots,
+        "wall_s": round(wall, 3),
+        "req_per_s": round(len(done) / wall, 3) if wall else None,
+        "tok_per_s": round(toks / wall, 3) if wall else None,
+        "generated_tokens": toks,
+        "queue_wait_p95_ms": _pct_from_values(field("queue_wait_s"), 95),
+        "ttft_p50_ms": _pct_from_values(field("ttft_s"), 50),
+        "ttft_p95_ms": _pct_from_values(field("ttft_s"), 95),
+        "tpot_p50_ms": _pct_from_values(tpots, 50),
+        "tpot_p95_ms": _pct_from_values(tpots, 95),
+        "e2e_p50_ms": _pct_from_values(field("total_s"), 50),
+        "e2e_p95_ms": _pct_from_values(field("total_s"), 95),
+        "expected_compiles": sum(
+            e.expected_compiles() for e, _, _, _ in replicas
+        ),
+        "compiles": sum(
+            int(reg.counter_values().get("compile/count", 0))
+            for _, _, _, reg in replicas
+        ),
+        "post_warmup_recompiles": recompiles,
+        "shed": sum(
+            int(reg.counter_values().get("serving/shed_total", 0))
+            for _, _, _, reg in replicas
+        ),
+        "kv_block_size": kv_block,
+        "kv_bits": replicas[0][0].pool.kv_bits,
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "prefix_hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+        "router_dispatched": int(
+            router_counters.get("router/dispatched_total", 0)
+        ),
+        "router_retries": int(
+            router_counters.get("router/retries_total", 0)
+        ),
+        "router_no_replica": int(
+            router_counters.get("router/no_replica_total", 0)
+        ),
+        "verified": min(verify, n),
+        "verify_ok": verify_ok,
+        "warmup_s": round(warmup_s, 3),
+        "transport": "router-http",
+    }
+    rec["ok"] = bool(
+        errors == 0 and verify_ok and recompiles == 0
     )
     return rec
 
@@ -256,6 +494,16 @@ def main(argv=None) -> int:
                     help="tiny model, 20 requests, verify 3 (tier-1 CI)")
     ap.add_argument("--workdir", default="",
                     help="serve the latest checkpoint in this run dir")
+    ap.add_argument("--router", action="store_true",
+                    help="drive --replicas in-proc serving stacks "
+                         "through serving/router.py (ISSUE 8)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="replica count for --router (default 2)")
+    ap.add_argument("--kv-block-size", type=int, default=-1,
+                    help="paged KV block size; -1 = dense pool "
+                         "(--router defaults to 16)")
+    ap.add_argument("--kv-dtype", default="",
+                    help="'' (cache dtype) or 'int8' (paged only)")
     ap.add_argument("--requests", type=int, default=0,
                     help="request count (default: 20 smoke / 64 otherwise)")
     ap.add_argument("--concurrency", type=int, default=8)
@@ -275,6 +523,15 @@ def main(argv=None) -> int:
     if not args.smoke and not args.workdir:
         ap.error("pick a target: --smoke or --workdir DIR")
 
+    if args.router:
+        rec = run_router_bench(args)
+        print(json.dumps(rec))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        return 0 if rec["ok"] else 1
+
     import jax
 
     from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
@@ -287,6 +544,8 @@ def main(argv=None) -> int:
         max_slots=args.max_slots,
         max_delay_s=0.002,
         request_timeout_s=args.timeout,
+        kv_block_size=max(args.kv_block_size, 0),
+        kv_dtype=args.kv_dtype,
         **(dict(prefill_bucket_floor=16, kv_bucket_floor=32)
            if args.smoke else {}),
     )

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Fleet router CLI: one endpoint over N serving replicas (ISSUE 8).
+
+    # Replicas started elsewhere (examples/gpt2/serve.py, one per
+    # host/chip), router in front:
+    python tools/serve_fleet.py --port 9000 \
+        --replica http://host-a:8000 --replica http://host-b:8000
+
+    # Canary rollout: route 25% of traffic to the canary set and bank
+    # a run_diff comparison of the two sets at exit (or on demand at
+    # GET /canary):
+    python tools/serve_fleet.py --port 9000 \
+        --replica http://host-a:8000 --replica http://host-b:8000 \
+        --canary http://host-c:8000 --canary-fraction 0.25 \
+        --diff-out canary_diff.json
+
+Ops verbs while running (the rollout runbook, docs/serving.md):
+
+    curl -s :9000/replicas                      # fleet state
+    curl -s -XPOST :9000/drain \
+        -d '{"replica": "http://host-a:8000"}'  # stop NEW dispatch
+    # ... restart host-a with the new build, then:
+    curl -s -XPOST :9000/undrain \
+        -d '{"replica": "http://host-a:8000"}'
+
+The router stops dispatching to a drained (or self-draining — SIGTERM
+on the replica flips its /health) replica while in-flight requests
+finish on the replica itself; 503s and transport failures retry once
+on another replica within a per-request budget, so a single-replica
+drain under load completes with zero failed requests (test-pinned).
+
+SIGTERM to the router itself closes the listening port and exits 0
+(replicas are not touched — they drain on their own schedule). A
+schema-v6 ``kind="serving"`` stats line is appended to ``--stats-out``
+every ``--stats-every`` seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--replica", action="append", default=[],
+                    help="base-set replica URL (repeatable)")
+    ap.add_argument("--canary", action="append", default=[],
+                    help="canary-set replica URL (repeatable)")
+    ap.add_argument("--port", type=int, default=9000,
+                    help="router listen port (0 = auto-assign)")
+    ap.add_argument("--probe-interval", type=float, default=0.5)
+    ap.add_argument("--request-timeout", type=float, default=120.0)
+    ap.add_argument("--retry-budget", type=float, default=10.0)
+    ap.add_argument("--canary-fraction", type=float, default=0.25,
+                    help="traffic share for the canary set")
+    ap.add_argument("--stats-every", type=float, default=10.0,
+                    help="seconds between stats lines (0 disables)")
+    ap.add_argument("--stats-out", default="",
+                    help="append stats lines here (default stderr)")
+    ap.add_argument("--diff-out", default="",
+                    help="write the base-vs-canary run_diff doc here "
+                         "at exit (needs --canary)")
+    args = ap.parse_args(argv)
+    if not args.replica:
+        ap.error("at least one --replica URL is required")
+    if args.diff_out and not args.canary:
+        ap.error("--diff-out needs a --canary set to compare against")
+
+    from tensorflow_examples_tpu.serving.router import (
+        Router,
+        RouterConfig,
+        RouterFrontend,
+    )
+
+    router = Router(
+        args.replica,
+        canary=args.canary,
+        cfg=RouterConfig(
+            probe_interval_s=args.probe_interval,
+            request_timeout_s=args.request_timeout,
+            retry_budget_s=args.retry_budget,
+            canary_fraction=args.canary_fraction,
+        ),
+    ).start()
+    frontend = RouterFrontend(router, port=args.port).start()
+    print(
+        f"router on :{frontend.port} over {len(args.replica)} base + "
+        f"{len(args.canary)} canary replica(s)",
+        file=sys.stderr,
+    )
+
+    stop = []
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.append(1))
+
+    def emit_stats():
+        line = json.dumps(router.stats_line())
+        if args.stats_out:
+            with open(args.stats_out, "a") as f:
+                f.write(line + "\n")
+        else:
+            print(line, file=sys.stderr)
+
+    last_stats = time.monotonic()
+    try:
+        while not stop:
+            time.sleep(0.2)
+            if (
+                args.stats_every > 0
+                and time.monotonic() - last_stats >= args.stats_every
+            ):
+                emit_stats()
+                last_stats = time.monotonic()
+    finally:
+        frontend.close()
+        router.close()
+        if args.diff_out:
+            import run_diff
+
+            base, canary = router.canary_records()
+            deltas, skipped = run_diff.diff_records(base, canary)
+            doc = {
+                "a_path": "router:base",
+                "b_path": "router:canary",
+                "ranked": deltas,
+                "not_comparable": skipped,
+                "regressions": sum(
+                    1 for d in deltas if d["verdict"] == "regressed"
+                ),
+                "a": base,
+                "b": canary,
+            }
+            doc.update({k: canary.get(k) for k in run_diff.GATE_KEYS})
+            with open(args.diff_out, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+            print(f"canary diff -> {args.diff_out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

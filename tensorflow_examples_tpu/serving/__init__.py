@@ -16,10 +16,20 @@ Layout (one module per concern, mirroring the training stack):
   interface — free-list block allocator with loud exhaustion, prefix
   cache reusing immutable full prompt blocks (shared system prompts
   prefill once), optional int8 KV with per-block scales.
-* ``router.py``    — ISSUE 8: the fleet tier — an HTTP router over N
-  engine replicas with load-aware dispatch from ``/health`` probes,
-  drain-aware rollout, retry-once-on-503, and canary per-set records
-  for ``tools/run_diff.py``.
+* ``router.py``    — ISSUE 8/10: the fleet tier — an HTTP router over
+  N engine replicas with load-aware dispatch from ``/health`` probes,
+  drain-aware rollout, per-replica circuit breakers, bounded
+  retry-with-backoff, optional hedged dispatch, in-flight failover on
+  replica death, and canary per-set records for ``tools/run_diff.py``.
+* ``supervisor.py`` — ISSUE 10: replica supervision — detect a dead or
+  stuck replica, restart it (process- or in-proc), re-admit to the
+  router only after ``/health`` goes green.
+* ``chaos.py``     — ISSUE 10: the serving chaos harness — restartable
+  in-proc replicas the fault engine (``utils/faults.py`` serve specs)
+  can crash/slow/starve deterministically, assembled as a
+  :class:`~.chaos.ChaosFleet` (replicas + hardened router +
+  supervisor) for the chaos acceptance tier and ``serve_bench
+  --chaos``.
 * ``engine.py``    — the compiled serving step: bucketed prefill +
   fixed-shape continuous decode, warmed up ahead of traffic over the
   padding-bucket ladder and wrapped in the PR-3 recompilation sentinel
@@ -61,3 +71,8 @@ from tensorflow_examples_tpu.serving.router import (  # noqa: F401
     RouterConfig,
     RouterFrontend,
 )
+
+# supervisor.py / chaos.py are imported lazily by their consumers
+# (tools/serve_fleet.py, serve_bench --chaos, tests/test_chaos.py) —
+# importing them here would drag the chaos machinery into every
+# serving import.

@@ -1,0 +1,239 @@
+"""In-proc chaos fleet: restartable serving replicas the fault engine
+can kill (ISSUE 10 tentpole (3)/(4)).
+
+``serve_bench --router`` already stands N full serving stacks up in one
+process; this module makes those stacks *units of failure*:
+
+* :class:`InProcReplica` — one engine + batcher + HTTP frontend on a
+  **pinned port**, with ``kill()`` (die like a SIGKILLed process: the
+  frontend resets every in-flight connection, nothing answers politely)
+  and ``restart()`` (fresh engine, full AOT warmup, same URL — the
+  supervisor's unit of work). Each start registers its ``kill`` as the
+  replica's ``crash@R:N`` callback (``utils.faults``), so a scripted
+  fault schedule can kill it mid-decode deterministically.
+* :class:`ChaosFleet` — N replicas (warmed concurrently, like
+  ``serve_bench --router``), a hardened :class:`~.router.Router` in
+  front, and a :class:`~.supervisor.Supervisor` watching the handles.
+  One object = the whole failure-domain under test; the chaos
+  acceptance tier (tests/test_chaos.py) and ``serve_bench --chaos``
+  both build exactly this.
+
+Failure semantics the harness guarantees (and the tier-1 golden
+asserts): a ``kill()`` mid-decode surfaces to the router as a
+*transport* failure — the router's in-flight failover replays the
+victim requests from the prompt on a survivor, the per-request
+``fold_in`` seeding makes the replayed streams token-identical to the
+unbatched reference, the survivors take zero post-warmup recompiles,
+and the supervisor restores the fleet (restart → re-warm → /health
+green → readmit) without operator action.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
+from tensorflow_examples_tpu.serving.frontend import ServingFrontend
+from tensorflow_examples_tpu.serving.router import Router, RouterConfig
+from tensorflow_examples_tpu.serving.supervisor import Supervisor
+from tensorflow_examples_tpu.utils import faults as faults_mod
+
+log = logging.getLogger(__name__)
+
+
+class InProcReplica:
+    """One full serving stack, rebuildable on a pinned port.
+
+    ``build_engine`` returns a FRESH, un-warmed engine each call (its
+    own registry — replicas must not share counters, or fleet-summed
+    recompile accounting lies). The first ``start()`` binds an OS-
+    assigned port and pins it; every restart re-binds the same port so
+    the replica's URL — what the router and supervisor key on — is
+    stable across its lifetimes.
+    """
+
+    def __init__(self, build_engine: Callable, *, replica_id: int,
+                 port: int = 0):
+        self.build_engine = build_engine
+        self.replica_id = int(replica_id)
+        self._port = int(port)  # 0 until the first bind pins it
+        self.engine = None
+        self.batcher: ContinuousBatcher | None = None
+        self.frontend: ServingFrontend | None = None
+        self._dead = True
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "InProcReplica":
+        engine = self.build_engine()
+        engine.replica_id = self.replica_id
+        engine.warmup()  # the full AOT ladder, BEFORE any traffic
+        batcher = ContinuousBatcher(engine).start()
+        frontend = ServingFrontend(batcher, port=self._port).start()
+        with self._lock:
+            self.engine, self.batcher, self.frontend = (
+                engine, batcher, frontend,
+            )
+            self._port = frontend.port
+            self._dead = False
+        # (Re-)register the crash verb: a ``crash@R:N`` fault on this
+        # replica id now kills THIS incarnation's transport.
+        faults_mod.register_serve_crash(self.replica_id, self.kill)
+        log.info(
+            "in-proc replica %d live at %s", self.replica_id, self.url
+        )
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._port}"
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def kill(self) -> None:
+        """Die like a killed process, NOW, from any thread (including
+        this replica's own batcher loop mid-decode): reset every
+        in-flight connection, stop listening. No drain, no 503s —
+        clients observe transport failures. The batcher thread is left
+        running (the crash fault raises InjectedCrash right after,
+        failing its in-flight set into dead sockets); ``restart()``
+        does the actual cleanup."""
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            frontend = self.frontend
+        if frontend is not None:
+            frontend.abort()
+        log.warning(
+            "in-proc replica %d KILLED (transport reset)",
+            self.replica_id,
+        )
+
+    def restart(self) -> None:
+        """Supervisor verb: tear down whatever is left of the previous
+        incarnation, then bring up a fresh one (new engine, full
+        warmup) on the same port. Blocking — the caller re-admits only
+        after this returns and /health is green."""
+        self._teardown()
+        self.start()
+
+    def _teardown(self) -> None:
+        with self._lock:
+            batcher, self.batcher = self.batcher, None
+            frontend, self.frontend = self.frontend, None
+            self.engine = None
+            self._dead = True
+        if frontend is not None:
+            frontend.abort()
+        if batcher is not None:
+            # No drain: the incarnation is dead; fail anything left so
+            # no future is ever abandoned unresolved.
+            batcher.close(drain=False)
+
+    def close(self) -> None:
+        self._teardown()
+
+
+class ChaosFleet:
+    """N in-proc replicas + hardened router + supervisor, as one unit.
+
+    ``engine_factories[k]`` builds replica k's engine. Warmups run
+    concurrently (XLA compilation releases the GIL). ``router_cfg``
+    defaults to chaos-appropriate hardening: fast probes, eject after 2
+    consecutive dispatch failures, short cooldown.
+    """
+
+    def __init__(
+        self,
+        engine_factories: list,
+        *,
+        router_cfg: RouterConfig | None = None,
+        supervisor_kw: dict | None = None,
+    ):
+        self.replicas = [
+            InProcReplica(f, replica_id=k)
+            for k, f in enumerate(engine_factories)
+        ]
+        self.router_cfg = router_cfg or RouterConfig(
+            probe_interval_s=0.1,
+            retry_budget_s=30.0,
+            max_retries=4,
+            eject_after=2,
+            eject_cooldown_s=1.0,
+        )
+        self.supervisor_kw = dict(
+            poll_s=0.1, health_stall_s=3.0, warm_timeout_s=300.0,
+        )
+        self.supervisor_kw.update(supervisor_kw or {})
+        self.router: Router | None = None
+        self.supervisor: Supervisor | None = None
+
+    def start(self) -> "ChaosFleet":
+        t0 = time.perf_counter()
+        errors: list = [None] * len(self.replicas)
+
+        def build(k):
+            try:
+                self.replicas[k].start()
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors[k] = e
+
+        threads = [
+            threading.Thread(target=build, args=(k,), daemon=True)
+            for k in range(len(self.replicas))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for e in errors:
+            if e is not None:
+                self.close()
+                raise e
+        log.info(
+            "chaos fleet: %d replicas warm in %.1fs",
+            len(self.replicas), time.perf_counter() - t0,
+        )
+        self.router = Router(
+            [r.url for r in self.replicas], cfg=self.router_cfg
+        ).start()
+        self.supervisor = Supervisor(
+            self.router, self.replicas, **self.supervisor_kw
+        ).start()
+        return self
+
+    @property
+    def urls(self) -> list:
+        return [r.url for r in self.replicas]
+
+    def healthy_count(self) -> int:
+        if self.router is None:
+            return 0
+        return sum(
+            r.eligible(self.router.cfg.unhealthy_after)
+            for r in self.router.replicas
+        )
+
+    def await_fleet_green(self, n: int, timeout_s: float = 300.0) -> bool:
+        """Block until ``n`` replicas are eligible again (the
+        supervisor finished its restart cycle), or the timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.healthy_count() >= n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def close(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.close()
+        if self.router is not None:
+            self.router.close()
+        for r in self.replicas:
+            r.close()

@@ -63,6 +63,7 @@ from tensorflow_examples_tpu.ops.attention import NEG_INF, attention_reference
 from tensorflow_examples_tpu.serving import kv_cache as kv_mod
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry.compilation import CompilationSentinel
+from tensorflow_examples_tpu.utils import faults as faults_mod
 
 log = logging.getLogger(__name__)
 
@@ -481,6 +482,11 @@ class InferenceEngine:
             )
         self.model_cfg = model_cfg
         self.cfg = cfg or ServeConfig()
+        # Fleet identity (ISSUE 10): which replica this engine is in a
+        # multi-replica process (serve_bench --router / the chaos
+        # harness). The serve-side fault engine keys on it; 0 for a
+        # standalone server.
+        self.replica_id = 0
         if self.cfg.attention not in ("xla", "flash"):
             raise ValueError(
                 f"ServeConfig.attention={self.cfg.attention!r} not in "
@@ -942,6 +948,14 @@ class InferenceEngine:
         ``pool.lengths[slot]``. Returns {slot: generated token}."""
         if not entries:
             return {}
+        feng = faults_mod.serve_active()
+        if feng is not None:
+            # Serve-side fault hook (ISSUE 10): may sleep (slowrep),
+            # raise a forced BlockExhausted (kvexhaust) or kill this
+            # replica's transport and raise InjectedCrash (crash) —
+            # all BEFORE any device call, so no donated state is lost
+            # to an injected fault.
+            feng.decode_step(self.replica_id, [e[0] for e in entries])
         s = self.cfg.max_slots
         tokens = np.zeros((s,), np.int32)
         positions = np.zeros((s,), np.int32)

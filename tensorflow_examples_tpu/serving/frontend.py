@@ -49,6 +49,7 @@ import concurrent.futures
 import http.server
 import json
 import logging
+import socket
 import threading
 import time
 
@@ -64,6 +65,7 @@ from tensorflow_examples_tpu.telemetry.serve import (
     json_safe,
     render_prometheus,
 )
+from tensorflow_examples_tpu.utils import faults as faults_mod
 # Module-level on purpose: a lazy import inside run_until_preempted would
 # leave a multi-second window after "ready" during which SIGTERM still
 # hits the default handler (import of the train package is slow) — the
@@ -73,6 +75,30 @@ from tensorflow_examples_tpu.train.resilience import PreemptionGuard
 log = logging.getLogger(__name__)
 
 _MAX_BODY = 1 << 20  # 1 MiB of JSON is already a pathological prompt
+
+
+class _TrackingHTTPServer(http.server.ThreadingHTTPServer):
+    """ThreadingHTTPServer that keeps the set of in-flight client
+    connections, so :meth:`ServingFrontend.abort` can RESET them —
+    simulating a replica process dying mid-request (clients observe a
+    transport failure, never a polite HTTP status). The chaos harness
+    (serving/chaos.py) and the ``crash@R:N`` serve fault are the
+    consumers; normal shutdown never touches this."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.conn_lock = threading.Lock()
+        self.live_connections: set = set()
+
+    def process_request(self, request, client_address):
+        with self.conn_lock:
+            self.live_connections.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self.conn_lock:
+            self.live_connections.discard(request)
+        super().shutdown_request(request)
 
 
 def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
@@ -156,6 +182,12 @@ class ServingFrontend:
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+
+    @property
+    def replica_id(self) -> int:
+        """This stack's replica index in a fleet (0 standalone) — the
+        key the serve fault engine targets (``utils/faults.py``)."""
+        return int(getattr(self.batcher.engine, "replica_id", 0))
 
     # ------------------------------------------------------------ payloads
 
@@ -263,6 +295,15 @@ class ServingFrontend:
 
             def do_POST(self):  # noqa: N802 - http.server contract
                 path = self.path.split("?", 1)[0].rstrip("/")
+                feng = faults_mod.serve_active()
+                if feng is not None and feng.transport_fault(
+                    server.replica_id
+                ):
+                    # Injected transport fault (ISSUE 10): drop the
+                    # request with no response bytes — the client sees
+                    # a reset, exactly like a died-mid-request process.
+                    self.close_connection = True
+                    return
                 if path not in ("/generate", "/classify"):
                     self._send_json(
                         404, {"error": "POST endpoints: /generate /classify"}
@@ -307,6 +348,19 @@ class ServingFrontend:
                             ).encode(),
                         )
                     elif path == "/health":
+                        feng = faults_mod.serve_active()
+                        if feng is not None and feng.health_fault(
+                            server.replica_id
+                        ):
+                            # Injected poisoned /health (ISSUE 10):
+                            # non-JSON garbage with a 200 — the probe
+                            # loop must mark this replica unhealthy,
+                            # never crash.
+                            self._send(
+                                200, "application/json",
+                                b"<<<not json at all>>>",
+                            )
+                            return
                         self._send_json(*server.health_payload())
                     elif path == "/window":
                         self._send_json(200, server.batcher.stats_line())
@@ -323,7 +377,7 @@ class ServingFrontend:
             def log_message(self, fmt, *args):  # quiet under load
                 log.debug("serving frontend: " + fmt, *args)
 
-        self._httpd = http.server.ThreadingHTTPServer(
+        self._httpd = _TrackingHTTPServer(
             (self.bind_host, self.requested_port), Handler
         )
         self._httpd.daemon_threads = True
@@ -358,6 +412,32 @@ class ServingFrontend:
         httpd.server_close()
         if thread is not None and thread is not threading.current_thread():
             thread.join(timeout=5)
+
+    def abort(self) -> None:
+        """Die like a killed process (the chaos harness's crash verb):
+        stop listening AND reset every in-flight client connection, so
+        callers observe a transport failure — never a drained 503 or a
+        polite error body. Handler threads are left to hit the dead
+        sockets on their own (their writes raise ConnectionError, which
+        the handlers already swallow); nothing is joined. Safe from any
+        thread, including the batcher loop mid-decode."""
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            self._thread = None
+        if httpd is None:
+            return
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:  # pragma: no cover - best-effort teardown
+            pass
+        with httpd.conn_lock:
+            conns = list(httpd.live_connections)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already gone
 
 
 def run_until_preempted(

@@ -19,11 +19,39 @@ tier, deliberately stdlib-only like every HTTP surface in the repo:
   its ``/health`` flips 503 with ``draining: true``) is detected by
   the probe and likewise rotated out without failing anything. Roll a
   fleet by draining one replica, restarting it, undraining, repeating.
-* **Retry-once-on-503** — a dispatch answered 503 (shed/draining) or a
-  transport failure is retried ONCE on a different replica of the same
-  set, within a per-request wall budget (``retry_budget_s``); anything
-  else (400/404/504/500) passes through untouched — the router never
-  re-runs a request a replica actually executed.
+* **Bounded retry with backoff** (ISSUE 10, replacing PR 8's
+  retry-once) — a dispatch answered 503 (shed/draining) or a transport
+  failure is retried up to ``max_retries`` times on different replicas
+  of the same set with exponential backoff, all within a per-request
+  wall budget (``retry_budget_s``); anything else a replica *answers*
+  (400/404/504/500) passes through untouched — the router never
+  re-runs a request a replica actually executed. A transport failure
+  after dispatch is **in-flight failover**: the replica may have died
+  mid-decode, and the re-dispatch replays the request from the prompt
+  on another replica (``router/failovers_total``). Replay is safe and
+  token-identical by construction — generation is a pure function of
+  (params, prompt, seed) via the engine's per-request ``fold_in``
+  seeding, so the failed-over stream matches what the dead replica
+  would have produced, and the survivors' prefix cache makes the
+  re-prefill cheap.
+* **Per-replica circuit breaker** (ISSUE 10) — ``eject_after``
+  consecutive dispatch failures eject the replica
+  (``router/ejections_total``; breaker *open*, no dispatch); after
+  ``eject_cooldown_s`` the breaker goes *half-open* and admits exactly
+  one trial (a successful ``/health`` probe or one live request);
+  success readmits (``router/readmits_total``, breaker closed),
+  failure re-ejects for another cooldown.
+* **Hedged dispatch** (ISSUE 10, opt-in ``hedge_after_s > 0``) — a
+  request still unanswered after the hedge deadline is sent a second
+  time to another replica; the first 200 wins and the loser is
+  abandoned (``router/hedges_total`` / ``hedge_wins_total`` /
+  ``hedge_cancelled_total``). Requests are idempotent-by-seeding, so
+  hedging can never produce divergent streams — it only caps p99.
+* **Supervision hooks** — ``quarantine(url)`` / ``readmit(url)`` let
+  ``serving/supervisor.py`` rotate a dead replica out while it is
+  restarted and re-warmed, and re-admit it only after its ``/health``
+  has gone green (``router/restarts_total`` counts completed
+  restart cycles).
 * **Canary compare** — replicas are grouped into sets (``base`` and
   ``canary``); a configured fraction of traffic goes to the canary
   set and per-set latency/throughput records
@@ -45,6 +73,7 @@ import dataclasses
 import http.server
 import json
 import logging
+import queue
 import threading
 import time
 import urllib.error
@@ -68,20 +97,40 @@ class RouterConfig:
     probe_interval_s: float = 0.5   # /health poll cadence per replica
     probe_timeout_s: float = 2.0
     request_timeout_s: float = 120.0
-    retry_budget_s: float = 10.0    # wall budget for the retry attempt
-    max_retries: int = 1            # retry-ONCE is the contract
+    retry_budget_s: float = 10.0    # wall budget for ALL retry attempts
+    max_retries: int = 2            # bounded retry (ISSUE 10): total
+    #                                 re-dispatches after the first try
+    retry_backoff_s: float = 0.05   # base backoff, doubled per retry
+    eject_after: int = 3            # consecutive DISPATCH failures ->
+    #                                 circuit breaker opens (ejected)
+    eject_cooldown_s: float = 3.0   # open -> half-open (one trial)
+    hedge_after_s: float = 0.0      # >0: hedged dispatch for p99 — a
+    #                                 request unanswered this long is
+    #                                 sent again elsewhere, first 200
+    #                                 wins, loser abandoned
     unhealthy_after: int = 3        # consecutive probe failures
     canary_fraction: float = 0.25   # traffic share when a canary set
     #                                 is configured
 
 
+def _as_object(status: int, body) -> tuple[int, dict]:
+    """Coerce a parsed reply to the (status, dict) contract. A replica
+    answering valid-but-non-object JSON (a bare list/string/number) is
+    as malformed as a torn body: status 0, so probes mark it unhealthy
+    and dispatches treat it as retryable — never an AttributeError
+    inside the probe loop (ISSUE 10 satellite)."""
+    if isinstance(body, dict):
+        return status, body
+    return 0, {"error": f"non-object JSON reply: {type(body).__name__}"}
+
+
 def _get_json(url: str, timeout: float) -> tuple[int, dict]:
     try:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
-            return resp.status, json.loads(resp.read())
+            return _as_object(resp.status, json.loads(resp.read()))
     except urllib.error.HTTPError as e:
         try:
-            return e.code, json.loads(e.read() or b"{}")
+            return _as_object(e.code, json.loads(e.read() or b"{}"))
         except (ValueError, OSError):
             return e.code, {}
     except (OSError, ValueError) as e:
@@ -100,10 +149,10 @@ def post_json(url: str, body: dict, timeout: float) -> tuple[int, dict]:
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, json.loads(resp.read())
+            return _as_object(resp.status, json.loads(resp.read()))
     except urllib.error.HTTPError as e:
         try:
-            return e.code, json.loads(e.read() or b"{}")
+            return _as_object(e.code, json.loads(e.read() or b"{}"))
         except (ValueError, OSError):
             return e.code, {}
     except (OSError, ValueError) as e:
@@ -122,6 +171,7 @@ class ReplicaState:
         self.set_name = set_name
         self.drained = False          # router-side: operator rollout
         self.draining_remote = False  # replica-side: its own SIGTERM
+        self.quarantined = False      # supervisor-side: being restarted
         self.failures = 0             # consecutive probe failures
         self.probed = False
         self.last_probe_unix = 0.0
@@ -133,13 +183,39 @@ class ReplicaState:
         self.dispatched = 0
         self.completed = 0
         self.errors = 0
+        # Circuit breaker (ISSUE 10). States: "closed" (normal),
+        # "open" (ejected — no dispatch until the cooldown expires),
+        # "half_open" (cooldown expired — exactly ONE trial in flight
+        # at a time; success readmits, failure re-ejects). Transitions
+        # happen under the Router's lock.
+        self.breaker = "closed"
+        self.consec_errors = 0        # consecutive dispatch failures
+        self.open_until = 0.0         # monotonic: open -> half_open
+        self.half_open_trial = False  # a half-open trial is in flight
 
-    def eligible(self, unhealthy_after: int) -> bool:
-        return (
-            not self.drained
-            and not self.draining_remote
-            and self.failures < unhealthy_after
-        )
+    def breaker_poll(self, now: float) -> None:
+        """Open -> half-open once the cooldown expires (caller holds
+        the router lock)."""
+        if self.breaker == "open" and now >= self.open_until:
+            self.breaker = "half_open"
+            self.half_open_trial = False
+
+    def eligible(self, unhealthy_after: int,
+                 now: float | None = None) -> bool:
+        if (
+            self.drained
+            or self.draining_remote
+            or self.quarantined
+            or self.failures >= unhealthy_after
+        ):
+            return False
+        if self.breaker == "closed":
+            return True
+        if now is None:
+            now = time.monotonic()
+        if self.breaker == "open":
+            return now >= self.open_until  # pick() flips to half_open
+        return not self.half_open_trial    # half_open: one trial only
 
     def load_score(self) -> float:
         """Least-loaded dispatch key: queued requests dominate, KV
@@ -152,6 +228,9 @@ class ReplicaState:
             "set": self.set_name,
             "drained": self.drained,
             "draining_remote": self.draining_remote,
+            "quarantined": self.quarantined,
+            "breaker": self.breaker,
+            "consec_errors": self.consec_errors,
             "probe_failures": self.failures,
             "queue_depth": self.queue_depth,
             "kv_occupancy": self.kv_occupancy,
@@ -275,28 +354,52 @@ class Router:
                 r.url + "/health", self.cfg.probe_timeout_s
             )
             r.last_probe_unix = time.time()
-            if status == 0:
-                r.failures += 1
+            if status == 0 or not isinstance(body, dict):
+                # Transport failure OR a malformed/non-JSON body
+                # (_get_json coerces the latter to status 0): the
+                # replica is marked unhealthy and the sweep moves on to
+                # the next one — garbage can fail a replica, never the
+                # probe loop (ISSUE 10 satellite).
+                with self._lock:
+                    r.failures += 1
                 if r.failures == self.cfg.unhealthy_after:
                     log.warning(
-                        "replica %s unreachable after %d probes — "
-                        "rotating out", r.url, r.failures,
+                        "replica %s unreachable or malformed after %d "
+                        "probes — rotating out", r.url, r.failures,
                     )
                 continue
             # Any HTTP answer means the process is alive; a 503 with
             # draining=true is the replica's own drain, not a failure.
-            r.failures = 0
-            r.probed = True
-            r.draining_remote = bool(body.get("draining"))
-            for field in ("queue_depth", "kv_occupancy",
-                          "active_requests"):
-                v = body.get(field)
-                if isinstance(v, (int, float)):
-                    setattr(r, field, float(v))
-            for field in ("slots", "post_warmup_recompiles"):
-                v = body.get(field)
-                if isinstance(v, (int, float)):
-                    setattr(r, field, int(v))
+            with self._lock:
+                r.failures = 0
+                r.probed = True
+                r.draining_remote = bool(body.get("draining"))
+                for field in ("queue_depth", "kv_occupancy",
+                              "active_requests"):
+                    v = body.get(field)
+                    if isinstance(v, (int, float)):
+                        setattr(r, field, float(v))
+                for field in ("slots", "post_warmup_recompiles"):
+                    v = body.get(field)
+                    if isinstance(v, (int, float)):
+                        setattr(r, field, int(v))
+                # Half-open probe -> readmit (ISSUE 10): once the
+                # breaker's cooldown has expired, a green /health is
+                # the trial — the replica rejoins dispatch without
+                # risking a live request on it.
+                r.breaker_poll(time.monotonic())
+                if (
+                    status == 200
+                    and r.breaker == "half_open"
+                    and not r.half_open_trial
+                ):
+                    r.breaker = "closed"
+                    r.consec_errors = 0
+                    self.registry.counter("router/readmits_total").inc()
+                    log.info(
+                        "replica %s readmitted (half-open /health probe "
+                        "green)", r.url,
+                    )
         self.registry.gauge("router/replicas_eligible").set(
             sum(r.eligible(self.cfg.unhealthy_after)
                 for r in self.replicas)
@@ -350,25 +453,65 @@ class Router:
         r.failures = 0
         return True
 
+    # ------------------------------------------------------ supervision
+
+    def quarantine(self, url: str) -> bool:
+        """Rotate a replica out while the supervisor restarts it: no
+        dispatch, no matter what its breaker or probe state says, until
+        :meth:`readmit`."""
+        r = self._find(url)
+        if r is None:
+            return False
+        with self._lock:
+            r.quarantined = True
+        log.warning("replica %s quarantined (supervisor)", r.url)
+        return True
+
+    def readmit(self, url: str) -> bool:
+        """Re-admit a restarted replica with a clean slate (the
+        supervisor calls this only after its /health has gone green)."""
+        r = self._find(url)
+        if r is None:
+            return False
+        with self._lock:
+            r.quarantined = False
+            r.draining_remote = False
+            r.failures = 0
+            r.consec_errors = 0
+            r.breaker = "closed"
+            r.half_open_trial = False
+        self.registry.counter("router/readmits_total").inc()
+        log.info("replica %s readmitted (supervisor)", r.url)
+        return True
+
     # --------------------------------------------------------- dispatch
 
     def pick(self, *, set_name: str | None = None,
              exclude: tuple = ()) -> ReplicaState | None:
         """Least-loaded eligible replica (of ``set_name`` when the
-        canary split is routing), ties broken by fewest dispatches."""
+        canary split is routing), ties broken by fewest dispatches. A
+        half-open replica may be picked for exactly one trial request
+        at a time (the dispatch outcome closes or re-opens its
+        breaker)."""
         with self._lock:
-            pool = [
-                r for r in self.replicas
-                if r.eligible(self.cfg.unhealthy_after)
-                and r not in exclude
-                and (set_name is None or r.set_name == set_name)
-            ]
+            now = time.monotonic()
+            pool = []
+            for r in self.replicas:
+                r.breaker_poll(now)
+                if (
+                    r.eligible(self.cfg.unhealthy_after, now)
+                    and r not in exclude
+                    and (set_name is None or r.set_name == set_name)
+                ):
+                    pool.append(r)
             if not pool:
                 return None
             best = min(
                 pool, key=lambda r: (r.load_score(), r.dispatched)
             )
             best.dispatched += 1
+            if best.breaker == "half_open":
+                best.half_open_trial = True
             return best
 
     def _route_set(self) -> str | None:
@@ -383,10 +526,154 @@ class Router:
         f = min(max(self.cfg.canary_fraction, 0.0), 1.0)
         return "canary" if int((n + 1) * f) != int(n * f) else "base"
 
+    # -------------------------------------------- dispatch bookkeeping
+
+    def _note_success(self, r: ReplicaState) -> None:
+        with self._lock:
+            r.completed += 1
+            r.consec_errors = 0
+            if r.breaker != "closed":
+                r.breaker = "closed"
+                r.half_open_trial = False
+                self.registry.counter("router/readmits_total").inc()
+                log.info(
+                    "replica %s readmitted (half-open trial request "
+                    "succeeded)", r.url,
+                )
+
+    def _note_failure(self, r: ReplicaState, *, transport: bool,
+                      draining: bool, breaker: bool = True) -> None:
+        """Book one dispatch failure. ``transport`` also bumps the
+        probe-failure count (the replica may be gone); ``draining``
+        marks the replica's own drain instead of tripping the breaker
+        (an orderly drain is not a fault); ``breaker=False`` for 4xx
+        replies (the request's fault, not the replica's)."""
+        now = time.monotonic()
+        with self._lock:
+            r.errors += 1
+            if transport:
+                r.failures += 1
+            if draining:
+                r.draining_remote = True
+                r.half_open_trial = False
+                return
+            if not breaker:
+                return
+            r.consec_errors += 1
+            if r.breaker == "half_open":
+                r.breaker = "open"
+                r.open_until = now + self.cfg.eject_cooldown_s
+                r.half_open_trial = False
+                self.registry.counter("router/ejections_total").inc()
+                log.warning(
+                    "replica %s re-ejected (half-open trial failed); "
+                    "next probe in %.1fs", r.url,
+                    self.cfg.eject_cooldown_s,
+                )
+            elif (
+                r.breaker == "closed"
+                and r.consec_errors >= self.cfg.eject_after
+            ):
+                r.breaker = "open"
+                r.open_until = now + self.cfg.eject_cooldown_s
+                self.registry.counter("router/ejections_total").inc()
+                log.warning(
+                    "replica %s EJECTED after %d consecutive dispatch "
+                    "failures (circuit breaker open, half-open probe "
+                    "in %.1fs)", r.url, r.consec_errors,
+                    self.cfg.eject_cooldown_s,
+                )
+
+    def _send_to(self, r: ReplicaState, body: dict,
+                 kind: str) -> tuple[int, dict]:
+        """One real dispatch to one replica, with breaker bookkeeping."""
+        status, reply = post_json(
+            r.url + "/" + kind, body, self.cfg.request_timeout_s
+        )
+        if status == 200:
+            self._note_success(r)
+        elif status in (0, 503):
+            self._note_failure(
+                r, transport=(status == 0),
+                draining=bool(reply.get("draining")),
+            )
+        else:
+            # The replica ANSWERED (400/404/500/504): never re-run the
+            # request elsewhere. 5xx still counts against the breaker —
+            # a replica answering 500s is failing; a 4xx is the
+            # request's own fault.
+            self._note_failure(
+                r, transport=False, draining=False,
+                breaker=(status >= 500),
+            )
+        return status, reply
+
+    def _dispatch(self, primary: ReplicaState, body: dict, kind: str,
+                  set_name: str | None,
+                  tried: list) -> tuple[int, dict]:
+        """One dispatch attempt — hedged when ``hedge_after_s`` is set:
+        if the primary has not answered by the hedge deadline, the
+        request is sent again to another replica; the first 200 wins
+        and the loser is abandoned (its eventual reply is discarded;
+        idempotent-by-seeding makes the duplicate execution harmless).
+        Any hedge replica used is appended to ``tried``."""
+        if self.cfg.hedge_after_s <= 0:
+            return self._send_to(primary, body, kind)
+        results: queue.Queue = queue.Queue()
+
+        def run(rep):
+            results.put((rep, *self._send_to(rep, body, kind)))
+
+        threading.Thread(
+            target=run, args=(primary,), name="router-dispatch",
+            daemon=True,
+        ).start()
+        try:
+            _, status, reply = results.get(
+                timeout=self.cfg.hedge_after_s
+            )
+            return status, reply  # answered before the hedge deadline
+        except queue.Empty:
+            pass
+        hedge = self.pick(set_name=set_name, exclude=tuple(tried))
+        if hedge is None and set_name is not None:
+            hedge = self.pick(exclude=tuple(tried))
+        if hedge is None:
+            _, status, reply = results.get()  # nothing to hedge with
+            return status, reply
+        tried.append(hedge)
+        self.registry.counter("router/hedges_total").inc()
+        self.registry.counter("router/dispatched_total").inc()
+        threading.Thread(
+            target=run, args=(hedge,), name="router-hedge", daemon=True,
+        ).start()
+        first_failure = None
+        for arrival in range(2):
+            rep, status, reply = results.get()
+            if status == 200:
+                if arrival == 0:
+                    # The slower dispatch is still in flight: abandon
+                    # it — its reply is discarded on arrival (only
+                    # breaker bookkeeping runs).
+                    self.registry.counter(
+                        "router/hedge_cancelled_total"
+                    ).inc()
+                if rep is hedge:
+                    self.registry.counter(
+                        "router/hedge_wins_total"
+                    ).inc()
+                return status, reply
+            if first_failure is None:
+                first_failure = (status, reply)
+        return first_failure
+
     def handle(self, body: dict, *, kind: str) -> tuple[int, dict]:
         """Dispatch one generate/classify request: least-loaded pick,
-        retry once on 503/transport failure (same set, different
-        replica, within the per-request budget)."""
+        bounded retry with backoff on 503/transport failure (different
+        replica of the same set, within the per-request wall budget).
+        A transport failure mid-request is an in-flight failover: the
+        re-dispatch replays the request from the prompt on another
+        replica, token-identical by the per-request seeding."""
         reg = self.registry
         reg.counter("router/requests_total").inc()
         set_name = self._route_set()
@@ -394,13 +681,31 @@ class Router:
         tried: list[ReplicaState] = []
         attempts = 0
         while True:
+            within_budget = (
+                time.monotonic() - t0 < self.cfg.retry_budget_s
+            )
             r = self.pick(set_name=set_name, exclude=tuple(tried))
             if r is None and tried and set_name is not None:
-                # The preferred set has no second replica: the retry
+                # The preferred set has no further replica: the retry
                 # may cross sets rather than fail the request (the
                 # canary compare just loses one sample).
                 r = self.pick(exclude=tuple(tried))
             if r is None:
+                if (
+                    tried
+                    and attempts <= self.cfg.max_retries
+                    and within_budget
+                ):
+                    # Mid-failover with every replica momentarily
+                    # ineligible (e.g. the supervisor is restarting
+                    # one and the rest are shedding): wait out a slice
+                    # of the budget and rescan the whole pool instead
+                    # of failing a request we already accepted.
+                    time.sleep(
+                        min(0.05, self.cfg.retry_budget_s / 20)
+                    )
+                    tried = []
+                    continue
                 reg.counter("router/no_replica_total").inc()
                 status, reply = 503, {
                     "error": "no live replica available", "retry": True,
@@ -408,28 +713,36 @@ class Router:
                 break
             tried.append(r)
             reg.counter("router/dispatched_total").inc()
-            status, reply = post_json(
-                r.url + "/" + kind, body, self.cfg.request_timeout_s
+            status, reply = self._dispatch(
+                r, body, kind, set_name, tried
             )
             if status == 200:
-                r.completed += 1
                 break
             if status in (0, 503):
-                r.errors += 1
-                if status == 0:
-                    r.failures += 1
                 attempts += 1
                 within_budget = (
                     time.monotonic() - t0 < self.cfg.retry_budget_s
                 )
                 if attempts <= self.cfg.max_retries and within_budget:
                     reg.counter("router/retries_total").inc()
+                    if status == 0:
+                        # The replica died with the request possibly
+                        # mid-decode: replay it from the prompt
+                        # elsewhere.
+                        reg.counter("router/failovers_total").inc()
+                    backoff = self.cfg.retry_backoff_s * (
+                        2 ** (attempts - 1)
+                    )
+                    remaining = self.cfg.retry_budget_s - (
+                        time.monotonic() - t0
+                    )
+                    if backoff > 0 and remaining > 0:
+                        time.sleep(min(backoff, remaining))
                     continue
                 status = 503
                 break
             # 400/404/500/504: the replica processed (or rejected) the
             # request — never re-run it elsewhere.
-            r.errors += 1
             break
         stats = self._set_stats[
             (tried[-1].set_name if tried else None) or set_name or "base"
@@ -485,6 +798,22 @@ class Router:
             ),
             "router_no_replica": int(
                 counters.get("router/no_replica_total", 0)
+            ),
+            # --- v7 (ISSUE 10): fault-tolerance counters ---
+            "router_ejections": int(
+                counters.get("router/ejections_total", 0)
+            ),
+            "router_readmits": int(
+                counters.get("router/readmits_total", 0)
+            ),
+            "router_hedges": int(
+                counters.get("router/hedges_total", 0)
+            ),
+            "router_failovers": int(
+                counters.get("router/failovers_total", 0)
+            ),
+            "router_restarts": int(
+                counters.get("router/restarts_total", 0)
             ),
         }
         return {

@@ -1,0 +1,281 @@
+"""Replica supervision: detect a dead/stuck replica, restart it,
+re-admit it only after /health goes green (ISSUE 10 tentpole (1)).
+
+PR 8's router already *stops dispatching* to a replica that dies (probe
+failures rotate it out; the ISSUE 10 circuit breaker ejects it on
+dispatch failures) — but nothing brought it back: a crashed replica
+left a hole in the fleet until an operator noticed. This module is the
+missing loop, the serving mirror of the trainer's PreemptionGuard
+discipline: **failure is a normal input**.
+
+The supervisor owns a set of :class:`ReplicaHandle`-shaped objects —
+anything with ``url``, ``alive()`` and a blocking ``restart()`` — and a
+background thread that, per sweep:
+
+1. **Detects** a dead or stuck replica: ``alive()`` false (process
+   exit / in-proc kill), or its ``/health`` not answering green for
+   longer than ``health_stall_s`` (a wedged process that still holds
+   its socket — the serving version of the training watchdog's hung
+   step).
+2. **Quarantines** it in the router (``Router.quarantine`` — no
+   dispatch no matter what the probe/breaker state says) so the
+   restart window cannot eat requests.
+3. **Restarts** it via the handle — for the in-proc chaos replicas
+   (serving/chaos.py) that means a fresh engine + **full AOT warmup**
+   of the bucket ladder; for :class:`ProcessReplica` a respawned
+   process whose own startup warms.
+4. **Re-admits** it (``Router.readmit``) only once ``/health`` answers
+   200 with ``ok: true`` — never a cold or half-warm replica; bumps
+   ``router/restarts_total`` (the schema-v7 ``router_restarts``
+   counter).
+
+A handle that keeps dying is retried up to ``max_restarts`` times with
+``restart_backoff_s`` between attempts, then left quarantined with an
+ERROR — a crash-looping build must page an operator, not flap the
+fleet forever. ``tools/serve_fleet.py --spawn`` wires this over real
+processes; the chaos tier (tests/test_chaos.py, ``serve_bench
+--chaos``) drives it in-proc.
+"""
+
+from __future__ import annotations
+
+import logging
+import shlex
+import subprocess
+import sys
+import threading
+import time
+
+from tensorflow_examples_tpu.serving.router import Router, _get_json
+
+log = logging.getLogger(__name__)
+
+
+class ProcessReplica:
+    """A replica that is a real child process (``serve_fleet --spawn``).
+
+    ``cmd`` is the spawn command (string, ``shlex``-split; a ``{port}``
+    placeholder receives ``port``). The process is expected to serve
+    the PR 5 frontend surface on ``http://127.0.0.1:{port}``.
+    """
+
+    def __init__(self, cmd: str, *, port: int,
+                 host: str = "127.0.0.1",
+                 stop_timeout_s: float = 10.0):
+        self.cmd = cmd
+        self.port = int(port)
+        self.url = f"http://{host}:{self.port}"
+        self.stop_timeout_s = stop_timeout_s
+        self._proc: subprocess.Popen | None = None
+
+    def start(self) -> "ProcessReplica":
+        argv = shlex.split(self.cmd.format(port=self.port))
+        log.info("spawning replica %s: %s", self.url, argv)
+        self._proc = subprocess.Popen(argv)
+        return self
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def terminate(self) -> None:
+        """SIGTERM (the replica's own drain path), escalate to SIGKILL
+        after ``stop_timeout_s``."""
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=self.stop_timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=self.stop_timeout_s)
+
+    def restart(self) -> None:
+        self.terminate()
+        self.start()
+
+    def close(self) -> None:
+        self.terminate()
+
+
+class Supervisor:
+    """Watch replicas, restart the dead/stuck ones, re-admit on green.
+
+    ``handles`` maps replica URL -> handle; every URL must already be a
+    replica of ``router``. Restarts run serially on the supervisor
+    thread (one failure at a time is the design point; a correlated
+    fleet-wide outage needs an operator anyway).
+    """
+
+    def __init__(
+        self,
+        router: Router,
+        handles,
+        *,
+        poll_s: float = 0.25,
+        health_stall_s: float = 5.0,
+        health_timeout_s: float = 2.0,
+        warm_timeout_s: float = 300.0,
+        max_restarts: int = 5,
+        restart_backoff_s: float = 0.5,
+    ):
+        self.router = router
+        self.handles = {h.url.rstrip("/"): h for h in handles}
+        for url in self.handles:
+            if router._find(url) is None:
+                raise ValueError(
+                    f"supervised url {url} is not a router replica"
+                )
+        self.poll_s = poll_s
+        self.health_stall_s = health_stall_s
+        self.health_timeout_s = health_timeout_s
+        self.warm_timeout_s = warm_timeout_s
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        # Completed restart cycles (reporting: serve_bench --chaos sums
+        # this into router_restarts).
+        self.restarts: dict[str, int] = {u: 0 for u in self.handles}
+        # Failed attempts within the CURRENT incident — reset on every
+        # successful readmit, so max_restarts bounds one crash-loop,
+        # not the replica's whole lifetime (a replica independently
+        # recovered N times must not be abandoned on failure N+1).
+        self._attempts: dict[str, int] = {u: 0 for u in self.handles}
+        self.given_up: set[str] = set()
+        # (url, event) rows: "detected" / "restarted" / "readmitted" /
+        # "gave_up" — the chaos tier asserts the transition sequence.
+        self.events: list[tuple[str, str]] = []
+        self._last_ok = {u: time.monotonic() for u in self.handles}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ sweep
+
+    def _healthy(self, url: str) -> bool:
+        status, body = _get_json(
+            url + "/health", self.health_timeout_s
+        )
+        if status == 0:
+            return False
+        # Any well-formed HTTP answer means the process is responsive;
+        # a 503 that is an orderly drain is NOT a stall (the replica is
+        # finishing its work on purpose).
+        return status == 200 or bool(body.get("draining"))
+
+    def check_once(self) -> None:
+        """One synchronous sweep (the loop body; tests call it
+        directly for determinism)."""
+        now = time.monotonic()
+        for url, handle in self.handles.items():
+            if url in self.given_up:
+                continue
+            if handle.alive() and self._healthy(url):
+                self._last_ok[url] = time.monotonic()
+                continue
+            stalled = now - self._last_ok[url]
+            if handle.alive() and stalled < self.health_stall_s:
+                continue  # transient blip: give /health time to recover
+            reason = (
+                "process dead" if not handle.alive()
+                else f"/health stalled {stalled:.1f}s"
+            )
+            log.warning(
+                "SUPERVISOR: replica %s down (%s) — quarantining and "
+                "restarting", url, reason,
+            )
+            self.events.append((url, "detected"))
+            self.router.quarantine(url)
+            self._restart(url, handle)
+
+    def _restart(self, url: str, handle) -> None:
+        while self._attempts[url] < self.max_restarts:
+            self._attempts[url] += 1
+            try:
+                handle.restart()  # blocking: respawn + re-warm the AOT
+                #                   ladder before anything is re-admitted
+            except Exception:  # noqa: BLE001 — a failed restart must
+                # not kill the supervisor loop
+                log.exception(
+                    "SUPERVISOR: restart of %s failed (attempt %d/%d)",
+                    url, self._attempts[url], self.max_restarts,
+                )
+                time.sleep(self.restart_backoff_s)
+                continue
+            self.events.append((url, "restarted"))
+            if self._await_green(url):
+                self._last_ok[url] = time.monotonic()
+                self._attempts[url] = 0  # incident over: fresh budget
+                self.restarts[url] += 1
+                self.router.readmit(url)
+                self.router.registry.counter(
+                    "router/restarts_total"
+                ).inc()
+                self.events.append((url, "readmitted"))
+                log.info(
+                    "SUPERVISOR: replica %s restarted and re-admitted "
+                    "(/health green)", url,
+                )
+                return
+            log.warning(
+                "SUPERVISOR: restarted %s never went green within "
+                "%.1fs (attempt %d/%d)", url, self.warm_timeout_s,
+                self._attempts[url], self.max_restarts,
+            )
+            time.sleep(self.restart_backoff_s)
+        self.given_up.add(url)
+        self.events.append((url, "gave_up"))
+        log.error(
+            "SUPERVISOR: giving up on %s after %d restart attempts — "
+            "left quarantined; operator action required", url,
+            self.max_restarts,
+        )
+
+    def _await_green(self, url: str) -> bool:
+        deadline = time.monotonic() + self.warm_timeout_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            status, body = _get_json(
+                url + "/health", self.health_timeout_s
+            )
+            if status == 200 and body.get("ok"):
+                return True
+            time.sleep(min(0.05, self.poll_s))
+        return False
+
+    # -------------------------------------------------------- lifecycle
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                log.exception("supervisor sweep failed")
+            self._stop.wait(self.poll_s)
+
+    def start(self) -> "Supervisor":
+        self._thread = threading.Thread(
+            target=self._loop, name="replica-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(10.0, self.warm_timeout_s))
+
+
+def main_check(urls, timeout_s: float = 2.0) -> int:  # pragma: no cover
+    """Tiny CLI helper: print each replica's health verdict (used by
+    operators, not tests)."""
+    rc = 0
+    for url in urls:
+        status, body = _get_json(
+            url.rstrip("/") + "/health", timeout_s
+        )
+        ok = status == 200 and bool(body.get("ok"))
+        print(f"{url}: {'OK' if ok else f'DOWN (status {status})'}")
+        rc = rc or (0 if ok else 1)
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main_check(sys.argv[1:]))

@@ -1,7 +1,9 @@
 """Self-describing JSONL metrics schema (ISSUE 2 CI satellite; v2 in
 ISSUE 3; v3 in ISSUE 4; v4 in ISSUE 5; v5 in ISSUE 7; v6 in ISSUE 8 —
 paged-KV block/prefix-cache fields and router-tier fields on the
-``serving`` object, see ``SERVING_KEYS_V6``).
+``serving`` object, see ``SERVING_KEYS_V6``; v7 in ISSUE 10 —
+fault-tolerance counters on the router's ``serving`` object, see
+``SERVING_KEYS_V7``).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -110,9 +112,14 @@ SCHEMA_VERSION = 5
 # v3-shaped line plus the required "serving" object introduced in v4:
 # active_requests / queue_depth / slots / kv_occupancy /
 # post_warmup_recompiles / draining).
-SERVING_SCHEMA_VERSION = 6
+#
+# Version 7 (ISSUE 10): additive — the router's serving object may
+# carry the fault-tolerance counters (router_ejections /
+# router_readmits / router_hedges / router_failovers /
+# router_restarts), all numeric; forbidden on v4-v6 serving lines.
+SERVING_SCHEMA_VERSION = 7
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -155,6 +162,15 @@ SERVING_KEYS_V6 = ("block_size", "blocks_total", "blocks_used",
                    "prefix_hits", "prefix_misses", "prefix_hit_rate",
                    "kv_bits", "replicas", "router_dispatched",
                    "router_retries", "router_no_replica")
+
+# v7-only serving-object keys (ISSUE 10): the router's fault-tolerance
+# counters — circuit-breaker ejections/readmits, hedged dispatches,
+# in-flight failovers, and supervisor restart cycles. Optional on
+# write (a single-engine line carries none), FORBIDDEN on v4-v6
+# serving lines, same mislabeling rule as every earlier bump.
+SERVING_KEYS_V7 = ("router_ejections", "router_readmits",
+                   "router_hedges", "router_failovers",
+                   "router_restarts")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -414,6 +430,13 @@ def validate_line(obj: Any) -> list[str]:
                     if key in obj["serving"]:
                         problems.append(
                             f"v6 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
+            if version < 7:
+                for key in SERVING_KEYS_V7:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v7 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
     elif "serving" in obj:

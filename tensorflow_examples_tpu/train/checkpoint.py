@@ -12,11 +12,25 @@ async save as the torn "latest" checkpoint. ``restore_latest`` validates
 the saved tree structure/shapes/dtypes against the live state up front
 and names the mismatching paths, instead of failing deep inside orbax on
 shape or dtype drift.
+
+Integrity (ISSUE 10 satellite): every COMMITTED step directory gets a
+``manifest.sha256.json`` sidecar (file -> sha256 over the whole step
+dir, written right after the async commit lands — at the next ``save``
+or at ``wait``/``close``). ``restore_latest`` verifies the manifest
+before restoring: a torn or bit-flipped checkpoint (power loss,
+flaky blob store) is skipped with a WARNING **naming the corrupt
+file**, and the restore falls back to the newest intact step instead
+of failing the run with an opaque orbax error. Checkpoints from
+before this PR have no manifest and restore exactly as before.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
+import os
+import threading
 from typing import Any
 
 import orbax.checkpoint as ocp
@@ -26,23 +40,41 @@ from tensorflow_examples_tpu.telemetry.spans import span as _trace_span
 
 log = logging.getLogger(__name__)
 
+MANIFEST_NAME = "manifest.sha256.json"
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
 
 class CheckpointManager:
     def __init__(self, workdir: str, *, max_to_keep: int = 3, async_save: bool = True):
-        import os
-
         # item_handlers pre-registers the standard handler so a FRESH
         # manager (the resume path) can read item_metadata — without it
         # orbax returns None metadata until the first save, and
         # restore-time structure validation would silently skip.
+        self._dir = os.path.abspath(os.path.join(workdir, "checkpoints"))
         self._mngr = ocp.CheckpointManager(
-            os.path.abspath(os.path.join(workdir, "checkpoints")),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
             ),
             item_handlers=ocp.StandardCheckpointHandler(),
         )
+        # Manifest stamping runs off the training thread (sha256 over a
+        # multi-GB step dir would otherwise stall the step loop — the
+        # exact blocking cost async_save exists to avoid). The lock
+        # serializes stampers; wait()/close() join the in-flight one.
+        self._manifest_lock = threading.Lock()
+        self._manifest_thread: threading.Thread | None = None
 
     def __enter__(self) -> "CheckpointManager":
         return self
@@ -63,6 +95,23 @@ class CheckpointManager:
         with _trace_span("checkpoint_save", step=step):
             self._mngr.save(step, args=ocp.args.StandardSave(_as_dict(state)))
         default_registry().counter("checkpoint/saves").inc()
+        # Every EARLIER step is committed by now (orbax serializes
+        # async saves: a new save waits for the previous commit), so
+        # any of them still missing an integrity manifest gets one —
+        # hashed on a background thread, never the step loop. The
+        # just-enqueued step may still be in flight — it is stamped by
+        # a later save, or by wait()/close(). If the previous stamper
+        # is still running, skip: stamping is idempotent and the next
+        # trigger catches up.
+        prev = self._manifest_thread
+        if prev is None or not prev.is_alive():
+            self._manifest_thread = threading.Thread(
+                target=self._write_manifests,
+                kwargs={"exclude_step": step},
+                name="ckpt-manifest-stamp",
+                daemon=True,
+            )
+            self._manifest_thread.start()
 
     def restore_latest(
         self, state: Any, *, validate: bool = True
@@ -75,21 +124,140 @@ class CheckpointManager:
         sharded (docs/sharding.md). Such leaves get a default
         single-device placement here, so any checkpoint — written on
         any mesh — restores through a shardings-free template onto the
-        local default device (resharding on restore is the contract)."""
-        step = self._mngr.latest_step()
-        if step is None:
+        local default device (resharding on restore is the contract).
+
+        Integrity fallback (ISSUE 10): steps whose sha256 manifest does
+        not verify — and steps orbax itself fails to deserialize — are
+        skipped with a WARNING naming the corrupt file, falling back to
+        the newest intact step. Structure/shape drift found by
+        ``validate`` still raises (that is a config mistake, not
+        corruption — silently restoring an OLDER checkpoint with the
+        same wrong config would mask it)."""
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
             return None
-        with _trace_span("checkpoint_restore", step=step):
-            target = _with_default_shardings(_as_dict(state))
-            if validate:
-                self._validate_structure(step, target)
-            restored = self._mngr.restore(
-                step, args=ocp.args.StandardRestore(target)
-            )
-            merged = _merge_arrays(state, restored)
-        default_registry().counter("checkpoint/restores").inc()
-        log.info("restored checkpoint at step %d", step)
-        return merged, step
+        target = _with_default_shardings(_as_dict(state))
+        corrupt: list[str] = []
+        for step in steps:
+            problems = self.verify_step_integrity(step)
+            if problems:
+                shown = "; ".join(problems[:5])
+                log.warning(
+                    "checkpoint at step %d fails its integrity "
+                    "manifest (%s)%s", step, shown,
+                    " — falling back to an older checkpoint"
+                    if step != steps[-1] else "",
+                )
+                default_registry().counter(
+                    "checkpoint/corrupt_skipped"
+                ).inc()
+                corrupt.append(f"step {step}: {shown}")
+                continue
+            with _trace_span("checkpoint_restore", step=step):
+                if validate:
+                    self._validate_structure(step, target)
+                try:
+                    restored = self._mngr.restore(
+                        step, args=ocp.args.StandardRestore(target)
+                    )
+                except Exception as e:  # noqa: BLE001 — a torn step
+                    # that slipped past the manifest (or predates it)
+                    # must not fail the run while an intact older
+                    # step exists.
+                    default_registry().counter(
+                        "checkpoint/corrupt_skipped"
+                    ).inc()
+                    corrupt.append(
+                        f"step {step}: {type(e).__name__}: {e}"
+                    )
+                    if step == steps[-1]:
+                        break
+                    log.warning(
+                        "restore of step %d failed inside orbax "
+                        "(%s: %s) — falling back to an older "
+                        "checkpoint", step, type(e).__name__, e,
+                    )
+                    continue
+                merged = _merge_arrays(state, restored)
+            default_registry().counter("checkpoint/restores").inc()
+            if corrupt:
+                log.warning(
+                    "restored checkpoint at step %d after skipping %d "
+                    "corrupt newer step(s)", step, len(corrupt),
+                )
+            else:
+                log.info("restored checkpoint at step %d", step)
+            return merged, step
+        raise RuntimeError(
+            "every checkpoint in %s is corrupt:\n  %s"
+            % (self._dir, "\n  ".join(corrupt))
+        )
+
+    # ------------------------------------------------------- integrity
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(step))
+
+    def _write_manifests(self, exclude_step: int | None = None) -> None:
+        """Stamp a sha256 manifest into every committed step dir that
+        lacks one (idempotent; the manifest itself is excluded from its
+        own hash set). Written atomically so a crash mid-stamp can
+        never leave a torn manifest posing as a verdict. A step swept
+        away by max_to_keep mid-stamp is skipped, not an error."""
+        with self._manifest_lock:
+            for step in self._mngr.all_steps():
+                if step == exclude_step:
+                    continue
+                step_dir = self._step_dir(step)
+                manifest = os.path.join(step_dir, MANIFEST_NAME)
+                if not os.path.isdir(step_dir) \
+                        or os.path.exists(manifest):
+                    continue
+                files = {}
+                try:
+                    for root, _, names in os.walk(step_dir):
+                        for name in sorted(names):
+                            if name == MANIFEST_NAME:
+                                continue
+                            full = os.path.join(root, name)
+                            files[os.path.relpath(full, step_dir)] = (
+                                _sha256_file(full)
+                            )
+                    tmp = manifest + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(
+                            {"step": step, "files": files}, f, indent=1
+                        )
+                        f.write("\n")
+                    os.replace(tmp, manifest)
+                except FileNotFoundError:
+                    continue  # rotated out from under us (max_to_keep)
+                log.debug(
+                    "stamped integrity manifest for step %d (%d files)",
+                    step, len(files),
+                )
+
+    def verify_step_integrity(self, step: int) -> list[str]:
+        """Problems with step's on-disk bytes vs its manifest (empty =
+        intact, or the step predates manifests)."""
+        step_dir = self._step_dir(step)
+        manifest = os.path.join(step_dir, MANIFEST_NAME)
+        if not os.path.exists(manifest):
+            return []  # pre-ISSUE-10 checkpoint: nothing to verify
+        try:
+            with open(manifest) as f:
+                doc = json.load(f)
+            files = doc["files"]
+        except (ValueError, KeyError, OSError) as e:
+            return [f"unreadable manifest {manifest}: {e}"]
+        problems = []
+        for rel, digest in sorted(files.items()):
+            full = os.path.join(step_dir, rel)
+            if not os.path.isfile(full):
+                problems.append(f"missing file {rel}")
+            elif _sha256_file(full) != digest:
+                problems.append(f"sha256 mismatch in {rel}")
+        return problems
 
     def _validate_structure(self, step: int, target: dict) -> None:
         """Compare the saved tree against the live state; raise a clear
@@ -164,11 +332,20 @@ class CheckpointManager:
                 f"{shown}{more}"
             )
 
+    def _join_manifest_thread(self) -> None:
+        t = self._manifest_thread
+        if t is not None and t is not threading.current_thread():
+            t.join()
+
     def wait(self) -> None:
         self._mngr.wait_until_finished()
+        self._join_manifest_thread()
+        self._write_manifests()
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
+        self._join_manifest_thread()
+        self._write_manifests()
         self._mngr.close()
 
 

@@ -37,6 +37,39 @@ or the ``TPU_FAULT_INJECT`` environment variable (read lazily on first
 ``active()`` call — how ``tools/fault_inject.py`` arms a child CLI).
 When no plan is armed every hook site is a single global-read + None
 check.
+
+**Serving faults (ISSUE 10).** The serving tier has its own plan — the
+failure unit is a *replica*, not a train step, so serve specs are
+``kind@replica:arg`` tokens, deterministic by construction (keyed on
+each replica's own decode-step / request / probe counters, never wall
+clock):
+
+  ``crash@R:N``      replica R dies before its Nth decode step (0-based):
+                     its frontend's in-flight connections are RESET (the
+                     router sees a transport failure, exactly like a
+                     killed process) and the batcher loop aborts with
+                     :class:`InjectedCrash`. Needs a registered crash
+                     callback (``register_serve_crash``) — the chaos
+                     harness's in-proc replicas register their ``kill``.
+  ``slowrep@R:S``    every decode step on replica R sleeps S seconds
+                     (a straggling replica: hedged dispatch territory).
+  ``transport@R:K``  the first K POST requests replica R's frontend
+                     receives are dropped with no response bytes (the
+                     client sees a reset — the router's in-flight
+                     failover path).
+  ``kvexhaust@R:N``  replica R's Nth decode step raises a forced
+                     ``BlockExhausted`` naming every active slot (the
+                     paged pool's loud capacity path, without needing a
+                     pool actually sized to starve).
+  ``badhealth@R:K``  the first K ``GET /health`` responses from replica
+                     R are non-JSON garbage bytes (the probe loop must
+                     mark the replica unhealthy, not crash).
+
+Hook sites: ``InferenceEngine.decode`` (``decode_step``),
+``ServingFrontend`` POST handling (``transport_fault``) and ``/health``
+(``health_fault``). Armed via ``serve_install(spec)`` in-process or the
+``TPU_SERVE_FAULT_INJECT`` env var (``tools/fault_inject.py --serve``);
+like the train side, an unarmed hook is one global read.
 """
 
 from __future__ import annotations
@@ -45,6 +78,7 @@ import dataclasses
 import logging
 import os
 import signal
+import threading
 import time
 from typing import Callable
 
@@ -199,6 +233,219 @@ class Engine:
                 f"injected io error for {what} "
                 f"({self._io_fails_left} more to come)"
             )
+
+
+# ---------------------------------------------------------- serving side
+
+SERVE_ENV_VAR = "TPU_SERVE_FAULT_INJECT"
+
+SERVE_KINDS = ("crash", "slowrep", "transport", "kvexhaust", "badhealth")
+
+
+class InjectedCrash(RuntimeError):
+    """Raised inside a replica's decode step by a ``crash@R:N`` fault:
+    the serving loop treats it like any fatal step error (fails the
+    in-flight batch), but by then the replica's transport is already
+    dead — clients observe a reset, not an HTTP status."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeFaultPlan:
+    crash_at: dict[int, int] = dataclasses.field(default_factory=dict)
+    slow_replica: dict[int, float] = dataclasses.field(default_factory=dict)
+    transport_drop: dict[int, int] = dataclasses.field(default_factory=dict)
+    kvexhaust_at: dict[int, int] = dataclasses.field(default_factory=dict)
+    bad_health: dict[int, int] = dataclasses.field(default_factory=dict)
+
+
+def parse_serve_spec(spec: str) -> ServeFaultPlan:
+    """Parse ``"crash@1:4,slowrep@0:0.2,transport@2:1,badhealth@0:3"``
+    (``kind@replica:arg`` tokens, comma separated)."""
+    crash: dict[int, int] = {}
+    slow: dict[int, float] = {}
+    transport: dict[int, int] = {}
+    kvex: dict[int, int] = {}
+    badhealth: dict[int, int] = {}
+    for token in filter(None, (t.strip() for t in spec.split(","))):
+        kind, _, arg = token.partition("@")
+        if kind not in SERVE_KINDS:
+            raise ValueError(
+                f"unknown serve fault kind {kind!r} "
+                f"(one of {'/'.join(SERVE_KINDS)})"
+            )
+        head, sep, tail = arg.partition(":")
+        if not head or not sep or not tail:
+            raise ValueError(
+                f"serve fault token {token!r} needs '@<replica>:<arg>'"
+            )
+        try:
+            replica = int(head)
+            if kind == "crash":
+                crash[replica] = int(tail)
+            elif kind == "slowrep":
+                slow[replica] = float(tail)
+            elif kind == "transport":
+                transport[replica] = int(tail)
+            elif kind == "kvexhaust":
+                kvex[replica] = int(tail)
+            else:
+                badhealth[replica] = int(tail)
+        except ValueError as e:
+            raise ValueError(
+                f"malformed serve fault token {token!r}: {e}"
+            ) from None
+    return ServeFaultPlan(
+        crash_at=crash, slow_replica=slow, transport_drop=transport,
+        kvexhaust_at=kvex, bad_health=badhealth,
+    )
+
+
+class ServeEngine:
+    """Runtime state for one armed ServeFaultPlan (per-replica counters,
+    fired-once sets). Every hook is lock-guarded: decode hooks run on
+    each replica's batcher thread, transport/health hooks on frontend
+    handler threads."""
+
+    def __init__(self, plan: ServeFaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._decode_steps: dict[int, int] = {}
+        self._transport_left = dict(plan.transport_drop)
+        self._health_left = dict(plan.bad_health)
+        self._fired_crash: set[int] = set()
+        self._fired_kvex: set[int] = set()
+        self.fired: list[tuple[str, int, int]] = []  # (kind, replica, idx)
+
+    # ------------------------------------------------------ decode hooks
+
+    def decode_step(self, replica: int, slots) -> None:
+        """Called at the top of every decode step; may sleep (slowrep),
+        raise a forced BlockExhausted (kvexhaust) or kill the replica
+        and raise InjectedCrash (crash)."""
+        with self._lock:
+            step = self._decode_steps.get(replica, 0)
+            self._decode_steps[replica] = step + 1
+            delay = self.plan.slow_replica.get(replica)
+            crash = (
+                self.plan.crash_at.get(replica) == step
+                and replica not in self._fired_crash
+            )
+            kvex = (
+                self.plan.kvexhaust_at.get(replica) == step
+                and replica not in self._fired_kvex
+            )
+            if crash:
+                self._fired_crash.add(replica)
+                self.fired.append(("crash", replica, step))
+            if kvex:
+                self._fired_kvex.add(replica)
+                self.fired.append(("kvexhaust", replica, step))
+            kill = _serve_crash_cbs.get(replica)
+        if delay:
+            log.warning(
+                "SERVE FAULT: replica %d decode step %d sleeping %.2fs",
+                replica, step, delay,
+            )
+            time.sleep(delay)
+        if kvex:
+            from tensorflow_examples_tpu.serving.paged_kv import (
+                BlockExhausted,
+            )
+
+            log.warning(
+                "SERVE FAULT: forced BlockExhausted on replica %d "
+                "decode step %d (slots %s)", replica, step, list(slots),
+            )
+            raise BlockExhausted(
+                f"injected KV block exhaustion on replica {replica}",
+                slots=tuple(slots),
+            )
+        if crash:
+            log.warning(
+                "SERVE FAULT: crashing replica %d before decode step %d",
+                replica, step,
+            )
+            if kill is not None:
+                kill()
+            raise InjectedCrash(f"injected crash of replica {replica}")
+
+    # --------------------------------------------------- frontend hooks
+
+    def transport_fault(self, replica: int) -> bool:
+        """True -> the frontend drops this request with no response
+        bytes (client-observable transport failure)."""
+        with self._lock:
+            left = self._transport_left.get(replica, 0)
+            if left <= 0:
+                return False
+            self._transport_left[replica] = left - 1
+            self.fired.append(("transport", replica, left))
+        log.warning(
+            "SERVE FAULT: dropping request on replica %d at the "
+            "transport level (%d more to come)", replica, left - 1,
+        )
+        return True
+
+    def health_fault(self, replica: int) -> bool:
+        """True -> /health answers non-JSON garbage this time."""
+        with self._lock:
+            left = self._health_left.get(replica, 0)
+            if left <= 0:
+                return False
+            self._health_left[replica] = left - 1
+            self.fired.append(("badhealth", replica, left))
+        return True
+
+
+# Crash callbacks live at module level, not on the armed engine, so a
+# replica can register its kill at build time regardless of whether the
+# plan is armed before or after the fleet comes up (replica id -> the
+# callable that makes that replica die at the transport level).
+_serve_crash_cbs: dict[int, Callable[[], None]] = {}
+
+
+def register_serve_crash(replica: int, kill: Callable[[], None]) -> None:
+    """Register replica ``replica``'s transport-kill callable (the
+    chaos harness registers ``InProcReplica.kill`` at every start)."""
+    _serve_crash_cbs[replica] = kill
+
+
+_serve_engine: ServeEngine | None = None
+_serve_env_checked = False
+
+
+def serve_install(spec_or_plan: str | ServeFaultPlan) -> ServeEngine:
+    """Arm a serve fault plan in-process (chaos harness / tests)."""
+    global _serve_engine, _serve_env_checked
+    plan = (
+        parse_serve_spec(spec_or_plan)
+        if isinstance(spec_or_plan, str)
+        else spec_or_plan
+    )
+    _serve_engine = ServeEngine(plan)
+    _serve_env_checked = True
+    return _serve_engine
+
+
+def serve_clear() -> None:
+    global _serve_engine, _serve_env_checked
+    _serve_engine = None
+    _serve_env_checked = False
+
+
+def serve_active() -> ServeEngine | None:
+    """The armed serve engine, lazily read from $TPU_SERVE_FAULT_INJECT."""
+    global _serve_engine, _serve_env_checked
+    if _serve_engine is None and not _serve_env_checked:
+        _serve_env_checked = True
+        spec = os.environ.get(SERVE_ENV_VAR, "")
+        if spec:
+            _serve_engine = ServeEngine(parse_serve_spec(spec))
+            log.info(
+                "serve fault injection armed from $%s=%s",
+                SERVE_ENV_VAR, spec,
+            )
+    return _serve_engine
 
 
 # ------------------------------------------------------- global activation

@@ -93,6 +93,30 @@ def faults():
     faults_mod.clear()
 
 
+@pytest.fixture
+def serve_faults():
+    """Arm a deterministic SERVING fault plan for one test (ISSUE 10).
+
+    Usage::
+
+        def test_x(serve_faults):
+            engine = serve_faults("crash@1:4,badhealth@0:3")
+            ...
+
+    Spec grammar: tensorflow_examples_tpu/utils/faults.py serve side
+    (crash@R:N, slowrep@R:S, transport@R:K, kvexhaust@R:N,
+    badhealth@R:K). Torn down afterwards even if the test dies
+    mid-fault.
+    """
+    from tensorflow_examples_tpu.utils import faults as faults_mod
+
+    def arm(spec: str):
+        return faults_mod.serve_install(spec)
+
+    yield arm
+    faults_mod.serve_clear()
+
+
 @pytest.fixture(scope="session")
 def devices():
     d = jax.devices()

@@ -1,0 +1,505 @@
+"""Serving chaos tier (ISSUE 10): replica death is a normal input.
+
+The load-bearing test is
+:class:`TestChaosGolden::test_kill_one_of_three_zero_failed_requests`
+— the acceptance contract: 3 REAL in-proc paged replicas (warmed AOT
+ladders) under concurrent load, one killed mid-decode by a
+deterministic ``crash@R:N`` fault. Every request completes 200 (the
+router's in-flight failover replays the victims from the prompt on a
+survivor), every stream — failed-over ones included — is
+token-identical to the engine's unbatched reference, the supervisor
+restores the fleet to 3 green replicas without operator action, and
+the survivors take ZERO post-warmup recompiles.
+
+Everything else here is deterministic harness coverage that doesn't
+need a device: fault-spec parsing, forced BlockExhausted / transport /
+poisoned-health faults against device-free fake engines, supervisor
+transitions over a real child process (:class:`ProcessReplica`).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tensorflow_examples_tpu.serving import kv_cache
+from tensorflow_examples_tpu.serving.chaos import ChaosFleet
+from tensorflow_examples_tpu.serving.engine import ServeConfig
+from tensorflow_examples_tpu.serving.router import (
+    Router,
+    RouterConfig,
+    RouterFrontend,
+)
+from tensorflow_examples_tpu.serving.supervisor import (
+    ProcessReplica,
+    Supervisor,
+)
+from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+from tensorflow_examples_tpu.utils import faults as faults_mod
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+# ------------------------------------------------------------ fault specs
+
+
+class TestServeFaultSpec:
+    def test_parse_all_kinds(self):
+        plan = faults_mod.parse_serve_spec(
+            "crash@1:4,slowrep@0:0.25,transport@2:3,kvexhaust@0:7,"
+            "badhealth@1:2"
+        )
+        assert plan.crash_at == {1: 4}
+        assert plan.slow_replica == {0: 0.25}
+        assert plan.transport_drop == {2: 3}
+        assert plan.kvexhaust_at == {0: 7}
+        assert plan.bad_health == {1: 2}
+
+    def test_unknown_kind_and_malformed_args_raise(self):
+        with pytest.raises(ValueError, match="unknown serve fault"):
+            faults_mod.parse_serve_spec("explode@0:1")
+        with pytest.raises(ValueError, match="needs '@<replica>:<arg>'"):
+            faults_mod.parse_serve_spec("crash@3")
+        with pytest.raises(ValueError, match="malformed"):
+            faults_mod.parse_serve_spec("crash@a:b")
+
+    def test_faults_fire_once_and_are_recorded(self, serve_faults):
+        eng = serve_faults("transport@0:2,badhealth@1:1")
+        assert eng.transport_fault(0) and eng.transport_fault(0)
+        assert not eng.transport_fault(0)  # budget spent
+        assert not eng.transport_fault(1)  # other replica untouched
+        assert eng.health_fault(1) and not eng.health_fault(1)
+        kinds = [k for k, _, _ in eng.fired]
+        assert kinds.count("transport") == 2
+        assert kinds.count("badhealth") == 1
+
+
+# --------------------------------------------------- device-free harness
+
+
+class _FakeEngine:
+    """Deterministic device-free engine (test_router's, plus the ISSUE
+    10 serve-fault hook and the warmup the chaos replica expects):
+    token stream is prompt[-1]+1, +2, ... so every replica serves
+    identical output and failover cannot change results."""
+
+    def __init__(self, *, max_slots=4, max_queue=32, max_len=64,
+                 step_delay=0.0, replica_id=0):
+        self.cfg = ServeConfig(
+            max_slots=max_slots, max_queue=max_queue, max_delay_s=0.0,
+            request_timeout_s=30.0,
+        )
+        import serve_bench
+
+        from tensorflow_examples_tpu.models import transformer
+
+        base = dict(serve_bench.SMOKE_MODEL)
+        base["max_len"] = max_len
+        self.model_cfg = transformer.TransformerConfig(**base)
+        self.registry = MetricsRegistry()
+        self.pool = kv_cache.KVCachePool(
+            num_layers=1, num_slots=max_slots, num_heads=1,
+            max_len=max_len, head_dim=2, registry=self.registry,
+        )
+        self.step_delay = step_delay
+        self.replica_id = replica_id
+        self.warmed = False
+
+    def warmup(self):
+        self.warmed = True
+        return {}
+
+    def post_warmup_recompiles(self):
+        return 0
+
+    def prefill(self, slot, prompt, *, seed=0, temperature=0.0, top_k=0):
+        self.pool.lengths[slot] = len(prompt)
+        last = np.zeros((self.model_cfg.vocab_size,), np.float32)
+        return (prompt[-1] + 1) % self.model_cfg.vocab_size, last
+
+    def decode(self, entries):
+        feng = faults_mod.serve_active()
+        if feng is not None:
+            # Mirror InferenceEngine.decode's hook site so the harness
+            # tests exercise the same fault semantics device-free.
+            feng.decode_step(self.replica_id, [e[0] for e in entries])
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        out = {}
+        for slot, token, _seed, _temp, _tk in entries:
+            self.pool.lengths[slot] += 1
+            out[slot] = (token + 1) % self.model_cfg.vocab_size
+        return out
+
+
+def _fake_fleet(n=2, *, step_delay=0.0, router_cfg=None,
+                supervisor_kw=None):
+    def make_factory(k):
+        return lambda: _FakeEngine(step_delay=step_delay, replica_id=k)
+
+    fleet = ChaosFleet(
+        [make_factory(k) for k in range(n)],
+        router_cfg=router_cfg or RouterConfig(
+            probe_interval_s=0.05, retry_budget_s=20.0, max_retries=4,
+            eject_after=1, eject_cooldown_s=0.5,
+        ),
+        supervisor_kw=dict(
+            poll_s=0.05, health_stall_s=2.0, warm_timeout_s=30.0,
+        ) | (supervisor_kw or {}),
+    )
+    fleet.start()
+    return fleet
+
+
+def _post(url, body, timeout=30):
+    import serve_bench
+
+    return serve_bench._post_json(url, body, timeout)
+
+
+class TestChaosHarnessFake:
+    """Fault kinds + breaker/supervisor transitions, device-free."""
+
+    @pytest.mark.timeout(120)
+    def test_kill_eject_restart_readmit_transitions(self, serve_faults):
+        """The chaos state machine end-to-end on fake engines: crash
+        mid-decode -> transport failure -> breaker EJECTS (eject_after
+        =1) -> supervisor detects, restarts, READMITS -> the restarted
+        replica serves again."""
+        serve_faults("crash@0:2")
+        fleet = _fake_fleet(2, step_delay=0.005)
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            url = rfront.url("/generate")
+            statuses = [
+                _post(url, {"prompt": [i + 1], "max_new_tokens": 4})[0]
+                for i in range(10)
+            ]
+            assert statuses.count(200) == 10, statuses
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/failovers_total", 0) >= 1
+            assert counters.get("router/ejections_total", 0) >= 1
+            assert fleet.await_fleet_green(2, timeout_s=30)
+            events = [
+                e for u, e in fleet.supervisor.events
+                if u == fleet.replicas[0].url
+            ]
+            assert events[:3] == ["detected", "restarted", "readmitted"]
+            assert sum(fleet.supervisor.restarts.values()) == 1
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/restarts_total", 0) == 1
+            assert counters.get("router/readmits_total", 0) >= 1
+            # The restarted replica takes traffic again.
+            fleet.router.probe_once()
+            status, reply = _post(
+                url, {"prompt": [42], "max_new_tokens": 2}
+            )
+            assert status == 200 and reply["tokens"] == [43, 44]
+        finally:
+            rfront.close()
+            fleet.close()
+
+    @pytest.mark.timeout(120)
+    def test_forced_block_exhaustion_fails_over(self, serve_faults):
+        """kvexhaust@R:N: the paged pool's loud capacity path — the
+        victim requests get 503 retry:true from the replica and the
+        router re-runs them elsewhere; nothing fails."""
+        serve_faults("kvexhaust@0:1")
+        fleet = _fake_fleet(2, step_delay=0.005)
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            url = rfront.url("/generate")
+            statuses = [
+                _post(url, {"prompt": [i + 1], "max_new_tokens": 4})[0]
+                for i in range(8)
+            ]
+            assert statuses.count(200) == 8, statuses
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/retries_total", 0) >= 1
+            # Forced exhaustion is NOT a crash: the replica stays up.
+            assert all(r.alive() for r in fleet.replicas)
+        finally:
+            rfront.close()
+            fleet.close()
+
+    @pytest.mark.timeout(120)
+    def test_transport_fault_fails_over(self, serve_faults):
+        serve_faults("transport@0:1")
+        fleet = _fake_fleet(2)
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            url = rfront.url("/generate")
+            statuses = [
+                _post(url, {"prompt": [i + 1], "max_new_tokens": 2})[0]
+                for i in range(6)
+            ]
+            assert statuses.count(200) == 6, statuses
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/failovers_total", 0) >= 1
+        finally:
+            rfront.close()
+            fleet.close()
+
+    @pytest.mark.timeout(120)
+    def test_poisoned_health_marks_unhealthy_not_crash(
+        self, serve_faults
+    ):
+        """badhealth@R:K: garbage /health bodies mark the replica
+        unhealthy; the probe sweep survives and keeps probing the
+        OTHER replicas (ISSUE 10 satellite regression)."""
+        serve_faults(f"badhealth@0:{10}")
+        fleet = _fake_fleet(
+            2,
+            router_cfg=RouterConfig(
+                probe_interval_s=60.0, eject_after=1,
+            ),
+            supervisor_kw=dict(health_stall_s=3600.0),
+        )
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            router = fleet.router
+            for _ in range(router.cfg.unhealthy_after):
+                router.probe_once()
+            a, b = router.replicas
+            assert a.failures >= router.cfg.unhealthy_after
+            assert not a.eligible(router.cfg.unhealthy_after)
+            # The sweep did NOT stop at the garbage replica.
+            assert b.probed and b.failures == 0
+            status, _ = _post(
+                rfront.url("/generate"),
+                {"prompt": [5], "max_new_tokens": 2},
+            )
+            assert status == 200
+        finally:
+            rfront.close()
+            fleet.close()
+
+
+# ------------------------------------------------- process supervision
+
+
+CHILD_SERVER = """\
+import http.server, json, sys
+
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = json.dumps(
+            {"ok": True, "queue_depth": 0, "kv_occupancy": 0.0}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+http.server.ThreadingHTTPServer(
+    ("127.0.0.1", int(sys.argv[1])), H
+).serve_forever()
+"""
+
+
+class TestProcessSupervision:
+    @pytest.mark.timeout(120)
+    def test_dead_process_restarted_and_readmitted(self, tmp_path):
+        """ProcessReplica + Supervisor over a real child process: kill
+        -9 the replica, one supervisor sweep respawns it and re-admits
+        it only after /health is green again."""
+        import socket
+
+        script = tmp_path / "stub_replica.py"
+        script.write_text(CHILD_SERVER)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        rep = ProcessReplica(
+            f"{sys.executable} {script} {{port}}", port=port
+        ).start()
+        router = None
+        sup = None
+        try:
+            deadline = time.monotonic() + 30
+            from tensorflow_examples_tpu.serving.router import _get_json
+
+            while time.monotonic() < deadline:
+                if _get_json(rep.url + "/health", 1.0)[0] == 200:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("stub replica never came up")
+            router = Router(
+                [rep.url], cfg=RouterConfig(probe_interval_s=60.0)
+            )
+            router.probe_once()
+            sup = Supervisor(
+                router, [rep], poll_s=0.05, health_stall_s=2.0,
+                warm_timeout_s=30.0,
+            )
+            rep._proc.kill()  # SIGKILL: no drain, no goodbye
+            rep._proc.wait(timeout=10)
+            assert not rep.alive()
+            sup.check_once()  # detect -> quarantine -> respawn -> green
+            assert rep.alive()
+            assert [e for _, e in sup.events] == [
+                "detected", "restarted", "readmitted"
+            ]
+            assert not router.replicas[0].quarantined
+            assert (
+                router.registry.counter_values()[
+                    "router/restarts_total"
+                ] == 1
+            )
+            assert _get_json(rep.url + "/health", 2.0)[0] == 200
+        finally:
+            if sup is not None:
+                sup.close()
+            if router is not None:
+                router.close()
+            rep.close()
+
+
+# --------------------------------------------------- THE chaos golden
+
+
+CHAOS_MODEL = dict(
+    vocab_size=211,
+    max_len=32,
+    num_layers=1,
+    num_heads=2,
+    d_model=16,
+    dropout=0.0,
+    attention="xla",
+)
+
+
+def _real_engine_factory():
+    """Tiny REAL paged engine for the golden: small enough that three
+    warmups + one supervisor re-warm stay tier-1 friendly, real enough
+    that the token-identity and zero-recompile claims mean something."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflow_examples_tpu.models import transformer
+    from tensorflow_examples_tpu.serving.engine import InferenceEngine
+
+    cfg = transformer.TransformerConfig(**CHAOS_MODEL)
+    model = transformer.Transformer(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, tokens
+    )["params"]
+    return InferenceEngine(
+        cfg,
+        params,
+        cfg=ServeConfig(
+            max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=16,
+            kv_block_size=8, max_delay_s=0.0, request_timeout_s=60.0,
+        ),
+        registry=MetricsRegistry(),
+    )
+
+
+class TestChaosGolden:
+    @pytest.mark.timeout(480)
+    def test_kill_one_of_three_zero_failed_requests(self, serve_faults):
+        """ISSUE 10 acceptance: 3 in-proc paged replicas under
+        concurrent load; killing one mid-decode yields ZERO failed
+        requests, every replayed stream token-identical to the
+        unbatched reference, the supervisor restores the fleet to 3
+        healthy replicas, and the survivors take zero post-warmup
+        recompiles."""
+        import serve_bench
+
+        fault_engine = serve_faults("crash@1:3")
+        fleet = ChaosFleet(
+            [_real_engine_factory] * 3,
+            router_cfg=RouterConfig(
+                probe_interval_s=0.1, retry_budget_s=30.0,
+                max_retries=4, eject_after=1, eject_cooldown_s=1.0,
+            ),
+            supervisor_kw=dict(
+                poll_s=0.05, health_stall_s=3.0, warm_timeout_s=240.0,
+            ),
+        )
+        fleet.start()
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            n, max_new = 12, 6
+            prompts = serve_bench.make_prompts(
+                n, vocab=CHAOS_MODEL["vocab_size"],
+                max_len=CHAOS_MODEL["max_len"], max_new=max_new,
+                seed=7, shared_prefix_every=4,
+            )
+            out = serve_bench.drive(
+                None, prompts, concurrency=4, max_new=max_new,
+                temperature=0.7, top_k=0,
+                http_url=rfront.url("/generate"), timeout=60.0,
+            )
+            statuses = [
+                r[0] if r is not None else None for r in out["replies"]
+            ]
+            # ZERO failed requests across the replica kill.
+            assert statuses.count(200) == n, statuses
+            # The kill actually happened, mid-decode, and victims were
+            # failed over (replayed from the prompt elsewhere).
+            assert ("crash", 1, 3) in fault_engine.fired
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/failovers_total", 0) >= 1
+            assert counters.get("router/ejections_total", 0) >= 1
+            # Every stream — failed-over ones included — is
+            # token-identical to the unbatched reference (the
+            # per-request fold_in seeding makes replay invisible).
+            ref_engine = fleet.replicas[0].engine
+            for i, prompt in enumerate(prompts):
+                expect = ref_engine.reference_generate(
+                    prompt, max_new=max_new, seed=i,
+                    temperature=0.7, top_k=0,
+                )
+                got = out["replies"][i][1]["tokens"]
+                assert got == expect, (
+                    f"request {i} diverged after failover: "
+                    f"{got} != {expect}"
+                )
+            # The supervisor restores the fleet: restart -> re-warm ->
+            # /health green -> readmit, no operator action.
+            assert fleet.await_fleet_green(3, timeout_s=240)
+            events = [
+                e for u, e in fleet.supervisor.events
+                if u == fleet.replicas[1].url
+            ]
+            assert events[:3] == ["detected", "restarted", "readmitted"]
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/restarts_total", 0) == 1
+            # Zero post-warmup recompiles on the survivors (and on the
+            # freshly re-warmed replica).
+            for rep in fleet.replicas:
+                assert rep.engine.post_warmup_recompiles() == 0
+            # The fleet serves after restoration — including the
+            # restarted replica's slot in the rotation.
+            for i in range(4):
+                status, reply = _post(
+                    rfront.url("/generate"),
+                    {"prompt": [3 + i], "max_new_tokens": 2,
+                     "seed": 99 + i},
+                )
+                assert status == 200
+            # Schema v7: the router's stats line carries the
+            # fault-tolerance counters and validates.
+            line = json.loads(json.dumps(fleet.router.stats_line()))
+            assert schema.validate_line(line) == []
+            assert line["schema_version"] == 7
+            assert line["serving"]["router_failovers"] >= 1
+            assert line["serving"]["router_ejections"] >= 1
+            assert line["serving"]["router_restarts"] == 1
+        finally:
+            rfront.close()
+            fleet.close()

@@ -8,6 +8,7 @@ bounded IO retry, and the poisoned-batch skip counter. Marked ``faults``
 (deliberately not ``slow``) so the tier-1 command always runs them.
 """
 
+import json
 import logging
 import os
 import signal
@@ -484,6 +485,119 @@ def test_restore_validates_structure(tmp_path, devices):
     with pytest.raises(ValueError, match="shape mismatch") as exc:
         CheckpointManager(str(tmp_path)).restore_latest(big.state)
     assert "params" in str(exc.value)  # names the drifted path
+
+
+class TestCheckpointIntegrity:
+    """ISSUE 10 satellite: sha256 manifests at save, verify-and-fall-
+    back at restore — a torn checkpoint degrades to the newest intact
+    step with a WARNING naming the corrupt file, never an opaque orbax
+    error."""
+
+    def _save_steps(self, tmp_path, trainer, steps=(1, 2, 3)):
+        with CheckpointManager(str(tmp_path)) as ckpt:
+            for s in steps:
+                ckpt.save(s, trainer.state)
+
+    def _corrupt_newest(self, tmp_path):
+        """Flip a byte in a manifest-covered data file of the newest
+        step; returns its manifest-relative name."""
+        import glob
+
+        step_dir = os.path.join(
+            str(tmp_path), "checkpoints",
+            str(CheckpointManager(str(tmp_path)).latest_step()),
+        )
+        with open(
+            os.path.join(step_dir, "manifest.sha256.json")
+        ) as f:
+            files = json.load(f)["files"]
+        victim = next(
+            rel for rel in sorted(files)
+            if os.path.getsize(os.path.join(step_dir, rel)) > 0
+            and "/d/" in rel
+        )
+        full = os.path.join(step_dir, victim)
+        with open(full, "r+b") as f:
+            data = bytearray(f.read())
+            data[len(data) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(data)
+        return victim
+
+    @pytest.mark.timeout(300)
+    def test_manifest_written_for_every_committed_step(
+        self, tmp_path, devices
+    ):
+        cfg = tiny_cfg(train_steps=2)
+        self._save_steps(tmp_path, Trainer(mnist.make_task(cfg), cfg))
+        for step in (1, 2, 3):
+            path = os.path.join(
+                str(tmp_path), "checkpoints", str(step),
+                "manifest.sha256.json",
+            )
+            assert os.path.isfile(path), f"step {step} not stamped"
+            with open(path) as f:
+                doc = json.load(f)
+            assert doc["step"] == step and doc["files"]
+        mngr = CheckpointManager(str(tmp_path))
+        assert mngr.verify_step_integrity(3) == []
+        mngr.close()
+
+    @pytest.mark.timeout(300)
+    def test_corrupt_latest_falls_back_with_named_file(
+        self, tmp_path, devices, caplog
+    ):
+        cfg = tiny_cfg(train_steps=2)
+        trainer = Trainer(mnist.make_task(cfg), cfg)
+        self._save_steps(tmp_path, trainer)
+        victim = self._corrupt_newest(tmp_path)
+        mngr = CheckpointManager(str(tmp_path))
+        problems = mngr.verify_step_integrity(3)
+        assert problems and victim in problems[0]
+        with caplog.at_level(
+            logging.WARNING, logger="tensorflow_examples_tpu"
+        ):
+            restored = mngr.restore_latest(trainer.state)
+        mngr.close()
+        assert restored is not None and int(restored[1]) == 2
+        warned = " ".join(
+            r.getMessage() for r in caplog.records
+            if "integrity" in r.getMessage()
+        )
+        assert victim in warned  # the WARNING names the corrupt file
+
+    @pytest.mark.timeout(300)
+    def test_all_steps_corrupt_raises_with_names(
+        self, tmp_path, devices
+    ):
+        cfg = tiny_cfg(train_steps=2)
+        trainer = Trainer(mnist.make_task(cfg), cfg)
+        self._save_steps(tmp_path, trainer, steps=(1,))
+        victim = self._corrupt_newest(tmp_path)
+        mngr = CheckpointManager(str(tmp_path))
+        with pytest.raises(RuntimeError, match="corrupt") as exc:
+            mngr.restore_latest(trainer.state)
+        mngr.close()
+        assert victim in str(exc.value)
+
+    @pytest.mark.timeout(300)
+    def test_pre_manifest_checkpoints_still_restore(
+        self, tmp_path, devices
+    ):
+        """A checkpoint from before this PR (no manifest) verifies
+        vacuously and restores exactly as before."""
+        cfg = tiny_cfg(train_steps=2)
+        trainer = Trainer(mnist.make_task(cfg), cfg)
+        self._save_steps(tmp_path, trainer, steps=(4,))
+        mpath = os.path.join(
+            str(tmp_path), "checkpoints", "4", "manifest.sha256.json"
+        )
+        os.unlink(mpath)  # simulate the pre-ISSUE-10 layout
+        mngr = CheckpointManager(str(tmp_path))
+        assert mngr.verify_step_integrity(4) == []
+        restored = mngr.restore_latest(trainer.state)
+        mngr.close()
+        assert restored is not None and int(restored[1]) == 4
 
 
 # ------------------------------------------------- end-to-end CLI chaos

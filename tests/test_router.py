@@ -370,6 +370,268 @@ class TestCanary:
         assert diff["ttft_p95_ms"] == canary["ttft_p95_ms"]
 
 
+class TestCircuitBreaker:
+    """ISSUE 10 breaker state machine: closed -> open (ejected) ->
+    half-open (one trial) -> closed on success / re-open on failure.
+    Driven through the router's own bookkeeping, no sockets."""
+
+    def _router(self, **cfg_kw):
+        kw = dict(eject_after=3, eject_cooldown_s=0.2)
+        kw.update(cfg_kw)
+        r = Router(["http://a:1", "http://b:2"], cfg=RouterConfig(**kw))
+        for rep in r.replicas:
+            rep.probed = True
+        return r
+
+    def test_consecutive_failures_eject(self):
+        r = self._router()
+        a = r.replicas[0]
+        for i in range(r.cfg.eject_after - 1):
+            r._note_failure(a, transport=True, draining=False)
+            assert a.breaker == "closed", i
+        r._note_failure(a, transport=True, draining=False)
+        assert a.breaker == "open"
+        assert not a.eligible(r.cfg.unhealthy_after)
+        assert (
+            r.registry.counter_values()["router/ejections_total"] == 1
+        )
+
+    def test_success_resets_consecutive_count(self):
+        r = self._router()
+        a = r.replicas[0]
+        for _ in range(r.cfg.eject_after - 1):
+            r._note_failure(a, transport=False, draining=False)
+        r._note_success(a)
+        assert a.consec_errors == 0
+        r._note_failure(a, transport=False, draining=False)
+        assert a.breaker == "closed"  # the streak was broken
+
+    def test_draining_503_is_not_a_breaker_failure(self):
+        r = self._router(eject_after=1)
+        a = r.replicas[0]
+        r._note_failure(a, transport=False, draining=True)
+        assert a.breaker == "closed" and a.draining_remote
+
+    def test_half_open_single_trial_then_readmit(self):
+        r = self._router(eject_after=1)
+        a, b = r.replicas
+        b.drained = True  # force every pick onto a
+        r._note_failure(a, transport=True, draining=False)
+        assert a.breaker == "open"
+        assert r.pick() is None  # ejected: nothing eligible
+        time.sleep(r.cfg.eject_cooldown_s + 0.05)
+        trial = r.pick()  # cooldown expired -> half-open, ONE trial
+        assert trial is a and a.breaker == "half_open"
+        assert r.pick() is None  # trial in flight: no second dispatch
+        r._note_success(a)
+        assert a.breaker == "closed"
+        assert (
+            r.registry.counter_values()["router/readmits_total"] == 1
+        )
+        assert r.pick() is a  # back in rotation
+
+    def test_half_open_failure_reopens(self):
+        r = self._router(eject_after=1)
+        a, b = r.replicas
+        b.drained = True
+        r._note_failure(a, transport=True, draining=False)
+        time.sleep(r.cfg.eject_cooldown_s + 0.05)
+        assert r.pick() is a and a.breaker == "half_open"
+        r._note_failure(a, transport=True, draining=False)
+        assert a.breaker == "open"  # re-ejected for another cooldown
+        assert r.pick() is None
+        assert (
+            r.registry.counter_values()["router/ejections_total"] == 2
+        )
+
+    def test_probe_green_readmits_half_open(self):
+        """The /health-probe path of the half-open trial: a green probe
+        readmits without risking a live request."""
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe in replicas]
+        router = Router(
+            urls, cfg=RouterConfig(eject_after=1, eject_cooldown_s=0.05)
+        )
+        try:
+            router.probe_once()
+            a = router.replicas[0]
+            router._note_failure(a, transport=False, draining=False)
+            assert a.breaker == "open"
+            time.sleep(0.1)
+            router.probe_once()
+            assert a.breaker == "closed"
+            assert (
+                router.registry.counter_values()[
+                    "router/readmits_total"
+                ] == 1
+            )
+        finally:
+            router.close()
+            _close(replicas)
+
+
+class TestBoundedRetryAndFailover:
+    @pytest.mark.timeout(120)
+    def test_transport_failure_fails_over_and_counts(self):
+        """A replica that died mid-request (transport failure, status
+        0) triggers in-flight failover: the request replays on the
+        other replica and router/failovers_total counts it."""
+        replicas = [_replica()]
+        live_url = f"http://127.0.0.1:{replicas[0][2].port}"
+        # A dead URL: bind-then-close guarantees connection refused.
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead_url = f"http://127.0.0.1:{s.getsockname()[1]}"
+        router = Router(
+            [dead_url, live_url],
+            cfg=RouterConfig(retry_backoff_s=0.01, eject_after=1),
+        )
+        router.probe_once()
+        try:
+            # Force the first pick onto the dead replica.
+            router.replicas[1].dispatched = 5
+            status, reply = router.handle(
+                {"prompt": [7], "max_new_tokens": 2}, kind="generate"
+            )
+            assert status == 200 and reply["tokens"] == [8, 9]
+            counters = router.registry.counter_values()
+            assert counters["router/failovers_total"] == 1
+            assert counters["router/retries_total"] == 1
+            assert counters["router/ejections_total"] == 1
+            assert router.replicas[0].breaker == "open"
+        finally:
+            router.close()
+            _close(replicas)
+
+    @pytest.mark.timeout(120)
+    def test_retries_bounded_by_max_retries(self):
+        """Every replica down -> the request fails 503 after at most
+        max_retries re-dispatches, never an unbounded loop."""
+        import socket
+
+        urls = []
+        for _ in range(2):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                urls.append(f"http://127.0.0.1:{s.getsockname()[1]}")
+        router = Router(
+            urls,
+            cfg=RouterConfig(
+                max_retries=2, retry_backoff_s=0.01,
+                retry_budget_s=5.0, eject_after=10,
+            ),
+        )
+        try:
+            status, reply = router.handle(
+                {"prompt": [1]}, kind="generate"
+            )
+            assert status == 503
+            counters = router.registry.counter_values()
+            assert counters["router/retries_total"] == 2
+        finally:
+            router.close()
+
+
+class TestHedgedDispatch:
+    @pytest.mark.timeout(120)
+    def test_hedge_wins_and_loser_is_discarded(self):
+        """A slow primary past the hedge deadline triggers a second
+        dispatch; the fast hedge's response wins, the slow loser is
+        abandoned (counted, its reply discarded on arrival)."""
+        slow = _replica(step_delay=0.25)
+        fast = _replica()
+        urls = [
+            f"http://127.0.0.1:{slow[2].port}",
+            f"http://127.0.0.1:{fast[2].port}",
+        ]
+        router = Router(
+            urls, cfg=RouterConfig(hedge_after_s=0.05)
+        )
+        router.probe_once()
+        try:
+            # Force the primary pick onto the slow replica.
+            router.replicas[1].dispatched = 5
+            status, reply = router.handle(
+                {"prompt": [7], "max_new_tokens": 4}, kind="generate"
+            )
+            assert status == 200
+            # Determinism across replicas: same tokens either way.
+            assert reply["tokens"] == [8, 9, 10, 11]
+            counters = router.registry.counter_values()
+            assert counters["router/hedges_total"] == 1
+            assert counters["router/hedge_wins_total"] == 1
+            assert counters["router/hedge_cancelled_total"] == 1
+            # The winner was the fast replica; the slow loser's reply
+            # lands later and is discarded (bookkeeping only).
+            assert router.replicas[1].completed == 1
+        finally:
+            router.close()
+            _close([slow, fast])
+
+    def test_hedge_disabled_by_default(self):
+        assert RouterConfig().hedge_after_s == 0.0
+
+
+class TestProbeGarbage:
+    """ISSUE 10 satellite: malformed /health bodies mark the replica
+    unhealthy instead of risking the probe loop."""
+
+    def _garbage_server(self, payload: bytes):
+        import http.server
+        import threading
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        return httpd
+
+    @pytest.mark.timeout(120)
+    @pytest.mark.parametrize(
+        "payload", [b"<<<not json", b"[1, 2, 3]", b'"just a string"'],
+        ids=["non-json", "json-array", "json-string"],
+    )
+    def test_garbage_health_body_marks_unhealthy(self, payload):
+        garbage = self._garbage_server(payload)
+        replicas = [_replica()]
+        urls = [
+            f"http://127.0.0.1:{garbage.server_address[1]}",
+            f"http://127.0.0.1:{replicas[0][2].port}",
+        ]
+        router = Router(urls, cfg=RouterConfig())
+        try:
+            for _ in range(router.cfg.unhealthy_after):
+                router.probe_once()  # must never raise
+            bad, good = router.replicas
+            assert bad.failures >= router.cfg.unhealthy_after
+            assert not bad.eligible(router.cfg.unhealthy_after)
+            # The sweep survived the garbage and still probed the
+            # well-behaved replica.
+            assert good.probed and good.failures == 0
+            status, _ = router.handle(
+                {"prompt": [5], "max_new_tokens": 2}, kind="generate"
+            )
+            assert status == 200
+        finally:
+            router.close()
+            _close(replicas)
+            garbage.shutdown()
+            garbage.server_close()
+
+
 class TestRouterSchema:
     def test_v6_serving_keys_flagged_on_older_versions(self):
         r = Router(["http://a:1"])
@@ -382,4 +644,18 @@ class TestRouterSchema:
         v4 = dict(line, schema_version=4)
         assert any(
             "v6 serving key" in p for p in schema.validate_line(v4)
+        )
+
+    def test_v7_serving_keys_flagged_on_older_versions(self):
+        """ISSUE 10: the fault-tolerance counters are v7-only — a 'v6'
+        line carrying router_failovers is a mislabeled v7 line."""
+        r = Router(["http://a:1"])
+        line = json.loads(json.dumps(r.stats_line()))
+        assert line["schema_version"] == 7
+        assert schema.validate_line(line) == []
+        for key in schema.SERVING_KEYS_V7:
+            assert key in line["serving"], key
+        v6 = dict(line, schema_version=6)
+        assert any(
+            "v7 serving key" in p for p in schema.validate_line(v6)
         )

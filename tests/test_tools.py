@@ -1163,6 +1163,75 @@ class TestBenchGate:
             ["--record", str(bad), "--floors", str(floors)]
         ) == 1
 
+    def test_chaos_error_rate_gated_at_zero(self, tmp_path, capsys):
+        """ISSUE 10 satellite: the serve_chaos availability record
+        gates ``error_rate`` with a max of 0 — the threshold slack
+        multiplies the zero bound into zero, so ONE failed request
+        under the replica kill regresses the gate. ``p95_vs_baseline``
+        gates as a declared-multiple maximum."""
+        rec = {
+            "bench": "serve_chaos",
+            "error_rate": 0.0,
+            "p95_vs_baseline": 3.0,
+            "failover_count": 2,
+        }
+        good = tmp_path / "chaos.json"
+        good.write_text(json.dumps(rec))
+        floors = tmp_path / "chaos_floors.json"
+        assert self._gate(
+            ["--stamp", str(good), "--floors", str(floors)]
+        ) == 0
+        with open(floors) as f:
+            stamped = json.load(f)
+        assert stamped["error_rate"] == {"max": 0.0}
+        assert stamped["p95_vs_baseline"] == {"max": 3.0}
+        assert self._gate(
+            ["--record", str(good), "--floors", str(floors)]
+        ) == 0
+        bad = tmp_path / "chaos_bad.json"
+        bad.write_text(json.dumps(dict(rec, error_rate=0.05)))
+        assert self._gate(
+            ["--record", str(bad), "--floors", str(floors)]
+        ) == 1
+        assert "[FAIL] error_rate" in capsys.readouterr().out
+        worse = tmp_path / "chaos_worse.json"
+        worse.write_text(json.dumps(dict(rec, p95_vs_baseline=9.0)))
+        assert self._gate(
+            ["--record", str(worse), "--floors", str(floors)]
+        ) == 1
+
+
+class TestFaultInjectServe:
+    """ISSUE 10 satellite: tools/fault_inject.py --serve arms the
+    serving fault grammar in the child's environment."""
+
+    def test_serve_spec_exported_to_child(self, capsys):
+        import fault_inject
+
+        rc = fault_inject.main([
+            "--serve", "--spec", "crash@1:4,badhealth@0:2", "--",
+            sys.executable, "-c",
+            "import os, sys; "
+            "sys.exit(0 if os.environ.get('TPU_SERVE_FAULT_INJECT')"
+            " == 'crash@1:4,badhealth@0:2' else 3)",
+        ])
+        assert rc == 0
+
+    def test_serve_spec_validated_before_spawn(self, capsys):
+        import fault_inject
+
+        with pytest.raises(ValueError, match="unknown serve fault"):
+            fault_inject.main([
+                "--serve", "--spec", "sigterm@5", "--",
+                sys.executable, "-c", "raise SystemExit(9)",
+            ])
+        # ...and the train grammar rejects serve kinds symmetrically.
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            fault_inject.main([
+                "--spec", "crash@1:4", "--",
+                sys.executable, "-c", "raise SystemExit(9)",
+            ])
+
 
 class TestHostInputBench:
     """ISSUE 6 CI satellite: the input-pipeline smoke — a BENCH-style
@@ -1351,6 +1420,50 @@ class TestServeBench:
         for key in ("req_per_s", "tok_per_s", "ttft_p95_ms",
                     "tpot_p95_ms", "e2e_p95_ms"):
             assert isinstance(rec[key], (int, float)) and rec[key] > 0
+
+    @pytest.mark.timeout(420)
+    def test_chaos_smoke_banks_availability_record(self, tmp_path):
+        """ISSUE 10 CI satellite: ``--smoke --chaos`` runs a
+        SUPERVISED 2-replica paged fleet through a baseline phase and
+        a crash-one-replica chaos phase, and banks the serve_chaos
+        availability record: zero failed requests (error_rate 0 — the
+        bench_gate smoke bound), >= 1 in-flight failover, a completed
+        restart cycle, and the chaos p95 within the declared multiple
+        of the fault-free baseline."""
+        import serve_bench
+
+        from tensorflow_examples_tpu.utils import faults as faults_mod
+
+        out = tmp_path / "chaos_record.json"
+        try:
+            rc = serve_bench.main(
+                ["--smoke", "--chaos", "--replicas", "2",
+                 "--requests", "8", "--concurrency", "4",
+                 "--out", str(out)]
+            )
+        finally:
+            faults_mod.serve_clear()  # belt-and-braces for the suite
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        assert rec["bench"] == "serve_chaos" and rec["replicas"] == 2
+        assert rec["ok"] is True
+        # Availability: every request of BOTH phases completed even
+        # though a replica was killed mid-decode.
+        assert rec["errors"] == 0 and rec["error_rate"] == 0.0
+        assert rec["faults_fired"] >= 1
+        assert rec["failover_count"] >= 1
+        # The supervisor completed one restart cycle and the fleet
+        # ended green.
+        assert rec["router_restarts"] == 1
+        assert rec["fleet_restored"] is True
+        # Tail latency bounded by the declared multiple.
+        assert rec["p95_vs_baseline"] is not None
+        assert rec["p95_vs_baseline"] <= rec["p95_budget"]
+        # Zero post-warmup recompiles across survivors + the re-warmed
+        # replica; verified subset token-identical through failover.
+        assert rec["post_warmup_recompiles"] == 0
+        assert rec["verified"] == 3 and rec["verify_ok"] is True
 
     def test_make_prompts_spans_buckets(self):
         import serve_bench

@@ -83,6 +83,13 @@ RECORD_KEYS: dict[str, str] = {
     "tok_per_s": "min",
     "prefix_hit_rate": "min",
     "post_warmup_recompiles": "max",
+    # Chaos/availability records (ISSUE 10): serve_bench --chaos banks
+    # error_rate (gated at 0 for the smoke config — any failed request
+    # under a single-replica kill is a regression; the threshold slack
+    # multiplies a 0 bound into 0, so the gate is exact) and the
+    # chaos-vs-baseline p95 ratio as a declared-multiple maximum.
+    "error_rate": "max",
+    "p95_vs_baseline": "max",
 }
 
 

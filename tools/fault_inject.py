@@ -27,6 +27,26 @@ Fault kinds (comma-separated kind@arg tokens):
     slow@N[:S]    sleep S (default 5) seconds fetching host batch N
     ioerr@K       first K file reads raise OSError (retry/backoff path)
     badbatch@N    corrupt host batch N (poisoned-batch skip path)
+
+**Serving faults** (ISSUE 10): ``--serve`` switches the spec grammar to
+the serve-side plan (exported as $TPU_SERVE_FAULT_INJECT; picked up by
+the serving engine's decode hook and the frontend — any command that
+runs the serving stack, e.g. ``tools/serve_bench.py --router`` or
+``examples/gpt2/serve.py``). Tokens are ``kind@replica:arg``, keyed on
+each replica's own decode-step/request/probe counters:
+
+    python tools/fault_inject.py --serve --spec 'crash@1:4' -- \
+        python tools/serve_bench.py --smoke --router --replicas 3
+
+    crash@R:N       kill replica R's transport before its Nth decode
+                    step (in-proc fleets; needs the chaos harness's
+                    registered kill — serving/chaos.py)
+    slowrep@R:S     every decode step on replica R sleeps S seconds
+    transport@R:K   drop replica R's first K requests with no response
+                    bytes (clients see a reset -> router failover)
+    kvexhaust@R:N   force BlockExhausted on replica R's Nth decode step
+    badhealth@R:K   replica R's first K /health replies are non-JSON
+                    garbage (the probe must mark it unhealthy)
 """
 
 from __future__ import annotations
@@ -41,7 +61,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tensorflow_examples_tpu.utils.diagnostics import HUNG_EXIT_CODE  # noqa: E402
-from tensorflow_examples_tpu.utils.faults import ENV_VAR, parse_spec  # noqa: E402
+from tensorflow_examples_tpu.utils.faults import (  # noqa: E402
+    ENV_VAR,
+    SERVE_ENV_VAR,
+    parse_serve_spec,
+    parse_spec,
+)
 
 
 def main(argv=None) -> int:
@@ -52,6 +77,12 @@ def main(argv=None) -> int:
         "--spec",
         required=True,
         help="fault plan, e.g. 'sigterm@10,ioerr@2' (see module docstring)",
+    )
+    parser.add_argument(
+        "--serve",
+        action="store_true",
+        help="treat --spec as a SERVING fault plan (kind@replica:arg "
+        "grammar, exported as $TPU_SERVE_FAULT_INJECT)",
     )
     parser.add_argument(
         "command",
@@ -65,9 +96,13 @@ def main(argv=None) -> int:
     if not command:
         parser.error("no command given; usage: fault_inject.py --spec ... -- <cmd>")
 
-    plan = parse_spec(args.spec)  # validate before spawning anything
+    # Validate before spawning anything.
+    if args.serve:
+        plan = parse_serve_spec(args.spec)
+    else:
+        plan = parse_spec(args.spec)
     env = dict(os.environ)
-    env[ENV_VAR] = args.spec
+    env[SERVE_ENV_VAR if args.serve else ENV_VAR] = args.spec
     print(f"[fault_inject] armed {plan} for: {' '.join(command)}", flush=True)
     proc = subprocess.run(command, env=env)
     rc = proc.returncode

@@ -73,6 +73,10 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("tok_per_s", "higher", "/s", 1.0),
     ("prefix_hit_rate", "higher", "", 1.0),
     ("post_warmup_recompiles", "lower", "", 1.0),
+    # ---- chaos/availability records (ISSUE 10) ----
+    ("error_rate", "lower", "", 1.0),
+    ("failover_count", "lower", "", 1.0),
+    ("p95_vs_baseline", "lower", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -92,6 +96,9 @@ GATE_KEYS = (
     "req_per_s",
     "tok_per_s",
     "prefix_hit_rate",
+    # chaos/availability gate keys (ISSUE 10)
+    "error_rate",
+    "p95_vs_baseline",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

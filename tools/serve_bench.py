@@ -45,6 +45,20 @@ Modes:
   recompiles summed over EVERY replica. ``--smoke --router`` is the
   tier-1 fleet smoke.
 
+* ``--chaos`` (ISSUE 10) — availability under injected faults: a
+  SUPERVISED 3-replica (default) in-proc paged fleet behind the
+  hardened router, a fault-free baseline phase, then a deterministic
+  serve fault schedule (``--fault-spec``, ``utils/faults.py`` grammar;
+  default crashes replica 1 mid-decode) under the same load, then
+  wait for the supervisor to restore the fleet. Banks a
+  ``serve_chaos`` record: ``error_rate`` (0 on a healthy tier —
+  in-flight failover means replica death drops nothing),
+  ``failover_count``, ejection/readmit/restart counters, and
+  ``p95_vs_baseline`` (client-observed e2e p95 ratio vs the declared
+  ``CHAOS_P95_BUDGET``). ``bench_gate`` gates ``error_rate`` at 0 and
+  ``p95_vs_baseline`` as a max. ``--smoke --chaos`` is the tier-1
+  chaos smoke.
+
 ``--inproc`` skips the HTTP hop (batcher futures driven directly) to
 separate transport cost from engine cost; ``--out`` banks the record
 as a JSON file next to the BENCH_r*.json trajectory.
@@ -180,8 +194,11 @@ def drive(frontend, prompts, *, concurrency: int, max_new: int,
           timeout: float) -> dict:
     """Closed loop: workers pull the next prompt off a shared list the
     moment their current request resolves. Returns per-request replies
-    (index-aligned with ``prompts``) + wall time."""
+    (index-aligned with ``prompts``), per-request CLIENT wall times
+    (``client_s`` — includes every router retry/failover, which the
+    replica-measured ``total_s`` cannot see), + wall time."""
     replies: list[tuple[int, dict] | None] = [None] * len(prompts)
+    client_s: list[float | None] = [None] * len(prompts)
     next_i = [0]
     lock = threading.Lock()
 
@@ -199,10 +216,12 @@ def drive(frontend, prompts, *, concurrency: int, max_new: int,
                 "top_k": top_k,
                 "seed": i,  # per-request stream: replayable
             }
+            t_req = time.perf_counter()
             if http_url is not None:
                 replies[i] = _post_json(http_url, body, timeout)
             else:
                 replies[i] = frontend.handle_request(body, kind="generate")
+            client_s[i] = time.perf_counter() - t_req
 
     t0 = time.perf_counter()
     threads = [
@@ -214,7 +233,7 @@ def drive(frontend, prompts, *, concurrency: int, max_new: int,
     for t in threads:
         t.join(timeout=timeout * max(1, len(prompts)))
     wall = time.perf_counter() - t0
-    return {"replies": replies, "wall_s": wall}
+    return {"replies": replies, "client_s": client_s, "wall_s": wall}
 
 
 def bench_record(engine, registry, outcome, prompts, *, concurrency,
@@ -324,6 +343,9 @@ def run_router_bench(args) -> dict:
             )
         else:
             engine = build_smoke_engine(serve_cfg, registry=reg)
+        # Fleet identity (ISSUE 10): serve-side fault specs
+        # (kind@replica:arg, $TPU_SERVE_FAULT_INJECT) key on it.
+        engine.replica_id = k
         engine.warmup()
         batcher = ContinuousBatcher(engine, registry=reg).start()
         frontend = ServingFrontend(batcher, port=0).start()
@@ -488,6 +510,204 @@ def run_router_bench(args) -> dict:
     return rec
 
 
+# Declared p95 budget for the chaos record (ISSUE 10): the chaos
+# phase's client-observed e2e p95 must stay within this multiple of the
+# fault-free baseline phase's. Generous on purpose — a failover adds
+# one full re-prefill + backoff to the victims, and the 2-vCPU CI rig
+# is load-noisy; the claim is "bounded", not "free".
+CHAOS_P95_BUDGET = 25.0
+
+
+def _client_p95_ms(outcome) -> float | None:
+    vals = [
+        s for s, r in zip(outcome["client_s"], outcome["replies"])
+        if s is not None and r is not None and r[0] == 200
+    ]
+    return _pct_from_values(vals, 95)
+
+
+def run_chaos_bench(args) -> dict:
+    """ISSUE 10: availability under injected faults. Stands up a
+    3-replica (default) in-proc paged fleet WITH supervision
+    (serving/chaos.ChaosFleet), measures a fault-free baseline phase,
+    arms a deterministic serve fault schedule (default: crash replica 1
+    mid-decode), drives a chaos phase through the hardened router, then
+    waits for the supervisor to restore the fleet. The record is the
+    availability claim CI gates: ``error_rate`` (must be 0 — in-flight
+    failover means a replica death drops nothing), ``failover_count``,
+    ejection/restart counters, and ``p95_vs_baseline`` (client-observed
+    e2e p95 ratio, bounded by the declared budget)."""
+    import jax
+
+    from tensorflow_examples_tpu.serving.chaos import ChaosFleet
+    from tensorflow_examples_tpu.serving.engine import ServeConfig
+    from tensorflow_examples_tpu.serving.router import (
+        RouterConfig,
+        RouterFrontend,
+    )
+    from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+    from tensorflow_examples_tpu.utils import faults as faults_mod
+
+    kv_block = args.kv_block_size if args.kv_block_size >= 0 else 16
+    serve_kw = dict(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        kv_block_size=kv_block,
+        kv_dtype=args.kv_dtype,
+    )
+    if args.smoke:
+        serve_kw.update(prefill_bucket_floor=16, kv_bucket_floor=32)
+
+    def factory():
+        reg = MetricsRegistry()
+        serve_cfg = ServeConfig(**serve_kw)
+        if args.workdir:
+            return build_checkpoint_engine(
+                args.workdir, serve_cfg, registry=reg
+            )
+        return build_smoke_engine(serve_cfg, registry=reg)
+
+    n_replicas = args.replicas if args.replicas > 0 else 3
+    spec = args.fault_spec or f"crash@{min(1, n_replicas - 1)}:4"
+    fleet = ChaosFleet(
+        [factory] * n_replicas,
+        router_cfg=RouterConfig(
+            probe_interval_s=0.1,
+            request_timeout_s=args.timeout,
+            retry_budget_s=min(30.0, args.timeout),
+            max_retries=4,
+            eject_after=2,
+            eject_cooldown_s=1.0,
+        ),
+    )
+    t0 = time.perf_counter()
+    fleet.start()
+    warmup_s = time.perf_counter() - t0
+    print(
+        f"# chaos fleet: {n_replicas} supervised paged replicas warm "
+        f"in {warmup_s:.1f}s; schedule: {spec}",
+        file=sys.stderr,
+    )
+    rfront = RouterFrontend(fleet.router, port=0).start()
+
+    n = args.requests or (12 if args.smoke else 48)
+    verify = args.verify if args.verify >= 0 else (3 if args.smoke else 0)
+    model_cfg = fleet.replicas[0].engine.model_cfg
+    mk = dict(
+        vocab=model_cfg.vocab_size, max_len=model_cfg.max_len,
+        max_new=args.max_new_tokens, shared_prefix_every=4,
+    )
+    drive_kw = dict(
+        concurrency=args.concurrency, max_new=args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k,
+        http_url=rfront.url("/generate"), timeout=args.timeout,
+    )
+    base_prompts = make_prompts(n, seed=101, **mk)
+    chaos_prompts = make_prompts(n, seed=202, **mk)
+    fault_engine = None
+    try:
+        base_out = drive(None, base_prompts, **drive_kw)
+        fault_engine = faults_mod.serve_install(spec)
+        chaos_out = drive(None, chaos_prompts, **drive_kw)
+        restored = fleet.await_fleet_green(
+            n_replicas, timeout_s=args.timeout * 3
+        )
+        # Verify chaos-phase replies (the failed-over ones included)
+        # token-for-token against the unbatched reference on a
+        # SURVIVOR engine — failover replay must be invisible.
+        verify_ok = True
+        ref_engine = fleet.replicas[0].engine
+        for i in range(min(verify, n)):
+            reply = chaos_out["replies"][i]
+            if reply is None or reply[0] != 200:
+                verify_ok = False
+                continue
+            ref = ref_engine.reference_generate(
+                chaos_prompts[i],
+                max_new=args.max_new_tokens, seed=i,
+                temperature=args.temperature, top_k=args.top_k,
+            )
+            if reply[1]["tokens"] != ref:
+                verify_ok = False
+                print(
+                    f"# VERIFY FAIL chaos req {i}: served "
+                    f"{reply[1]['tokens']} != reference {ref}",
+                    file=sys.stderr,
+                )
+    finally:
+        faults_mod.serve_clear()
+        rfront.close()
+        supervisor = fleet.supervisor
+        router = fleet.router
+        fleet.close()
+
+    def phase(outcome):
+        replies = outcome["replies"]
+        done = [r for r in replies if r is not None and r[0] == 200]
+        return len(done), len(replies) - len(done)
+
+    base_done, base_errors = phase(base_out)
+    chaos_done, chaos_errors = phase(chaos_out)
+    base_p95 = _client_p95_ms(base_out)
+    chaos_p95 = _client_p95_ms(chaos_out)
+    p95_ratio = (
+        round(chaos_p95 / base_p95, 3)
+        if base_p95 and chaos_p95 else None
+    )
+    counters = router.registry.counter_values()
+    restarts = sum(supervisor.restarts.values())
+    survivor_recompiles = sum(
+        rep.engine.post_warmup_recompiles()
+        for rep in fleet.replicas if rep.engine is not None
+    )
+    errors = base_errors + chaos_errors
+    fired = list(fault_engine.fired) if fault_engine is not None else []
+    rec = {
+        "bench": "serve_chaos",
+        "backend": jax.default_backend(),
+        "replicas": n_replicas,
+        "fault_spec": spec,
+        "faults_fired": len(fired),
+        "requests": 2 * n,
+        "completed": base_done + chaos_done,
+        "errors": errors,
+        "error_rate": round(errors / (2 * n), 4),
+        "concurrency": args.concurrency,
+        "baseline_e2e_p95_ms": base_p95,
+        "chaos_e2e_p95_ms": chaos_p95,
+        "p95_vs_baseline": p95_ratio,
+        "p95_budget": CHAOS_P95_BUDGET,
+        "failover_count": int(
+            counters.get("router/failovers_total", 0)
+        ),
+        "router_retries": int(counters.get("router/retries_total", 0)),
+        "router_ejections": int(
+            counters.get("router/ejections_total", 0)
+        ),
+        "router_readmits": int(
+            counters.get("router/readmits_total", 0)
+        ),
+        "router_restarts": restarts,
+        "fleet_restored": bool(restored),
+        "post_warmup_recompiles": survivor_recompiles,
+        "verified": min(verify, n),
+        "verify_ok": verify_ok,
+        "warmup_s": round(warmup_s, 3),
+        "kv_block_size": kv_block,
+        "transport": "router-http",
+    }
+    rec["ok"] = bool(
+        errors == 0
+        and verify_ok
+        and restored
+        and fired
+        and survivor_recompiles == 0
+        and (p95_ratio is None or p95_ratio <= CHAOS_P95_BUDGET)
+    )
+    return rec
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
@@ -497,8 +717,19 @@ def main(argv=None) -> int:
     ap.add_argument("--router", action="store_true",
                     help="drive --replicas in-proc serving stacks "
                          "through serving/router.py (ISSUE 8)")
-    ap.add_argument("--replicas", type=int, default=2,
-                    help="replica count for --router (default 2)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="ISSUE 10: supervised in-proc fleet + injected "
+                         "fault schedule; banks the serve_chaos "
+                         "availability record (error_rate, failovers, "
+                         "p95-vs-baseline)")
+    ap.add_argument("--fault-spec", default="",
+                    help="serve fault schedule for --chaos "
+                         "(utils/faults.py grammar, e.g. 'crash@1:4,"
+                         "badhealth@0:3'); default: crash replica 1 "
+                         "mid-decode")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="replica count (default: 2 for --router, "
+                         "3 for --chaos)")
     ap.add_argument("--kv-block-size", type=int, default=-1,
                     help="paged KV block size; -1 = dense pool "
                          "(--router defaults to 16)")
@@ -522,6 +753,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not args.smoke and not args.workdir:
         ap.error("pick a target: --smoke or --workdir DIR")
+    if args.replicas <= 0:
+        args.replicas = 3 if args.chaos else 2
+
+    if args.chaos:
+        rec = run_chaos_bench(args)
+        print(json.dumps(rec))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        return 0 if rec["ok"] else 1
 
     if args.router:
         rec = run_router_bench(args)

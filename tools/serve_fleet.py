@@ -1,10 +1,25 @@
 #!/usr/bin/env python
-"""Fleet router CLI: one endpoint over N serving replicas (ISSUE 8).
+"""Fleet router CLI: one endpoint over N serving replicas (ISSUE 8),
+with replica supervision (ISSUE 10).
 
     # Replicas started elsewhere (examples/gpt2/serve.py, one per
     # host/chip), router in front:
     python tools/serve_fleet.py --port 9000 \
         --replica http://host-a:8000 --replica http://host-b:8000
+
+    # SUPERVISED local replicas: serve_fleet spawns each --spawn
+    # command (the {port} placeholder receives an assigned port),
+    # waits for its /health to go green, and a supervisor thread then
+    # watches it — a replica that dies (process exit) or wedges
+    # (/health stalling past --health-stall) is quarantined in the
+    # router, restarted (the fresh process re-warms its own AOT
+    # ladder), and re-admitted only once /health is green again. A
+    # crash-looping replica is given up on after --max-restarts and
+    # left quarantined with an ERROR.
+    python tools/serve_fleet.py --port 9000 \
+        --spawn 'python examples/gpt2/serve.py --workdir w0 --port {port}' \
+        --spawn 'python examples/gpt2/serve.py --workdir w0 --port {port}' \
+        --spawn-base-port 8100
 
     # Canary rollout: route 25% of traffic to the canary set and bank
     # a run_diff comparison of the two sets at exit (or on demand at
@@ -70,9 +85,35 @@ def main(argv=None) -> int:
     ap.add_argument("--diff-out", default="",
                     help="write the base-vs-canary run_diff doc here "
                          "at exit (needs --canary)")
+    ap.add_argument("--spawn", action="append", default=[],
+                    help="spawn + SUPERVISE a local replica from this "
+                         "command ({port} placeholder; repeatable)")
+    ap.add_argument("--spawn-base-port", type=int, default=8100,
+                    help="first port for --spawn replicas")
+    ap.add_argument("--spawn-warm-timeout", type=float, default=600.0,
+                    help="seconds to wait for a spawned replica's "
+                         "/health to go green at startup")
+    ap.add_argument("--health-stall", type=float, default=15.0,
+                    help="supervisor: /health silent this long -> "
+                         "restart the replica")
+    ap.add_argument("--max-restarts", type=int, default=5,
+                    help="supervisor: give up on a crash-looping "
+                         "replica after this many restarts")
+    ap.add_argument("--max-retries", type=int, default=2,
+                    help="bounded retry: re-dispatches per request")
+    ap.add_argument("--hedge-after", type=float, default=0.0,
+                    help=">0: hedged dispatch for p99 — resend a "
+                         "request unanswered this long (seconds)")
+    ap.add_argument("--eject-after", type=int, default=3,
+                    help="circuit breaker: consecutive dispatch "
+                         "failures before ejecting a replica")
+    ap.add_argument("--eject-cooldown", type=float, default=3.0,
+                    help="circuit breaker: seconds ejected before the "
+                         "half-open probe")
     args = ap.parse_args(argv)
-    if not args.replica:
-        ap.error("at least one --replica URL is required")
+    if not args.replica and not args.spawn:
+        ap.error("at least one --replica URL or --spawn command is "
+                 "required")
     if args.diff_out and not args.canary:
         ap.error("--diff-out needs a --canary set to compare against")
 
@@ -80,22 +121,76 @@ def main(argv=None) -> int:
         Router,
         RouterConfig,
         RouterFrontend,
+        _get_json,
+    )
+    from tensorflow_examples_tpu.serving.supervisor import (
+        ProcessReplica,
+        Supervisor,
     )
 
+    spawned = []
+    try:
+        for i, cmd in enumerate(args.spawn):
+            rep = ProcessReplica(
+                cmd, port=args.spawn_base_port + i
+            ).start()
+            spawned.append(rep)
+        for rep in spawned:
+            deadline = time.monotonic() + args.spawn_warm_timeout
+            while time.monotonic() < deadline:
+                status, body = _get_json(rep.url + "/health", 2.0)
+                if status == 200 and body.get("ok"):
+                    print(f"replica {rep.url} green", file=sys.stderr)
+                    break
+                if not rep.alive():
+                    raise SystemExit(
+                        f"spawned replica {rep.url} exited before its "
+                        "/health ever went green"
+                    )
+                time.sleep(0.5)
+            else:
+                raise SystemExit(
+                    f"spawned replica {rep.url} not green within "
+                    f"{args.spawn_warm_timeout:.0f}s"
+                )
+    except BaseException:
+        # A failed startup must not orphan the replicas already
+        # spawned — they hold their ports (and devices) with no
+        # supervisor attached.
+        for rep in spawned:
+            rep.close()
+        raise
+
+    replica_urls = args.replica + [rep.url for rep in spawned]
     router = Router(
-        args.replica,
+        replica_urls,
         canary=args.canary,
         cfg=RouterConfig(
             probe_interval_s=args.probe_interval,
             request_timeout_s=args.request_timeout,
             retry_budget_s=args.retry_budget,
+            max_retries=args.max_retries,
+            hedge_after_s=args.hedge_after,
+            eject_after=args.eject_after,
+            eject_cooldown_s=args.eject_cooldown,
             canary_fraction=args.canary_fraction,
         ),
     ).start()
+    supervisor = None
+    if spawned:
+        supervisor = Supervisor(
+            router,
+            spawned,
+            poll_s=1.0,
+            health_stall_s=args.health_stall,
+            warm_timeout_s=args.spawn_warm_timeout,
+            max_restarts=args.max_restarts,
+        ).start()
     frontend = RouterFrontend(router, port=args.port).start()
     print(
-        f"router on :{frontend.port} over {len(args.replica)} base + "
-        f"{len(args.canary)} canary replica(s)",
+        f"router on :{frontend.port} over {len(replica_urls)} base + "
+        f"{len(args.canary)} canary replica(s)"
+        + (f", supervising {len(spawned)}" if spawned else ""),
         file=sys.stderr,
     )
 
@@ -123,7 +218,11 @@ def main(argv=None) -> int:
                 last_stats = time.monotonic()
     finally:
         frontend.close()
+        if supervisor is not None:
+            supervisor.close()
         router.close()
+        for rep in spawned:
+            rep.close()
         if args.diff_out:
             import run_diff
 

@@ -66,6 +66,21 @@ flags.DEFINE_string(
 flags.DEFINE_boolean(
     "prefix_cache", True,
     "reuse immutable full prompt blocks across requests (paged only)")
+flags.DEFINE_integer(
+    "spec_decode_k", 0,
+    "speculative decoding draft window (docs/serving.md): verify up to "
+    "K drafted tokens per decode step. Output streams stay "
+    "token-identical — K buys TPOT on prompt-like text, never changes "
+    "tokens. 0 disables.")
+flags.DEFINE_integer(
+    "draft_ngram", 3,
+    "longest n-gram the self-speculative drafter matches against the "
+    "request's own context (spec_decode_k > 0 only)")
+flags.DEFINE_string(
+    "decode_attention", "",
+    "decode attention impl: '' (engine default), 'xla' (gather "
+    "reference), 'flash' (Pallas prefill attend), or 'paged_flash' "
+    "(fused paged-decode kernel; requires --kv_block_size)")
 flags.DEFINE_string("vocab_dir", "", "dir with vocab.json+merges.txt")
 flags.DEFINE_string(
     "serve_sharding_config", "",
@@ -202,6 +217,12 @@ def main(argv):
             kv_blocks=FLAGS.kv_blocks,
             kv_dtype=FLAGS.kv_dtype,
             prefix_cache=FLAGS.prefix_cache,
+            spec_decode_k=FLAGS.spec_decode_k,
+            draft_ngram=FLAGS.draft_ngram,
+            **(
+                {"attention": FLAGS.decode_attention}
+                if FLAGS.decode_attention else {}
+            ),
         ),
         sharding=sharding,
     )

@@ -33,11 +33,20 @@ Layout (one module per concern, mirroring the training stack):
 * ``engine.py``    — the compiled serving step: bucketed prefill +
   fixed-shape continuous decode, warmed up ahead of traffic over the
   padding-bucket ladder and wrapped in the PR-3 recompilation sentinel
-  so steady-state serving is provably zero-recompile.
+  so steady-state serving is provably zero-recompile. ISSUE 11 adds
+  the speculative ``verify_k`` rungs (score k draft tokens in one
+  forward, commit the longest agreeing prefix, token-identical by
+  per-position sampling keys) and the ``attention="paged_flash"``
+  fused Pallas paged-decode kernel (``ops/paged_decode.py``).
+* ``speculative.py`` — ISSUE 11: the draft side of speculative
+  decoding — the self-speculative n-gram ``DraftSource`` (a small
+  draft model plugs into the same interface) and the deterministic
+  acceptance rule.
 * ``batcher.py``   — the continuous-batching request queue: admission
   control, max-batch/max-delay coalescing, per-request deadlines,
   bounded-queue backpressure with a load-shed counter, futures back to
-  callers.
+  callers; with speculation on, the decode step becomes draft-propose/
+  verify-commit with per-request acceptance accounting.
 * ``frontend.py``  — stdlib HTTP endpoints (``/generate`` ``/classify``
   ``/metrics`` ``/health`` ``/window``) + SIGTERM drain with
   resilience-layer parity (reuses ``train.resilience.PreemptionGuard``).

@@ -37,6 +37,17 @@ The loop runs on one daemon thread; a ``utils.diagnostics.Watchdog``
 (``watchdog_secs > 0``) gets phase markers (``serve_idle`` /
 ``serve_admit`` / ``serve_prefill`` / ``serve_decode``) so a wedged
 device step is attributed exactly like a training-loop hang.
+
+Speculative decoding (ISSUE 11, ``ServeConfig.spec_decode_k > 0``):
+each decode step first asks the per-request draft source
+(serving/speculative.py) for up to k candidate tokens, runs the
+engine's compiled ``verify_k`` rung over launch token + drafts, and
+commits the longest agreeing prefix — multiple tokens per step, every
+one of them a verify-SAMPLED token at its own position key, so streams
+stay token-identical to the non-speculative path. TPOT records
+wall/committed per token; ``serving/accepted_per_step`` and the
+``serving/spec_*`` counters carry the acceptance story onto the
+schema-v8 stats line.
 """
 
 from __future__ import annotations
@@ -95,12 +106,19 @@ class Result:
     queue_wait_s: float = 0.0
     ttft_s: float | None = None
     total_s: float = 0.0
+    # Per-request speculation accounting (ISSUE 11; zeros with
+    # speculation off): drafts offered to verify steps and drafts
+    # accepted. len(tokens) - 1 - spec_accepted = plain decode commits,
+    # which is how the accounting test ties streams to counters.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class _InFlight:
     __slots__ = (
         "req", "future", "slot", "t_submit", "t_admit", "t_first",
-        "deadline", "tokens", "last_token",
+        "deadline", "tokens", "last_token", "spec_drafted",
+        "spec_accepted",
     )
 
     def __init__(self, req: Request, future, t_submit: float):
@@ -116,16 +134,40 @@ class _InFlight:
         )
         self.tokens: list[int] = []
         self.last_token: int | None = None
+        # Per-request speculation accounting (ISSUE 11): drafts offered
+        # to verify steps and drafts accepted. Committed tokens ==
+        # len(tokens) always — acceptance is a speed story, never a
+        # content one (test-pinned).
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
 
 class ContinuousBatcher:
-    def __init__(self, engine, *, registry=None, watchdog=None):
+    def __init__(self, engine, *, registry=None, watchdog=None,
+                 draft=None):
         self.engine = engine
         cfg = engine.cfg
         self.max_batch = min(
             cfg.max_batch or cfg.max_slots, cfg.max_slots
         )
         self.max_delay_s = cfg.max_delay_s
+        # Speculative decoding (ISSUE 11): with spec_decode_k > 0 the
+        # decode step becomes draft-propose / verify-commit — the
+        # drafter proposes up to k tokens per request, one compiled
+        # verify_k forward scores them, and the longest agreeing prefix
+        # commits. ``draft=`` injects a custom DraftSource (a small
+        # draft model, a test stub); default is the self-speculative
+        # n-gram source.
+        self.spec_k = int(getattr(cfg, "spec_decode_k", 0) or 0)
+        self._draft = None
+        if self.spec_k > 0 and hasattr(engine, "verify"):
+            if draft is None:
+                from tensorflow_examples_tpu.serving.speculative import (
+                    make_draft,
+                )
+
+                draft = make_draft(cfg)
+            self._draft = draft
         self.registry = (
             registry if registry is not None else engine.registry
         )
@@ -298,6 +340,7 @@ class ContinuousBatcher:
                         log.exception("prefill failed; failing request")
                         if item.slot is not None:
                             self.engine.pool.free(item.slot)
+                            self._drop_draft(item.slot)
                             item.slot = None
                         if not item.future.done():
                             item.future.set_exception(e)
@@ -313,16 +356,10 @@ class ContinuousBatcher:
                 continue
             self._wd("serve_decode")
             t0 = time.perf_counter()
+            drafts_by_slot: dict[int, int] = {}
             try:
                 with span("serve_decode_step", active=len(self._active)):
-                    entries = [
-                        (
-                            it.slot, it.last_token, it.req.seed,
-                            it.req.temperature, it.req.top_k,
-                        )
-                        for it in self._active.values()
-                    ]
-                    out = self.engine.decode(entries)
+                    out = self._decode_step(drafts_by_slot)
             except BlockExhausted as e:
                 # Host-side exhaustion BEFORE the device step: no
                 # donated state was lost, so only the named slots (the
@@ -340,6 +377,7 @@ class ContinuousBatcher:
                     if item is None:
                         continue
                     self.engine.pool.free(slot)
+                    self._drop_draft(slot)
                     if not item.future.done():
                         item.future.set_exception(e)
                 continue
@@ -355,13 +393,75 @@ class ContinuousBatcher:
                 self._watchdog.ping(decode_steps)
             tpot = reg.histogram("serving/tpot")
             reg.histogram("serving/decode_step").record(dt)
-            for slot, token in out.items():
+            for slot, toks in out.items():
                 item = self._active[slot]
-                item.tokens.append(token)
-                item.last_token = token
-                tpot.record(dt)
+                item.spec_drafted += drafts_by_slot.get(slot, 0)
+                item.spec_accepted += len(toks) - 1
+                per_tok = dt / len(toks)
+                committed: list[int] = []
+                for token in toks:
+                    item.tokens.append(token)
+                    item.last_token = token
+                    committed.append(token)
+                    tpot.record(per_tok)
+                    if item.req.eos_id is not None \
+                            and token == item.req.eos_id:
+                        # Tokens past eos in the same verify window are
+                        # discarded — identical to the non-speculative
+                        # stream, which stops here.
+                        break
+                if self._draft is not None:
+                    if drafts_by_slot:  # a verify step, not a fallback
+                        # The ENGINE-committed count (pre-eos-discard),
+                        # so the histogram and the spec_* counters
+                        # measure the same thing.
+                        reg.histogram(
+                            "serving/accepted_per_step"
+                        ).record(float(len(toks)))
+                    self._draft.extend(slot, committed)
                 self._maybe_finish(item)
             reg.gauge("serving/active_requests").set(len(self._active))
+
+    def _decode_step(self, drafts_by_slot: dict[int, int]):
+        """One device step over the active set; returns {slot:
+        committed token list}. Speculation on: propose per-request
+        drafts (capped at the request's remaining budget minus the one
+        token the verify itself samples) and run the verify_k rung; a
+        step where NO request has a draft falls back to the plain
+        one-token decode rung — same tokens, (k+1)x less compute."""
+        if self._draft is None:
+            out = self.engine.decode([
+                (
+                    it.slot, it.last_token, it.req.seed,
+                    it.req.temperature, it.req.top_k,
+                )
+                for it in self._active.values()
+            ])
+            return {slot: [tok] for slot, tok in out.items()}
+        entries = []
+        proposed: dict[int, int] = {}
+        for it in self._active.values():
+            remaining = it.req.max_new_tokens - len(it.tokens)
+            k_eff = min(self.spec_k, remaining - 1)
+            drafts = (
+                self._draft.propose(it.slot, k_eff) if k_eff > 0 else []
+            )
+            proposed[it.slot] = len(drafts)
+            entries.append((
+                it.slot, it.last_token, drafts, it.req.seed,
+                it.req.temperature, it.req.top_k,
+            ))
+        if not any(e[2] for e in entries):
+            # drafts_by_slot stays empty: this is a plain decode step,
+            # and the accepted_per_step histogram (like the spec_*
+            # counters) measures VERIFY steps only.
+            out = self.engine.decode([
+                (slot, tok, seed, temp, tk)
+                for slot, tok, _, seed, temp, tk in entries
+            ])
+            return {slot: [tok] for slot, tok in out.items()}
+        drafts_by_slot.update(proposed)
+        return self.engine.verify(entries)
 
     def _gather(self) -> list[_InFlight]:
         """Pull admissible requests without over-committing slots. Idle:
@@ -404,8 +504,13 @@ class ContinuousBatcher:
         for it in list(self._active.values()):
             del self._active[it.slot]
             self.engine.pool.free(it.slot)
+            self._drop_draft(it.slot)
             if not it.future.done():
                 it.future.set_exception(exc)
+
+    def _drop_draft(self, slot: int | None) -> None:
+        if self._draft is not None and slot is not None:
+            self._draft.end(slot)
 
     def _take(self, staged: list, timeout: float | None = None) -> None:
         """Dequeue one request into ``staged``, counted in ``_staged``
@@ -463,6 +568,9 @@ class ContinuousBatcher:
             return
         item.tokens.append(first)
         item.last_token = first
+        if self._draft is not None:
+            # The drafter's context: prompt + everything committed.
+            self._draft.begin(slot, list(req.prompt) + [first])
         self._active[slot] = item
         self._maybe_finish(item)
 
@@ -490,12 +598,15 @@ class ContinuousBatcher:
             del self._active[item.slot]
         if item.slot is not None:
             self.engine.pool.free(item.slot)
+            self._drop_draft(item.slot)
         self._resolve(
             item,
             Result(
                 tokens=item.tokens,
                 prompt_len=len(item.req.prompt),
                 truncated=truncated,
+                spec_drafted=item.spec_drafted,
+                spec_accepted=item.spec_accepted,
             ),
         )
 
@@ -553,6 +664,20 @@ class ContinuousBatcher:
             ),
             "draining": 1 if self._draining else 0,
         }
+        if self.spec_k > 0:
+            # Schema-v8 speculation keys (ISSUE 11): how many tokens a
+            # verify step commits and how often drafts land — the
+            # measured numbers behind any TPOT-speedup claim.
+            steps = counters.get("serving/spec_request_steps", 0)
+            drafted = counters.get("serving/spec_drafted_total", 0)
+            accepted = counters.get("serving/spec_accepted_total", 0)
+            serving["spec_k"] = self.spec_k
+            serving["draft_hit_rate"] = (
+                accepted / drafted if drafted else 0.0
+            )
+            serving["accepted_per_step"] = (
+                (steps + accepted) / steps if steps else 0.0
+            )
         paged = getattr(self.engine.pool, "paged_stats", None)
         if callable(paged):
             serving.update(paged())

@@ -75,9 +75,20 @@ class ServeConfig:
     max_slots: int = 8           # concurrent requests = decode batch shape
     prefill_bucket_floor: int = 16
     kv_bucket_floor: int = 64
-    attention: str = "xla"       # xla | flash (flash: Pallas prefill attend)
+    attention: str = "xla"       # xla | flash (Pallas prefill attend) |
+    #                              paged_flash (fused Pallas paged-decode
+    #                              kernel, ops/paged_decode.py; requires
+    #                              the paged pool)
     cache_dtype: str = ""        # "" -> follow the params dtype
     compile_warmup: int = 1      # expected compiles per sentinel-wrapped fn
+    # ---- speculative decoding (serving/speculative.py; ISSUE 11) ----
+    spec_decode_k: int = 0       # drafts verified per decode step; 0 off.
+    #                              Output streams stay token-identical
+    #                              (acceptance is seed-deterministic);
+    #                              k buys TPOT, never changes tokens.
+    draft: str = "ngram"         # draft source; "ngram" = self-
+    #                              speculative (no second model)
+    draft_ngram: int = 3         # longest n-gram the drafter matches
     # ---- paged KV (serving/paged_kv.py; ISSUE 8) ----
     kv_block_size: int = 0       # 0 -> dense pool (legacy); else paged,
     #                              power of two dividing both bucket
@@ -205,6 +216,48 @@ def _decode_forward(cfg: TransformerConfig, params, k_cache, v_cache,
     return k_cache, v_cache, jnp.dot(x, wte.T)
 
 
+def _verify_forward(cfg: TransformerConfig, params, k_cache, v_cache,
+                    tokens, positions, *, kv_bucket: int):
+    """The speculative ``verify_k`` step (ISSUE 11): score T = k+1
+    tokens per slot in ONE forward. ``tokens`` [S, T] holds each slot's
+    launch token followed by its k draft tokens; row t lands in cache
+    row ``positions[s] + t`` and attends its own populated prefix
+    (``kv_cache.varlen_verify_attention``). Returns the updated caches
+    and logits [S, T, V]. T=1 is numerically the plain decode step.
+
+    Rows past ``max_len`` (a short-budget slot padded to the fixed T)
+    are dropped by scatter semantics and their logits discarded —
+    acceptance (host side) never commits past the rows that landed.
+    """
+    wte = params["wte"]["embedding"]
+    s_n, t_n = tokens.shape
+    pos_grid = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32)
+    x = wte[tokens] + params["wpe"]["embedding"][
+        jnp.minimum(pos_grid, cfg.max_len - 1)
+    ]
+    idx = jnp.arange(s_n)
+    for layer in range(cfg.num_layers):
+        p = params[f"h_{layer}"]
+        y = _layer_norm(x, p["ln_1"])
+        q, k, v = _qkv(y, p["attn"])  # [S, T, H, hd]
+        k_cache = k_cache.at[layer, idx[:, None], :, pos_grid, :].set(
+            k.astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[layer, idx[:, None], :, pos_grid, :].set(
+            v.astype(v_cache.dtype)
+        )
+        att = kv_mod.varlen_verify_attention(
+            q,
+            jax.lax.slice_in_dim(k_cache[layer], 0, kv_bucket, axis=2),
+            jax.lax.slice_in_dim(v_cache[layer], 0, kv_bucket, axis=2),
+            positions,
+        )
+        x = x + _attn_out(att, p["attn"])
+        x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
+    x = _layer_norm(x, params["ln_f"])
+    return k_cache, v_cache, jnp.dot(x, wte.T)
+
+
 # ---------------------------------------------------------- paged forward
 #
 # The paged mirrors of the dense cache ops (ISSUE 8): same math, but
@@ -289,10 +342,15 @@ def _paged_gather_dequant(kv, layer, tables, dtype):
 
 
 def _paged_decode_forward(cfg: TransformerConfig, params, kv, tokens,
-                          positions, tables, *, block_size: int):
+                          positions, tables, *, block_size: int,
+                          attention: str = "xla"):
     """The paged twin of ``_decode_forward``: writes route through the
     block table, attention gathers by it (the
-    ``varlen_decode_attention`` block-table path)."""
+    ``varlen_decode_attention`` block-table path). Under
+    ``attention="paged_flash"`` the gather + masked attention fuse into
+    the ``ops/paged_decode`` Pallas kernel — one launch reading K/V
+    straight through the table (int8 pools dequantize in-kernel); the
+    XLA gather path stays as the selectable reference oracle."""
     wte = params["wte"]["embedding"]
     x = wte[tokens] + params["wpe"]["embedding"][positions]
     lengths = positions + 1
@@ -300,17 +358,73 @@ def _paged_decode_forward(cfg: TransformerConfig, params, kv, tokens,
         tables, (positions // block_size)[:, None], axis=1
     )[:, 0]
     offsets = positions % block_size
+    fused = attention == "paged_flash"
+    if fused:
+        from tensorflow_examples_tpu.ops.paged_decode import (
+            paged_decode_attention,
+        )
     for layer in range(cfg.num_layers):
         p = params[f"h_{layer}"]
         y = _layer_norm(x, p["ln_1"])
         q, k, v = _qkv(y, p["attn"])  # [S, H, hd]
         kv = _paged_write_rows(kv, layer, write_blocks, offsets, k, v)
         if len(kv) == 4:
-            kk, vv = _paged_gather_dequant(kv, layer, tables, q.dtype)
-            att = kv_mod.varlen_decode_attention(q, kk, vv, lengths)
+            if fused:
+                att = paged_decode_attention(
+                    q, kv[0][layer], kv[1][layer], lengths, tables,
+                    k_scale=kv[2][layer], v_scale=kv[3][layer],
+                )
+            else:
+                kk, vv = _paged_gather_dequant(kv, layer, tables, q.dtype)
+                att = kv_mod.varlen_decode_attention(q, kk, vv, lengths)
+        elif fused:
+            att = paged_decode_attention(
+                q, kv[0][layer], kv[1][layer], lengths, tables
+            )
         else:
             att = kv_mod.varlen_decode_attention(
                 q, kv[0][layer], kv[1][layer], lengths,
+                block_tables=tables,
+            )
+        x = x + _attn_out(att, p["attn"])
+        x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
+    x = _layer_norm(x, params["ln_f"])
+    return kv, jnp.dot(x, wte.T)
+
+
+def _paged_verify_forward(cfg: TransformerConfig, params, kv, tokens,
+                          positions, tables, *, block_size: int):
+    """The paged twin of ``_verify_forward``: T rows per slot scattered
+    through the block table (the spec window may cross block
+    boundaries), attention over the slot's gathered view. Rows beyond a
+    slot's allocated blocks — draft padding the pool could not or need
+    not back — resolve to the null block, whose garbage acceptance
+    never commits."""
+    wte = params["wte"]["embedding"]
+    s_n, t_n = tokens.shape
+    nb = tables.shape[1]
+    pos_grid = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32)
+    x = wte[tokens] + params["wpe"]["embedding"][
+        jnp.minimum(pos_grid, cfg.max_len - 1)
+    ]
+    blk = jnp.minimum(pos_grid // block_size, nb - 1)
+    write_blocks = jnp.where(
+        pos_grid < nb * block_size,
+        jnp.take_along_axis(tables, blk, axis=1),
+        0,
+    )
+    offsets = pos_grid % block_size
+    for layer in range(cfg.num_layers):
+        p = params[f"h_{layer}"]
+        y = _layer_norm(x, p["ln_1"])
+        q, k, v = _qkv(y, p["attn"])  # [S, T, H, hd]
+        kv = _paged_write_rows(kv, layer, write_blocks, offsets, k, v)
+        if len(kv) == 4:
+            kk, vv = _paged_gather_dequant(kv, layer, tables, q.dtype)
+            att = kv_mod.varlen_verify_attention(q, kk, vv, positions)
+        else:
+            att = kv_mod.varlen_verify_attention(
+                q, kv[0][layer], kv[1][layer], positions,
                 block_tables=tables,
             )
         x = x + _attn_out(att, p["attn"])
@@ -441,6 +555,28 @@ def request_key(seed: int, position: int) -> jax.Array:
 _request_key_batch = jax.vmap(request_key)
 
 
+def _sample_verify(seeds, positions, logits, temps, top_ks):
+    """Sample every verify row with its request's own per-POSITION key:
+    row t of slot s draws with ``fold_in(seed_s, positions[s] + t + 1)``
+    — exactly the key a plain decode step would consume at that
+    absolute position. That per-position (not per-step) key discipline
+    is what keeps sampled streams token-identical with speculation on:
+    acceptance changes which rows ship, never what any position draws.
+    """
+    s_n, t_n, _ = logits.shape
+    pos = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32) + 1
+    keys = jax.vmap(_request_key_batch)(
+        jnp.broadcast_to(seeds[:, None], (s_n, t_n)), pos
+    )
+    flat = _sample_batch(
+        keys.reshape((s_n * t_n,) + keys.shape[2:]),
+        logits.reshape(s_n * t_n, -1),
+        jnp.repeat(temps, t_n),
+        jnp.repeat(top_ks, t_n),
+    )
+    return flat.reshape(s_n, t_n)
+
+
 # ---------------------------------------------------------------- engine
 
 
@@ -487,11 +623,16 @@ class InferenceEngine:
         # harness). The serve-side fault engine keys on it; 0 for a
         # standalone server.
         self.replica_id = 0
-        if self.cfg.attention not in ("xla", "flash"):
+        if self.cfg.attention not in ("xla", "flash", "paged_flash"):
             raise ValueError(
                 f"ServeConfig.attention={self.cfg.attention!r} not in "
-                "('xla', 'flash')"
+                "('xla', 'flash', 'paged_flash')"
             )
+        # Prefill always runs the full-prompt causal forward; the
+        # paged-decode kernel only exists for the per-slot decode step.
+        self._prefill_attn = (
+            "flash" if self.cfg.attention == "flash" else "xla"
+        )
         # Sharded serving (ISSUE 7): the SAME ShardingConfig training
         # persisted to workdir/sharding.json places the param tree by
         # its rules (instead of replicating) and the KV pool with heads
@@ -536,6 +677,24 @@ class InferenceEngine:
             else param_dtype
         )
         self.paged = self.cfg.kv_block_size > 0
+        if self.cfg.attention == "paged_flash" and not self.paged:
+            raise ValueError(
+                "attention='paged_flash' is the fused paged-decode "
+                "kernel — it requires the paged pool (set kv_block_size)"
+            )
+        if self.cfg.spec_decode_k < 0:
+            raise ValueError(
+                f"spec_decode_k={self.cfg.spec_decode_k} must be >= 0"
+            )
+        if self.cfg.spec_decode_k + 1 > self.cfg.prefill_bucket_floor:
+            # Parked slots write their discarded verify rows at
+            # positions [0, k+1); any later prefill overwrites at least
+            # the smallest bucket, which must cover them.
+            raise ValueError(
+                f"spec_decode_k={self.cfg.spec_decode_k} + 1 must not "
+                f"exceed prefill_bucket_floor="
+                f"{self.cfg.prefill_bucket_floor}"
+            )
         if self.paged:
             bs = self.cfg.kv_block_size
             for name, val in (
@@ -631,6 +790,16 @@ class InferenceEngine:
                 )
                 for tb in self.prefill_ladder
             } if self.cfg.prefix_cache else {}
+            self._verify_fns = {
+                kb: self.sentinel.wrap(
+                    jax.jit(
+                        functools.partial(self._paged_verify_impl, kb),
+                        donate_argnums=(1,),
+                    ),
+                    f"serve_verify_K{kb}",
+                )
+                for kb in self.kv_ladder
+            } if self.cfg.spec_decode_k > 0 else {}
         else:
             self._prefill_fns = {
                 lb: self.sentinel.wrap(
@@ -653,6 +822,16 @@ class InferenceEngine:
                 for kb in self.kv_ladder
             }
             self._extend_fns = {}
+            self._verify_fns = {
+                kb: self.sentinel.wrap(
+                    jax.jit(
+                        functools.partial(self._verify_impl, kb),
+                        donate_argnums=(1, 2),
+                    ),
+                    f"serve_verify_K{kb}",
+                )
+                for kb in self.kv_ladder
+            } if self.cfg.spec_decode_k > 0 else {}
         self.warmed = False
         self._ref_fwd = None
 
@@ -688,7 +867,7 @@ class InferenceEngine:
         the first generated token from the logits at row length-1."""
         del bucket  # static: encoded in tokens.shape
         logits, ks, vs = forward_full(
-            self.model_cfg, params, tokens, impl=self.cfg.attention
+            self.model_cfg, params, tokens, impl=self._prefill_attn
         )
         # [L, 1, bucket, H, hd] -> [L, 1, H, bucket, hd] cache layout.
         kstack = ks.transpose(0, 1, 3, 2, 4).astype(k_cache.dtype)
@@ -711,6 +890,20 @@ class InferenceEngine:
         keys = _request_key_batch(seeds, positions + 1)
         return k_cache, v_cache, _sample_batch(keys, logits, temps, top_ks)
 
+    def _verify_impl(self, bucket, params, k_cache, v_cache, tokens,
+                     positions, seeds, temps, top_ks):
+        """Speculative verify (ISSUE 11): tokens [S, T] = launch token
+        + k drafts per slot, one forward, per-position sampling keys.
+        Returns the caches and the sampled stream [S, T] the host's
+        acceptance walks."""
+        k_cache, v_cache, logits = _verify_forward(
+            self.model_cfg, params, k_cache, v_cache, tokens, positions,
+            kv_bucket=bucket,
+        )
+        return k_cache, v_cache, _sample_verify(
+            seeds, positions, logits, temps, top_ks
+        )
+
     # --------------------------------------------- compiled fns (paged)
 
     def _paged_prefill_impl(self, bucket, params, kv, block_ids, tokens,
@@ -718,7 +911,7 @@ class InferenceEngine:
         """The paged twin of ``_prefill_impl``: same forward, K/V
         scattered into the slot's blocks instead of its dense extent."""
         logits, ks, vs = forward_full(
-            self.model_cfg, params, tokens, impl=self.cfg.attention
+            self.model_cfg, params, tokens, impl=self._prefill_attn
         )
         kv = _paged_write_prompt(
             kv, ks[:, 0], vs[:, 0], block_ids,
@@ -735,9 +928,22 @@ class InferenceEngine:
         kv, logits = _paged_decode_forward(
             self.model_cfg, params, kv, tokens, positions, tables,
             block_size=self.cfg.kv_block_size,
+            attention=self.cfg.attention,
         )
         keys = _request_key_batch(seeds, positions + 1)
         return kv, _sample_batch(keys, logits, temps, top_ks)
+
+    def _paged_verify_impl(self, bucket, params, kv, tokens, positions,
+                           tables, seeds, temps, top_ks):
+        """The paged twin of ``_verify_impl`` (same sampling contract;
+        the verify attention keeps the gather path — its cost amortizes
+        over T tokens)."""
+        del bucket  # static: encoded in tables.shape
+        kv, logits = _paged_verify_forward(
+            self.model_cfg, params, kv, tokens, positions, tables,
+            block_size=self.cfg.kv_block_size,
+        )
+        return kv, _sample_verify(seeds, positions, logits, temps, top_ks)
 
     def _extend_impl(self, tail_bucket, params, kv, ctx_table, tail_ids,
                      tokens, ctx_len, tail_len, key, temp, top_k):
@@ -797,6 +1003,19 @@ class InferenceEngine:
                 )
                 self.pool.set_kv_state(kv)
                 tok.block_until_ready()
+            t_n = self.cfg.spec_decode_k + 1
+            for kb in self._verify_fns:
+                kv, toks = self._verify_fns[kb](
+                    self.params, self.pool.kv_state(),
+                    jnp.zeros((s, t_n), jnp.int32),
+                    jnp.zeros((s,), jnp.int32),
+                    jnp.zeros((s, kb // bs), jnp.int32),
+                    jnp.zeros((s,), jnp.int32),
+                    jnp.zeros((s,), jnp.float32),
+                    jnp.zeros((s,), jnp.int32),
+                )
+                self.pool.set_kv_state(kv)
+                toks.block_until_ready()
         else:
             for lb in self.prefill_ladder:
                 self.pool.k, self.pool.v, tok, _ = self._prefill_fns[lb](
@@ -813,6 +1032,17 @@ class InferenceEngine:
                     jnp.zeros((s,), jnp.int32),
                 )
                 toks.block_until_ready()
+            t_n = self.cfg.spec_decode_k + 1
+            for kb in self._verify_fns:
+                self.pool.k, self.pool.v, toks = self._verify_fns[kb](
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.zeros((s, t_n), jnp.int32),
+                    jnp.zeros((s,), jnp.int32),
+                    jnp.zeros((s,), jnp.int32),
+                    jnp.zeros((s,), jnp.float32),
+                    jnp.zeros((s,), jnp.int32),
+                )
+                toks.block_until_ready()
         self.pool.reset()
         self.warmed = True
         counts = self.sentinel.compile_counts()
@@ -826,7 +1056,7 @@ class InferenceEngine:
     def expected_compiles(self) -> int:
         return (
             len(self.prefill_ladder) + len(self.kv_ladder)
-            + len(self._extend_fns)
+            + len(self._extend_fns) + len(self._verify_fns)
         )
 
     def post_warmup_recompiles(self) -> int:
@@ -835,6 +1065,22 @@ class InferenceEngine:
         return self.sentinel.post_warmup_recompiles()
 
     # ------------------------------------------------------ request ops
+
+    def _run_compiled(self, kind: str, fn, *args):
+        """Run one donated compiled step. On ANY runtime failure the
+        donated KV buffers were consumed, so the pool is reallocated
+        and :class:`EngineStepError` surfaces — the one place the
+        donation-recovery contract lives (prefill/extend, decode, and
+        verify all route through it; the batcher fails the whole
+        in-flight set on the error)."""
+        try:
+            return fn(*args)
+        except Exception as e:
+            self.pool.reallocate()
+            raise EngineStepError(
+                f"compiled {kind} step failed (KV caches reallocated): "
+                f"{type(e).__name__}: {e}"
+            ) from e
 
     def prefill(self, slot: int, prompt: Sequence[int], *, seed: int = 0,
                 temperature: float = 0.0, top_k: int = 0):
@@ -862,21 +1108,13 @@ class InferenceEngine:
             bucket = kv_mod.pick_bucket(self.prefill_ladder, n)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :n] = prompt
-            try:
-                (self.pool.k, self.pool.v, tok, last) = (
-                    self._prefill_fns[bucket](
-                        self.params, self.pool.k, self.pool.v,
-                        jnp.int32(slot), jnp.asarray(tokens), jnp.int32(n),
-                        request_key(seed, n), jnp.float32(temperature),
-                        jnp.int32(top_k),
-                    )
-                )
-            except Exception as e:
-                self.pool.reallocate()
-                raise EngineStepError(
-                    f"compiled prefill step failed (KV caches "
-                    f"reallocated): {type(e).__name__}: {e}"
-                ) from e
+            (self.pool.k, self.pool.v, tok, last) = self._run_compiled(
+                "prefill", self._prefill_fns[bucket],
+                self.params, self.pool.k, self.pool.v,
+                jnp.int32(slot), jnp.asarray(tokens), jnp.int32(n),
+                request_key(seed, n), jnp.float32(temperature),
+                jnp.int32(top_k),
+            )
         self.pool.lengths[slot] = n
         self.registry.counter("serving/prefill_tokens").inc(n)
         return int(tok), np.asarray(last)
@@ -899,44 +1137,39 @@ class InferenceEngine:
         self.pool.assign(slot, reused + fresh)
         key = request_key(seed, n)
         ftemp, ftk = jnp.float32(temperature), jnp.int32(top_k)
-        try:
-            if ctx == 0:
-                bucket = kv_mod.pick_bucket(self.prefill_ladder, n)
-                ids = np.zeros((bucket // bs,), np.int32)
-                ids[:total_blocks] = self.pool.block_tables[
-                    slot, :total_blocks
-                ]
-                tokens = np.zeros((1, bucket), np.int32)
-                tokens[0, :n] = prompt
-                kv, tok, last = self._prefill_fns[bucket](
-                    self.params, self.pool.kv_state(), jnp.asarray(ids),
-                    jnp.asarray(tokens), jnp.int32(n), key, ftemp, ftk,
-                )
-            else:
-                tail = n - ctx
-                tb = kv_mod.pick_bucket(self.prefill_ladder, tail)
-                tail_blocks = total_blocks - ctx // bs
-                tail_ids = np.zeros((tb // bs,), np.int32)
-                tail_ids[:tail_blocks] = self.pool.block_tables[
-                    slot, ctx // bs:total_blocks
-                ]
-                tokens = np.zeros((1, tb), np.int32)
-                tokens[0, :tail] = prompt[ctx:]
-                kv, tok, last = self._extend_fns[tb](
-                    self.params, self.pool.kv_state(),
-                    jnp.asarray(self.pool.block_tables[slot]),
-                    jnp.asarray(tail_ids), jnp.asarray(tokens),
-                    jnp.int32(ctx), jnp.int32(tail), key, ftemp, ftk,
-                )
-                self.registry.counter(
-                    "serving/prefix_reused_tokens"
-                ).inc(ctx)
-        except Exception as e:
-            self.pool.reallocate()
-            raise EngineStepError(
-                f"compiled prefill step failed (KV caches reallocated): "
-                f"{type(e).__name__}: {e}"
-            ) from e
+        if ctx == 0:
+            bucket = kv_mod.pick_bucket(self.prefill_ladder, n)
+            ids = np.zeros((bucket // bs,), np.int32)
+            ids[:total_blocks] = self.pool.block_tables[
+                slot, :total_blocks
+            ]
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = prompt
+            kv, tok, last = self._run_compiled(
+                "prefill", self._prefill_fns[bucket],
+                self.params, self.pool.kv_state(), jnp.asarray(ids),
+                jnp.asarray(tokens), jnp.int32(n), key, ftemp, ftk,
+            )
+        else:
+            tail = n - ctx
+            tb = kv_mod.pick_bucket(self.prefill_ladder, tail)
+            tail_blocks = total_blocks - ctx // bs
+            tail_ids = np.zeros((tb // bs,), np.int32)
+            tail_ids[:tail_blocks] = self.pool.block_tables[
+                slot, ctx // bs:total_blocks
+            ]
+            tokens = np.zeros((1, tb), np.int32)
+            tokens[0, :tail] = prompt[ctx:]
+            kv, tok, last = self._run_compiled(
+                "prefill", self._extend_fns[tb],
+                self.params, self.pool.kv_state(),
+                jnp.asarray(self.pool.block_tables[slot]),
+                jnp.asarray(tail_ids), jnp.asarray(tokens),
+                jnp.int32(ctx), jnp.int32(tail), key, ftemp, ftk,
+            )
+            self.registry.counter(
+                "serving/prefix_reused_tokens"
+            ).inc(ctx)
         self.pool.set_kv_state(kv)
         self.pool.insert_prefix(slot, prompt)
         return tok, last
@@ -999,40 +1232,158 @@ class InferenceEngine:
             tables = np.ascontiguousarray(
                 self.pool.block_tables[:, :bucket // bs]
             )
-            try:
-                kv, out = self._decode_fns[bucket](
-                    self.params, self.pool.kv_state(),
-                    jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(tables), jnp.asarray(seeds),
-                    jnp.asarray(temps), jnp.asarray(top_ks),
-                )
-            except Exception as e:
-                self.pool.reallocate()
-                raise EngineStepError(
-                    f"compiled decode step failed (KV caches "
-                    f"reallocated): {type(e).__name__}: {e}"
-                ) from e
+            kv, out = self._run_compiled(
+                "decode", self._decode_fns[bucket],
+                self.params, self.pool.kv_state(),
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(seeds),
+                jnp.asarray(temps), jnp.asarray(top_ks),
+            )
             self.pool.set_kv_state(kv)
         else:
-            try:
-                self.pool.k, self.pool.v, out = self._decode_fns[bucket](
-                    self.params, self.pool.k, self.pool.v,
-                    jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(seeds), jnp.asarray(temps),
-                    jnp.asarray(top_ks),
-                )
-            except Exception as e:
-                self.pool.reallocate()
-                raise EngineStepError(
-                    f"compiled decode step failed (KV caches "
-                    f"reallocated): {type(e).__name__}: {e}"
-                ) from e
+            self.pool.k, self.pool.v, out = self._run_compiled(
+                "decode", self._decode_fns[bucket],
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(seeds), jnp.asarray(temps),
+                jnp.asarray(top_ks),
+            )
         out = np.asarray(out)
         for slot in slots:
             self.pool.lengths[slot] += 1
         self.registry.counter("serving/decode_steps").inc()
         self.registry.counter("serving/decode_tokens").inc(len(slots))
         return {slot: int(out[slot]) for slot in slots}
+
+    def verify(self, entries):
+        """One SPECULATIVE decode step (ISSUE 11): score each active
+        request's launch token plus its draft tokens in one compiled
+        ``verify_k`` forward and commit the longest agreeing prefix.
+
+        ``entries``: (slot, input_token, draft_tokens, seed,
+        temperature, top_k) per request — the input token sits at cache
+        row ``pool.lengths[slot]``, drafts at the rows after it.
+        Returns {slot: committed token list} — ALWAYS at least one
+        token per entry (the verify-sampled next token; a plain decode
+        step would have produced exactly it), plus one more per
+        accepted draft (``speculative.accept_drafts``). ``lengths``
+        advance by the committed count, so rejected draft rows are
+        overwritten by the next step's writes and never attended.
+        """
+        if not entries:
+            return {}
+        if not self._verify_fns:
+            raise RuntimeError(
+                "verify() requires spec_decode_k > 0 (no verify rungs "
+                "were compiled)"
+            )
+        from tensorflow_examples_tpu.serving.speculative import (
+            accept_drafts,
+        )
+
+        feng = faults_mod.serve_active()
+        if feng is not None:
+            # Same serve-side fault hook as decode(): a chaos schedule
+            # counts speculative steps exactly like plain ones, BEFORE
+            # any device call (no donated state lost to a fault).
+            feng.decode_step(self.replica_id, [e[0] for e in entries])
+        s = self.cfg.max_slots
+        t_n = self.cfg.spec_decode_k + 1
+        max_len = self.model_cfg.max_len
+        tokens = np.zeros((s, t_n), np.int32)
+        positions = np.zeros((s,), np.int32)
+        temps = np.zeros((s,), np.float32)
+        top_ks = np.zeros((s,), np.int32)
+        seeds = np.zeros((s,), np.int32)
+        slots: list[int] = []
+        drafts_by_slot: dict[int, list[int]] = {}
+        limits: dict[int, int] = {}
+        for slot, token, drafts, seed, temp, tk in entries:
+            pos = int(self.pool.lengths[slot])
+            drafts = [int(d) for d in drafts][: self.cfg.spec_decode_k]
+            tokens[slot, 0] = token
+            tokens[slot, 1:1 + len(drafts)] = drafts
+            positions[slot] = pos
+            temps[slot] = temp
+            top_ks[slot] = tk
+            seeds[slot] = seed
+            slots.append(slot)
+            drafts_by_slot[slot] = drafts
+            # Committed rows must have landed in the cache: the dense
+            # extent caps them at max_len (rows past it were dropped).
+            limits[slot] = max_len - pos
+        bucket = kv_mod.pick_bucket(
+            self.kv_ladder,
+            min(int(positions.max(initial=0)) + t_n, max_len),
+        )
+        if self.paged:
+            from tensorflow_examples_tpu.serving import paged_kv
+
+            exhausted = []
+            for slot in slots:
+                pos = int(positions[slot])
+                try:
+                    self.pool.ensure_position(
+                        slot, min(pos + t_n - 1, max_len - 1)
+                    )
+                except paged_kv.BlockExhausted:
+                    # Shrink the spec window before shedding anything:
+                    # the NON-speculative requirement is one row.
+                    try:
+                        self.pool.ensure_position(slot, pos)
+                    except paged_kv.BlockExhausted:
+                        exhausted.append(slot)
+                        continue
+                limits[slot] = min(
+                    limits[slot],
+                    self.pool.covered_positions(slot) - pos,
+                )
+            if exhausted:
+                raise paged_kv.BlockExhausted(
+                    "KV block pool exhausted mid-decode for slot(s) "
+                    f"{exhausted}; pool is serving at capacity",
+                    slots=tuple(exhausted),
+                )
+            bs = self.cfg.kv_block_size
+            tables = np.ascontiguousarray(
+                self.pool.block_tables[:, :bucket // bs]
+            )
+            kv, out = self._run_compiled(
+                "verify", self._verify_fns[bucket],
+                self.params, self.pool.kv_state(),
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(seeds),
+                jnp.asarray(temps), jnp.asarray(top_ks),
+            )
+            self.pool.set_kv_state(kv)
+        else:
+            self.pool.k, self.pool.v, out = self._run_compiled(
+                "verify", self._verify_fns[bucket],
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(seeds), jnp.asarray(temps),
+                jnp.asarray(top_ks),
+            )
+        out = np.asarray(out)
+        committed: dict[int, list[int]] = {}
+        total = drafted = accepted = 0
+        for slot in slots:
+            toks = accept_drafts(
+                drafts_by_slot[slot], out[slot], limit=limits[slot]
+            )
+            committed[slot] = toks
+            self.pool.lengths[slot] += len(toks)
+            total += len(toks)
+            drafted += len(drafts_by_slot[slot])
+            accepted += len(toks) - 1
+        reg = self.registry
+        reg.counter("serving/decode_steps").inc()
+        reg.counter("serving/decode_tokens").inc(total)
+        reg.counter("serving/spec_steps").inc()
+        reg.counter("serving/spec_request_steps").inc(len(slots))
+        reg.counter("serving/spec_drafted_total").inc(drafted)
+        reg.counter("serving/spec_accepted_total").inc(accepted)
+        return committed
 
     # ------------------------------------------------------- references
 

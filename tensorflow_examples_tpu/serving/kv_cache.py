@@ -128,6 +128,57 @@ def varlen_decode_attention(
     ).astype(q.dtype)
 
 
+def varlen_verify_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    positions: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    block_tables: jax.Array | None = None,
+) -> jax.Array:
+    """Multi-token generalization of :func:`varlen_decode_attention`
+    for the speculative ``verify_k`` step (ISSUE 11).
+
+    q: [S, T, H, D] — T new queries per slot (the launch token plus
+    T-1 draft tokens), occupying global positions
+    ``positions[s] .. positions[s] + T - 1``; their K/V rows are
+    already written to the cache. Row t of slot s attends columns
+    ``<= positions[s] + t`` — its own populated prefix INCLUDING
+    itself, the verify-time mirror of continuous decode's per-slot
+    length vector (T=1 reduces to exactly
+    ``varlen_decode_attention(..., lengths=positions + 1)``).
+
+    k_cache / v_cache: [S, H, Kb, D] bucket-sliced caches, or the
+    paged block pool ([NB, H, BS, D]) when ``block_tables`` is given —
+    same gather contract as the decode path. Returns [S, T, H, D];
+    numerics mirror the decode path (f32 scores/softmax, probabilities
+    cast to the value dtype, f32 accumulation) so a verify step's
+    sampled tokens match what T single-token steps would have drawn —
+    the property every token-identical golden with speculation on
+    rests on.
+    """
+    if block_tables is not None:
+        k_cache = gather_block_kv(k_cache, block_tables)
+        v_cache = gather_block_kv(v_cache, block_tables)
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "sthd,shkd->shtk", q, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * sm_scale
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    limit = positions[:, None, None, None] + row
+    s = jnp.where(col <= limit, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum(
+        "shtk,shkd->shtd", p, v_cache,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    return out.transpose(0, 2, 1, 3)
+
+
 class KVCachePool:
     """Preallocated per-request KV slots with host-side bookkeeping.
 

@@ -371,9 +371,11 @@ class PagedKVPool:
             self._publish()
 
     def ensure_position(self, slot: int, position: int) -> None:
-        """Grow the slot's table to cover ``position`` (one block at a
-        time during decode). Raises :class:`BlockExhausted` when the
-        pool cannot back the growth — the caller fails THAT request."""
+        """Grow the slot's table to cover ``position`` (one block per
+        step in plain decode; a speculative verify step may need
+        several — the spec window can cross block boundaries). Growth
+        is all-or-nothing: on :class:`BlockExhausted` nothing was
+        claimed and the caller fails THAT request."""
         need = position // self.block_size + 1
         with self._lock:
             have = int(self._slot_blocks[slot])
@@ -383,11 +385,27 @@ class PagedKVPool:
                 raise ValueError(
                     f"position {position} exceeds max_len {self.max_len}"
                 )
-            bid = self._alloc_block_locked()
-            self._refcount[bid] = 1
-            self.block_tables[slot, have] = bid
-            self._slot_blocks[slot] = have + 1
+            got: list[int] = []
+            try:
+                for _ in range(need - have):
+                    got.append(self._alloc_block_locked())
+            except BlockExhausted:
+                for bid in got:
+                    self._free_blocks.append(bid)
+                raise
+            for i, bid in enumerate(got):
+                self._refcount[bid] = 1
+                self.block_tables[slot, have + i] = bid
+            self._slot_blocks[slot] = need
             self._publish()
+
+    def covered_positions(self, slot: int) -> int:
+        """Token rows the slot's allocated blocks can hold — the cap on
+        how many verify rows may COMMIT when a speculative window could
+        not be fully backed (rows past it land in the null block and
+        their tokens must not ship)."""
+        with self._lock:
+            return int(self._slot_blocks[slot]) * self.block_size
 
     # ------------------------------------------------------ prefix cache
 

@@ -3,7 +3,8 @@ ISSUE 3; v3 in ISSUE 4; v4 in ISSUE 5; v5 in ISSUE 7; v6 in ISSUE 8 —
 paged-KV block/prefix-cache fields and router-tier fields on the
 ``serving`` object, see ``SERVING_KEYS_V6``; v7 in ISSUE 10 —
 fault-tolerance counters on the router's ``serving`` object, see
-``SERVING_KEYS_V7``).
+``SERVING_KEYS_V7``; v8 in ISSUE 11 — speculative-decoding measurement
+keys on the batcher's ``serving`` object, see ``SERVING_KEYS_V8``).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -117,9 +118,15 @@ SCHEMA_VERSION = 5
 # carry the fault-tolerance counters (router_ejections /
 # router_readmits / router_hedges / router_failovers /
 # router_restarts), all numeric; forbidden on v4-v6 serving lines.
-SERVING_SCHEMA_VERSION = 7
+#
+# Version 8 (ISSUE 11): additive — a speculative-decoding serving line
+# may carry spec_k (the configured draft window), draft_hit_rate
+# (accepted drafts / offered drafts) and accepted_per_step (mean
+# committed tokens per request verify step), all numeric; forbidden on
+# v4-v7 serving lines, same mislabeling rule as every earlier bump.
+SERVING_SCHEMA_VERSION = 8
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -171,6 +178,12 @@ SERVING_KEYS_V6 = ("block_size", "blocks_total", "blocks_used",
 SERVING_KEYS_V7 = ("router_ejections", "router_readmits",
                    "router_hedges", "router_failovers",
                    "router_restarts")
+
+# v8-only serving-object keys (ISSUE 11): the speculative-decoding
+# measurement trio the batcher stamps when spec_decode_k > 0. Optional
+# on write (a non-speculative line carries none), FORBIDDEN on v4-v7
+# serving lines.
+SERVING_KEYS_V8 = ("accepted_per_step", "draft_hit_rate", "spec_k")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -437,6 +450,13 @@ def validate_line(obj: Any) -> list[str]:
                     if key in obj["serving"]:
                         problems.append(
                             f"v7 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
+            if version < 8:
+                for key in SERVING_KEYS_V8:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v8 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
     elif "serving" in obj:

@@ -381,10 +381,12 @@ CHAOS_MODEL = dict(
 )
 
 
-def _real_engine_factory():
+def _real_engine_factory(spec_decode_k: int = 0):
     """Tiny REAL paged engine for the golden: small enough that three
     warmups + one supervisor re-warm stay tier-1 friendly, real enough
-    that the token-identity and zero-recompile claims mean something."""
+    that the token-identity and zero-recompile claims mean something.
+    ``spec_decode_k`` arms speculative decoding (ISSUE 11) — the chaos
+    contract must hold with the verify path on the hot loop too."""
     import jax
     import jax.numpy as jnp
 
@@ -403,9 +405,14 @@ def _real_engine_factory():
         cfg=ServeConfig(
             max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=16,
             kv_block_size=8, max_delay_s=0.0, request_timeout_s=60.0,
+            spec_decode_k=spec_decode_k,
         ),
         registry=MetricsRegistry(),
     )
+
+
+def _spec_engine_factory():
+    return _real_engine_factory(spec_decode_k=2)
 
 
 class TestChaosGolden:
@@ -496,10 +503,71 @@ class TestChaosGolden:
             # fault-tolerance counters and validates.
             line = json.loads(json.dumps(fleet.router.stats_line()))
             assert schema.validate_line(line) == []
-            assert line["schema_version"] == 7
+            assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION
             assert line["serving"]["router_failovers"] >= 1
             assert line["serving"]["router_ejections"] >= 1
             assert line["serving"]["router_restarts"] == 1
+        finally:
+            rfront.close()
+            fleet.close()
+
+    @pytest.mark.timeout(480)
+    def test_kill_one_of_three_with_speculation_on(self, serve_faults):
+        """ISSUE 11 acceptance: the kill-one-of-three chaos contract
+        holds with SPECULATIVE decoding enabled (spec_decode_k=2) —
+        zero failed requests, and every failover replay token-identical
+        to the unbatched reference. Speculation is seed-deterministic
+        per position, so a victim replayed from the prompt on a
+        survivor commits exactly the same stream no matter how its
+        draft windows land."""
+        import serve_bench
+
+        fault_engine = serve_faults("crash@1:3")
+        fleet = ChaosFleet(
+            [_spec_engine_factory] * 3,
+            router_cfg=RouterConfig(
+                probe_interval_s=0.1, retry_budget_s=30.0,
+                max_retries=4, eject_after=1, eject_cooldown_s=1.0,
+            ),
+            supervisor_kw=dict(
+                poll_s=0.05, health_stall_s=3.0, warm_timeout_s=240.0,
+            ),
+        )
+        fleet.start()
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            n, max_new = 10, 5
+            prompts = serve_bench.make_prompts(
+                n, vocab=CHAOS_MODEL["vocab_size"],
+                max_len=CHAOS_MODEL["max_len"], max_new=max_new,
+                seed=17, shared_prefix_every=4,
+            )
+            out = serve_bench.drive(
+                None, prompts, concurrency=3, max_new=max_new,
+                temperature=0.7, top_k=0,
+                http_url=rfront.url("/generate"), timeout=60.0,
+            )
+            statuses = [
+                r[0] if r is not None else None for r in out["replies"]
+            ]
+            assert statuses.count(200) == n, statuses
+            assert ("crash", 1, 3) in fault_engine.fired
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/failovers_total", 0) >= 1
+            ref_engine = fleet.replicas[0].engine
+            for i, prompt in enumerate(prompts):
+                expect = ref_engine.reference_generate(
+                    prompt, max_new=max_new, seed=i,
+                    temperature=0.7, top_k=0,
+                )
+                got = out["replies"][i][1]["tokens"]
+                assert got == expect, (
+                    f"speculative request {i} diverged after failover: "
+                    f"{got} != {expect}"
+                )
+            assert fleet.await_fleet_green(3, timeout_s=240)
+            for rep in fleet.replicas:
+                assert rep.engine.post_warmup_recompiles() == 0
         finally:
             rfront.close()
             fleet.close()

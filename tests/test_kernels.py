@@ -268,6 +268,168 @@ class TestFlashDecode:
         )
 
 
+class TestPagedDecodeKernel:
+    """ISSUE 11 satellite: the fused Pallas paged-decode kernel
+    (ops/paged_decode.py) pinned element-wise against the XLA gather
+    path (the serving oracle) in interpret mode, across the slot-length
+    / block-table edge cases the paged pool actually produces."""
+
+    BS, NB, H, D = 8, 9, 2, 16
+
+    def _pool(self, seed=0, dtype=jnp.float32):
+        rng = np.random.default_rng(seed)
+        k = jnp.asarray(
+            rng.standard_normal((self.NB, self.H, self.BS, self.D)), dtype
+        )
+        v = jnp.asarray(
+            rng.standard_normal((self.NB, self.H, self.BS, self.D)), dtype
+        )
+        return k, v
+
+    def _case(self, lengths, tables, seed=0):
+        from tensorflow_examples_tpu.ops.paged_decode import (
+            paged_decode_attention,
+            paged_decode_reference,
+        )
+
+        rng = np.random.default_rng(seed + 100)
+        s = len(lengths)
+        q = jnp.asarray(
+            rng.standard_normal((s, self.H, self.D)), jnp.float32
+        )
+        k, v = self._pool(seed)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        tables = jnp.asarray(tables, jnp.int32)
+        out = paged_decode_attention(q, k, v, lengths, tables)
+        ref = paged_decode_reference(q, k, v, lengths, tables)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-6, rtol=2e-6
+        )
+        return out
+
+    def test_single_block_and_length_one(self):
+        self._case([1, 8], [[3, 0], [5, 0]])
+
+    def test_ragged_last_block(self):
+        # Lengths ending mid-block: the final block is partially
+        # populated and masked, exactly the common decode state.
+        self._case([13, 21, 30], [[1, 2, 0, 0], [3, 4, 5, 0],
+                                  [6, 7, 8, 2]])
+
+    def test_empty_slot_is_finite_garbage(self):
+        # A parked slot (length 0) must come out finite (its output is
+        # discarded downstream — both paths emit garbage there, and
+        # DIFFERENT garbage: the oracle's all-masked softmax is
+        # uniform, the kernel's epsilon-guarded sum is ~0 — so only
+        # the populated slot is compared element-wise) and never NaN.
+        from tensorflow_examples_tpu.ops.paged_decode import (
+            paged_decode_attention,
+            paged_decode_reference,
+        )
+
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(
+            rng.standard_normal((2, self.H, self.D)), jnp.float32
+        )
+        k, v = self._pool(5)
+        lengths = jnp.asarray([0, 5], jnp.int32)
+        tables = jnp.asarray([[0, 0], [4, 0]], jnp.int32)
+        out = paged_decode_attention(q, k, v, lengths, tables)
+        ref = paged_decode_reference(q, k, v, lengths, tables)
+        assert bool(jnp.all(jnp.isfinite(out)))
+        np.testing.assert_allclose(
+            np.asarray(out[1]), np.asarray(ref[1]), atol=2e-6, rtol=2e-6
+        )
+
+    def test_null_padded_tables_never_leak(self):
+        # Two slots share a pool; slot 0's null-padded tail entries
+        # must not read slot 1's blocks: perturbing an UNREFERENCED
+        # block changes nothing.
+        from tensorflow_examples_tpu.ops.paged_decode import (
+            paged_decode_attention,
+        )
+
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(
+            rng.standard_normal((1, self.H, self.D)), jnp.float32
+        )
+        k, v = self._pool(7)
+        lengths = jnp.asarray([10], jnp.int32)
+        tables = jnp.asarray([[2, 6, 0, 0]], jnp.int32)
+        base = paged_decode_attention(q, k, v, lengths, tables)
+        k2 = k.at[5].add(100.0)  # block 5 is unreferenced
+        v2 = v.at[5].add(100.0)
+        again = paged_decode_attention(q, k2, v2, lengths, tables)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(again))
+
+    def test_int8_scales_dequant_in_kernel(self):
+        from tensorflow_examples_tpu.core.precision import (
+            quantize_int8_rows,
+        )
+        from tensorflow_examples_tpu.ops.paged_decode import (
+            paged_decode_attention,
+            paged_decode_reference,
+        )
+
+        rng = np.random.default_rng(3)
+        s = 3
+        q = jnp.asarray(
+            rng.standard_normal((s, self.H, self.D)), jnp.float32
+        )
+        k, v = self._pool(3)
+        qk, ks = quantize_int8_rows(k)
+        qv, vs = quantize_int8_rows(v)
+        lengths = jnp.asarray([5, 16, 27], jnp.int32)
+        tables = jnp.asarray(
+            [[1, 0, 0, 0], [2, 3, 0, 0], [4, 5, 6, 7]], jnp.int32
+        )
+        out = paged_decode_attention(
+            q, qk, qv, lengths, tables, k_scale=ks, v_scale=vs
+        )
+        ref = paged_decode_reference(
+            q, qk, qv, lengths, tables, k_scale=ks, v_scale=vs
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-6, rtol=2e-6
+        )
+
+    def test_jit_traced_lengths_and_tables(self):
+        from tensorflow_examples_tpu.ops.paged_decode import (
+            paged_decode_attention,
+            paged_decode_reference,
+        )
+
+        rng = np.random.default_rng(11)
+        q = jnp.asarray(
+            rng.standard_normal((2, self.H, self.D)), jnp.float32
+        )
+        k, v = self._pool(11)
+        fn = jax.jit(
+            lambda *a: paged_decode_attention(*a, interpret=True)
+        )
+        lengths = jnp.asarray([7, 19], jnp.int32)
+        tables = jnp.asarray([[3, 0, 0], [1, 2, 4]], jnp.int32)
+        out = fn(q, k, v, lengths, tables)
+        ref = paged_decode_reference(q, k, v, lengths, tables)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-6, rtol=2e-6
+        )
+
+    def test_scale_pairing_enforced(self):
+        from tensorflow_examples_tpu.ops.paged_decode import (
+            paged_decode_attention,
+        )
+
+        k, v = self._pool()
+        with pytest.raises(ValueError, match="both k_scale and v_scale"):
+            paged_decode_attention(
+                jnp.zeros((1, self.H, self.D)), k, v,
+                jnp.ones((1,), jnp.int32),
+                jnp.zeros((1, 2), jnp.int32),
+                k_scale=jnp.ones((self.NB, self.H, self.BS)),
+            )
+
+
 class TestFusedCrossEntropy:
     @pytest.mark.parametrize("vocab", [1000, 50257])
     def test_forward_matches_reference(self, vocab):

@@ -651,7 +651,7 @@ class TestRouterSchema:
         line carrying router_failovers is a mislabeled v7 line."""
         r = Router(["http://a:1"])
         line = json.loads(json.dumps(r.stats_line()))
-        assert line["schema_version"] == 7
+        assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION
         assert schema.validate_line(line) == []
         for key in schema.SERVING_KEYS_V7:
             assert key in line["serving"], key

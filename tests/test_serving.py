@@ -1089,6 +1089,83 @@ class TestPagedGolden:
         assert eng.post_warmup_recompiles() == 0
 
 
+class TestPagedFlashGolden:
+    """ISSUE 11: the fused Pallas paged-decode kernel
+    (``attention="paged_flash"``, ops/paged_decode.py) behind the SAME
+    batcher golden the gather path passes — the kernel is a launch/HBM
+    optimization, never a numerics change."""
+
+    @pytest.mark.timeout(300)
+    def test_paged_batcher_golden_under_fused_kernel(self):
+        cfg = tiny_cfg()
+        eng = InferenceEngine(
+            cfg,
+            _tiny_params(cfg),
+            cfg=ServeConfig(
+                max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=32,
+                max_delay_s=0.002, kv_block_size=8,
+                attention="paged_flash",
+            ),
+            registry=MetricsRegistry(),
+        )
+        counts = eng.warmup()
+        assert sum(counts.values()) == eng.expected_compiles()
+        reqs = _mixed_requests(8, eng.model_cfg)
+        batcher = ContinuousBatcher(eng).start()
+        try:
+            futs = [batcher.submit(r) for r in reqs]
+            results = [f.result(timeout=120) for f in futs]
+        finally:
+            batcher.close(drain=True)
+        for req, res in zip(reqs, results):
+            ref = eng.reference_generate(
+                req.prompt, max_new=req.max_new_tokens, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+            )
+            assert res.tokens == ref, (
+                f"paged_flash != reference for prompt_len="
+                f"{len(req.prompt)} temp={req.temperature}"
+            )
+        assert eng.post_warmup_recompiles() == 0
+        assert eng.pool.active_slots == 0
+
+    @pytest.mark.timeout(240)
+    def test_int8_dequant_in_kernel_tracks_fp32(self):
+        """int8 KV under the fused kernel: same bounded-divergence
+        contract as the gather path (first token exact — prefill
+        attends fresh unquantized K/V — and >= 75% stream agreement)."""
+        import numpy as np
+
+        cfg = tiny_cfg(num_layers=1, d_model=16, max_len=32)
+        eng = InferenceEngine(
+            cfg,
+            _tiny_params(cfg),
+            cfg=ServeConfig(
+                max_slots=2, prefill_bucket_floor=16, kv_bucket_floor=16,
+                kv_block_size=8, kv_dtype="int8",
+                attention="paged_flash",
+            ),
+            registry=MetricsRegistry(),
+        )
+        eng.warmup()
+        rng = np.random.default_rng(5)
+        for i in range(2):
+            prompt = [int(t) for t in rng.integers(0, 211, 5 + i * 6)]
+            slot = eng.pool.alloc()
+            tok, _ = eng.prefill(slot, prompt, seed=i)
+            seq = [tok]
+            for _ in range(5):
+                seq.append(eng.decode([(slot, seq[-1], i, 0.0, 0)])[slot])
+            eng.pool.free(slot)
+            ref = eng.reference_generate(prompt, max_new=6, seed=i)
+            assert seq[0] == ref[0], "first token must be exact"
+            agree = sum(a == b for a, b in zip(seq, ref))
+            assert agree >= 0.75 * len(ref), (
+                f"int8 paged_flash diverged beyond bound: {seq} vs {ref}"
+            )
+        assert eng.post_warmup_recompiles() == 0
+
+
 class TestPagedExhaustionServing:
     @pytest.mark.timeout(120)
     def test_mid_decode_exhaustion_fails_loudly_engine_keeps_serving(self):

@@ -1200,6 +1200,71 @@ class TestBenchGate:
             ["--record", str(worse), "--floors", str(floors)]
         ) == 1
 
+    def test_spec_speedup_stamps_and_gates(self, tmp_path, capsys):
+        """ISSUE 11 satellite: the serve_spec record's tpot_speedup
+        gates as a stamped MINIMUM — a drafter/verify regression that
+        quietly eats the speedup fails like any other perf loss."""
+        rec = {
+            "bench": "serve_spec",
+            "tpot_speedup": 2.1,
+            "draft_hit_rate": 0.9,
+            "accepted_per_step": 4.2,
+        }
+        good = tmp_path / "spec.json"
+        good.write_text(json.dumps(rec))
+        floors = tmp_path / "spec_floors.json"
+        assert self._gate(
+            ["--stamp", str(good), "--floors", str(floors)]
+        ) == 0
+        with open(floors) as f:
+            stamped = json.load(f)
+        assert stamped["tpot_speedup"] == {"min": 2.1}
+        assert stamped["draft_hit_rate"] == {"min": 0.9}
+        assert self._gate(
+            ["--record", str(good), "--floors", str(floors)]
+        ) == 0
+        bad = tmp_path / "spec_bad.json"
+        bad.write_text(json.dumps(dict(rec, tpot_speedup=1.0)))
+        assert self._gate(
+            ["--record", str(bad), "--floors", str(floors)]
+        ) == 1
+        assert "[FAIL] tpot_speedup" in capsys.readouterr().out
+
+    def test_floorless_report_lists_unbanked_gate_keys(
+        self, tmp_path, capsys
+    ):
+        """ISSUE 11 satellite: the floorless-keys report WARNS (exit 0)
+        for every gate key with no banked floor — the ROADMAP standing
+        note's harvest list (sharded_step_time, serving TTFT/TPOT/
+        prefix-hit, chaos p95) made explicit — and drops keys a
+        stamped floors file covers."""
+        rc = self._gate(["--floorless-report"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for key in ("sharded_step_time", "ttft_p95_ms", "tpot_p95_ms",
+                    "prefix_hit_rate", "p95_vs_baseline",
+                    "tpot_speedup"):
+            assert f"[WARN] gate key '{key}'" in out, key
+        # A stamped floor removes its key from the report.
+        floors = tmp_path / "floors.json"
+        floors.write_text(json.dumps({"tpot_speedup": {"min": 2.0}}))
+        rc = self._gate(["--floorless-report", "--floors", str(floors)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "'tpot_speedup'" not in out
+        assert "'sharded_step_time'" in out
+
+    def test_trajectory_gate_appends_floorless_warnings(self, capsys):
+        files = sorted(
+            os.path.join(REPO, f)
+            for f in os.listdir(REPO)
+            if re.fullmatch(r"BENCH_r\d+\.json", f)
+        )
+        assert self._gate(files) == 0
+        out = capsys.readouterr().out
+        assert "bench_gate floorless:" in out
+        assert "[WARN] gate key 'sharded_step_time'" in out
+
 
 class TestFaultInjectServe:
     """ISSUE 10 satellite: tools/fault_inject.py --serve arms the
@@ -1384,6 +1449,40 @@ class TestServeBench:
         for key in ("req_per_s", "tok_per_s", "ttft_p95_ms",
                     "tpot_p95_ms", "e2e_p95_ms", "queue_wait_p95_ms"):
             assert isinstance(rec[key], (int, float)) and rec[key] > 0, key
+
+    @pytest.mark.timeout(300)
+    def test_spec_decode_smoke_banks_ab_record(self, tmp_path):
+        """ISSUE 11 satellite: ``--smoke --spec-decode K`` drives the
+        SAME prompt-like prompts speculation-off then -on, banks a
+        ``serve_spec`` record with the measured tpot_speedup /
+        draft_hit_rate / accepted_per_step, and asserts every on-phase
+        stream token-identical to its off-phase twin with zero
+        post-warmup recompiles across both engines."""
+        import serve_bench
+
+        out = tmp_path / "spec_record.json"
+        rc = serve_bench.main([
+            "--smoke", "--spec-decode", "3", "--requests", "8",
+            "--max-new-tokens", "16", "--concurrency", "4",
+            "--out", str(out),
+        ])
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        assert rec["bench"] == "serve_spec" and rec["spec_k"] == 3
+        assert rec["errors"] == 0 and rec["ok"] is True
+        assert rec["tokens_identical"] is True
+        assert rec["verify_ok"] is True
+        assert rec["post_warmup_recompiles"] == 0
+        # The verify_k rungs are part of the warmed ladder.
+        assert rec["expected_compiles"] > 0
+        assert rec["tpot_speedup"] is not None and rec["tpot_speedup"] > 0
+        assert 0.0 <= rec["draft_hit_rate"] <= 1.0
+        assert rec["accepted_per_step"] >= 1.0
+        assert rec["accepted_per_step_p50"] >= 1.0
+        # Prompt-like traffic through the n-gram drafter must actually
+        # accept drafts — otherwise the A/B measured nothing.
+        assert rec["draft_hit_rate"] > 0.25
 
     @pytest.mark.timeout(300)
     def test_router_smoke_two_paged_replicas(self, tmp_path):

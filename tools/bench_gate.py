@@ -90,6 +90,12 @@ RECORD_KEYS: dict[str, str] = {
     # chaos-vs-baseline p95 ratio as a declared-multiple maximum.
     "error_rate": "max",
     "p95_vs_baseline": "max",
+    # Speculative decoding (ISSUE 11): serve_bench --spec-decode banks
+    # the off/on TPOT ratio — the one number the tentpole claims. A
+    # stamped floor pins it so a drafter/verify regression that quietly
+    # eats the speedup fails CI like any other perf loss.
+    "tpot_speedup": "min",
+    "draft_hit_rate": "min",
 }
 
 
@@ -221,7 +227,8 @@ def extract_records(path: str) -> list[dict]:
 # ---------------------------------------------------- trajectory gate
 
 
-def gate_trajectory(paths: list[str], threshold: float) -> int:
+def gate_trajectory(paths: list[str], threshold: float,
+                    floors_path: str | None = None) -> int:
     import bench  # floors + policy live with the bench driver
 
     latest: dict[tuple[str, str], tuple[str, dict]] = {}
@@ -294,7 +301,49 @@ def gate_trajectory(paths: list[str], threshold: float) -> int:
         f"skipped, {len(failures)} regressed (threshold "
         f"{threshold:.0%})"
     )
+    report_floorless(floors_path)
     return 1 if failures else 0
+
+
+# ----------------------------------------------------- floorless keys
+
+
+def floorless_keys(floors_path: str | None = None) -> list[str]:
+    """Gate keys that exist with NO banked floor anywhere — neither a
+    ``bench.FLOORS`` metric (any backend) nor an entry in an optional
+    stamped record-mode floors file. These are claims the repo gates in
+    tooling but has never pinned to a real-rig number (the ROADMAP
+    standing note: ``sharded_step_time``, serving TTFT/TPOT/prefix-hit,
+    ``serve_chaos`` p95) — the harvest list for the first session on
+    real hardware."""
+    import bench
+
+    floored: set[str] = set()
+    for metrics in bench.FLOORS.values():
+        floored.update(metrics)
+    if floors_path and os.path.isfile(floors_path):
+        with open(floors_path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict):
+            floored.update(doc)
+    return [k for k in sorted(RECORD_KEYS) if k not in floored]
+
+
+def report_floorless(floors_path: str | None = None) -> int:
+    """WARN (never fail) for every floorless gate key; exit 0 always —
+    this is a to-harvest list, not a regression."""
+    missing = floorless_keys(floors_path)
+    for key in missing:
+        print(
+            f"[WARN] gate key '{key}' has no banked floor — harvest a "
+            "known-good record on the real rig and stamp it "
+            "(bench_gate --stamp REPORT --floors FLOORS)"
+        )
+    print(
+        f"bench_gate floorless: {len(missing)} gate key(s) await a "
+        "banked floor"
+    )
+    return 0
 
 
 # -------------------------------------------------------- record gate
@@ -390,8 +439,16 @@ def main(argv=None) -> int:
         "--stamp", metavar="REPORT_JSON",
         help="write --floors from this known-good record, then exit",
     )
+    ap.add_argument(
+        "--floorless-report", action="store_true",
+        help="list gate keys with no banked floor (WARN only, exit 0) "
+        "— the to-harvest list for the first real-rig session; also "
+        "appended to every trajectory gate",
+    )
     args = ap.parse_args(argv)
 
+    if args.floorless_report:
+        return report_floorless(args.floors)
     if args.stamp:
         if not args.floors:
             ap.error("--stamp requires --floors")
@@ -411,7 +468,7 @@ def main(argv=None) -> int:
     if missing:
         print(f"bench_gate: missing file(s): {missing}", file=sys.stderr)
         return 2
-    return gate_trajectory(paths, args.threshold)
+    return gate_trajectory(paths, args.threshold, args.floors)
 
 
 if __name__ == "__main__":

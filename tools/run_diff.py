@@ -77,6 +77,10 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("error_rate", "lower", "", 1.0),
     ("failover_count", "lower", "", 1.0),
     ("p95_vs_baseline", "lower", "", 1.0),
+    # ---- speculative decoding records (ISSUE 11) ----
+    ("tpot_speedup", "higher", "x", 1.0),
+    ("draft_hit_rate", "higher", "", 1.0),
+    ("accepted_per_step", "higher", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -99,6 +103,9 @@ GATE_KEYS = (
     # chaos/availability gate keys (ISSUE 10)
     "error_rate",
     "p95_vs_baseline",
+    # speculative-decoding gate keys (ISSUE 11)
+    "tpot_speedup",
+    "draft_hit_rate",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

@@ -59,6 +59,16 @@ Modes:
   ``p95_vs_baseline`` as a max. ``--smoke --chaos`` is the tier-1
   chaos smoke.
 
+* ``--spec-decode K`` (ISSUE 11) — speculative-decoding A/B: the SAME
+  prompt-like prompts (tiled motifs — the traffic speculation exists
+  for) through two engines, speculation off then on at draft window K,
+  banking a ``serve_spec`` record: ``tpot_speedup`` (off/on TPOT p50
+  ratio — the headline the tentpole claims), ``draft_hit_rate`` and
+  ``accepted_per_step`` p50 (why it moved), ``tokens_identical`` (the
+  determinism contract, checked over EVERY request) and zero
+  post-warmup recompiles across both engines. ``bench_gate`` gates
+  ``tpot_speedup`` as a stamped minimum.
+
 ``--inproc`` skips the HTTP hop (batcher futures driven directly) to
 separate transport cost from engine cost; ``--out`` banks the record
 as a JSON file next to the BENCH_r*.json trajectory.
@@ -145,6 +155,31 @@ def build_checkpoint_engine(workdir: str, serve_cfg, *, registry=None):
     return InferenceEngine(
         gpt2.model_config(cfg), params, cfg=serve_cfg, registry=registry
     )
+
+
+def make_patterned_prompts(n: int, *, vocab: int, max_len: int,
+                           max_new: int,
+                           seed: int = 0) -> list[list[int]]:
+    """Prompt-LIKE prompts for the speculation A/B (ISSUE 11): each is
+    a short random motif tiled to a mixed length, the repetitive shape
+    of real prompt traffic (code, templates, boilerplate) that the
+    self-speculative n-gram drafter exists for. Random-token prompts
+    (``make_prompts``) are the adversarial case — near-zero draft hits
+    — and exactly what a speculation bench must NOT quietly use."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cap = max(4, max_len - max_new)
+    prompts = []
+    for i in range(n):
+        motif = [
+            int(t) for t in rng.integers(0, vocab, int(rng.integers(3, 7)))
+        ]
+        ln = int(rng.integers(max(4, cap // 3), cap + 1))
+        prompts.append((motif * (ln // len(motif) + 1))[:ln])
+    prompts[0] = prompts[0][:max(4, cap // 3)]
+    prompts[-1] = (prompts[-1] * 4)[:cap]
+    return prompts
 
 
 def make_prompts(n: int, *, vocab: int, max_len: int, max_new: int,
@@ -708,6 +743,150 @@ def run_chaos_bench(args) -> dict:
     return rec
 
 
+def run_spec_bench(args) -> dict:
+    """--spec-decode K (ISSUE 11): drive the SAME prompt-like prompts
+    through two freshly built engines — speculation off, then
+    speculation on at draft window K — and bank one ``serve_spec``
+    record. The claims it carries, all measured: ``tpot_speedup``
+    (off-phase TPOT p50 / on-phase TPOT p50 — the headline),
+    ``draft_hit_rate`` and ``accepted_per_step`` p50 (why the headline
+    moved), ``tokens_identical`` (every on-phase stream token-for-token
+    equal to its off-phase twin — speculation is a latency
+    optimization, never a numerics change), and zero post-warmup
+    recompiles across BOTH engines (the verify_k rungs are part of the
+    warmed ladder, counted in expected_compiles)."""
+    import jax
+
+    from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
+    from tensorflow_examples_tpu.serving.engine import ServeConfig
+    from tensorflow_examples_tpu.serving.frontend import ServingFrontend
+    from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+
+    serve_kw = dict(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        kv_block_size=max(args.kv_block_size, 0),
+        kv_dtype=args.kv_dtype,
+    )
+    if args.smoke:
+        serve_kw.update(prefill_bucket_floor=16, kv_bucket_floor=32)
+
+    def build(spec_k: int):
+        reg = MetricsRegistry()
+        cfg = ServeConfig(spec_decode_k=spec_k, **serve_kw)
+        if args.workdir:
+            eng = build_checkpoint_engine(args.workdir, cfg, registry=reg)
+        else:
+            eng = build_smoke_engine(cfg, registry=reg)
+        eng.warmup()
+        return eng, reg
+
+    def phase(eng, reg, prompts):
+        batcher = ContinuousBatcher(eng, registry=reg).start()
+        frontend = ServingFrontend(batcher, port=0)  # in-proc transport
+        try:
+            outcome = drive(
+                frontend, prompts,
+                concurrency=args.concurrency,
+                max_new=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                http_url=None, timeout=args.timeout,
+            )
+        finally:
+            batcher.close(drain=True)
+            frontend.close()
+        return outcome
+
+    n = args.requests or (12 if args.smoke else 48)
+    # Both engines (and their full AOT warmups) are built BEFORE the
+    # clock starts: wall_s measures request driving only, comparable
+    # with every other serve_bench record's.
+    off_eng, off_reg = build(0)
+    on_eng, on_reg = build(args.spec_decode)
+    model_cfg = off_eng.model_cfg
+    prompts = make_patterned_prompts(
+        n, vocab=model_cfg.vocab_size, max_len=model_cfg.max_len,
+        max_new=args.max_new_tokens,
+    )
+    t0 = time.perf_counter()
+    off_out = phase(off_eng, off_reg, prompts)
+    on_out = phase(on_eng, on_reg, prompts)
+    wall = time.perf_counter() - t0
+
+    def done(outcome):
+        return [
+            r for r in outcome["replies"] if r is not None and r[0] == 200
+        ]
+
+    errors = 2 * n - len(done(off_out)) - len(done(on_out))
+    identical = len(done(off_out)) == n and len(done(on_out)) == n and all(
+        a[1].get("tokens") == b[1].get("tokens")
+        for a, b in zip(off_out["replies"], on_out["replies"])
+    )
+
+    def tpot_ms(reg, q):
+        h = reg.histogram_summaries().get("serving/tpot")
+        v = h and h.get(f"p{q}")
+        return round(v * 1e3, 4) if v is not None else None
+
+    def toks_per_s(outcome):
+        toks = sum(len(r[1].get("tokens", ())) for r in done(outcome))
+        return round(toks / outcome["wall_s"], 3) if outcome["wall_s"] \
+            else None
+
+    on_counters = on_reg.counter_values()
+    req_steps = on_counters.get("serving/spec_request_steps", 0)
+    drafted = on_counters.get("serving/spec_drafted_total", 0)
+    accepted = on_counters.get("serving/spec_accepted_total", 0)
+    acc_hist = on_reg.histogram_summaries().get(
+        "serving/accepted_per_step"
+    )
+    off_tpot, on_tpot = tpot_ms(off_reg, 50), tpot_ms(on_reg, 50)
+    recompiles = (
+        off_eng.post_warmup_recompiles() + on_eng.post_warmup_recompiles()
+    )
+    rec = {
+        "bench": "serve_spec",
+        "backend": jax.default_backend(),
+        "requests": n,
+        "spec_k": args.spec_decode,
+        "draft": "ngram",
+        "max_new_tokens": args.max_new_tokens,
+        "concurrency": args.concurrency,
+        "temperature": args.temperature,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "tpot_off_p50_ms": off_tpot,
+        "tpot_on_p50_ms": on_tpot,
+        "tpot_speedup": (
+            round(off_tpot / on_tpot, 3)
+            if off_tpot and on_tpot else None
+        ),
+        "tok_per_s_off": toks_per_s(off_out),
+        "tok_per_s_on": toks_per_s(on_out),
+        "draft_hit_rate": (
+            round(accepted / drafted, 4) if drafted else 0.0
+        ),
+        "accepted_per_step": (
+            round((req_steps + accepted) / req_steps, 4)
+            if req_steps else 0.0
+        ),
+        "accepted_per_step_p50": (
+            acc_hist and acc_hist.get("p50")
+        ),
+        "tokens_identical": identical,
+        "expected_compiles": on_eng.expected_compiles(),
+        "post_warmup_recompiles": recompiles,
+        "kv_block_size": serve_kw["kv_block_size"],
+        "verified": n,
+        "verify_ok": identical,
+        "transport": "inproc",
+    }
+    rec["ok"] = bool(errors == 0 and identical and recompiles == 0)
+    return rec
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
@@ -722,6 +901,12 @@ def main(argv=None) -> int:
                          "fault schedule; banks the serve_chaos "
                          "availability record (error_rate, failovers, "
                          "p95-vs-baseline)")
+    ap.add_argument("--spec-decode", type=int, default=0, metavar="K",
+                    help="ISSUE 11: A/B the same prompt-like prompts "
+                         "with speculation off vs on (K drafts per "
+                         "step); banks the serve_spec record "
+                         "(tpot_speedup, draft_hit_rate, "
+                         "accepted_per_step, tokens_identical)")
     ap.add_argument("--fault-spec", default="",
                     help="serve fault schedule for --chaos "
                          "(utils/faults.py grammar, e.g. 'crash@1:4,"
@@ -755,6 +940,15 @@ def main(argv=None) -> int:
         ap.error("pick a target: --smoke or --workdir DIR")
     if args.replicas <= 0:
         args.replicas = 3 if args.chaos else 2
+
+    if args.spec_decode > 0:
+        rec = run_spec_bench(args)
+        print(json.dumps(rec))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        return 0 if rec["ok"] else 1
 
     if args.chaos:
         rec = run_chaos_bench(args)

@@ -81,6 +81,20 @@ flags.DEFINE_string(
     "decode attention impl: '' (engine default), 'xla' (gather "
     "reference), 'flash' (Pallas prefill attend), or 'paged_flash' "
     "(fused paged-decode kernel; requires --kv_block_size)")
+flags.DEFINE_string(
+    "role", "mixed",
+    "fleet scheduling role (docs/serving.md scheduling section): "
+    "'mixed' (default — serves everything), 'prefill' (runs prompts to "
+    "completion-of-prefill and exports KV pages), or 'decode' (imports "
+    "pages and continues streams). Advisory: every role still answers "
+    "a full /generate. Published on /health for the router.")
+flags.DEFINE_integer(
+    "prefill_chunk_tokens", 0,
+    "chunked prefill admission (docs/serving.md): split any cold "
+    "prompt tail longer than this into block-aligned chunks run one "
+    "per decode-loop iteration, so a long prefill interleaves with "
+    "decode steps. Requires --kv_block_size (+ prefix_cache) and must "
+    "be a multiple of it. 0 disables.")
 flags.DEFINE_string("vocab_dir", "", "dir with vocab.json+merges.txt")
 flags.DEFINE_string(
     "serve_sharding_config", "",
@@ -219,6 +233,8 @@ def main(argv):
             prefix_cache=FLAGS.prefix_cache,
             spec_decode_k=FLAGS.spec_decode_k,
             draft_ngram=FLAGS.draft_ngram,
+            role=FLAGS.role,
+            prefill_chunk_tokens=FLAGS.prefill_chunk_tokens,
             **(
                 {"attention": FLAGS.decode_attention}
                 if FLAGS.decode_attention else {}

@@ -38,6 +38,12 @@ Layout (one module per concern, mirroring the training stack):
   forward, commit the longest agreeing prefix, token-identical by
   per-position sampling keys) and the ``attention="paged_flash"``
   fused Pallas paged-decode kernel (``ops/paged_decode.py``).
+* ``scheduler.py``  — ISSUE 12: cache-aware fleet scheduling
+  primitives — content-addressed prefix chain keys (the affinity hash
+  the router matches prompts against replica digests with), the
+  block-aligned chunk planner behind chunked prefill admission, and
+  the serialized KV-page wire format of the disaggregated
+  prefill->decode handoff.
 * ``speculative.py`` — ISSUE 11: the draft side of speculative
   decoding — the self-speculative n-gram ``DraftSource`` (a small
   draft model plugs into the same interface) and the deterministic

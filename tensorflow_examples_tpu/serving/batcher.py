@@ -82,7 +82,14 @@ class DeadlineExceeded(RuntimeError):
 
 @dataclasses.dataclass
 class Request:
-    """One generate/classify request (token ids in, token ids out)."""
+    """One generate/classify request (token ids in, token ids out).
+
+    The disaggregated roles (ISSUE 12) add two more kinds: ``prefill``
+    runs the prompt to completion-of-prefill and resolves with the
+    first generated token plus the slot's serialized KV pages
+    (``Result.pages``); ``resume`` imports ``pages``/``first_token``
+    from a prefill replica and continues the decode stream. Both
+    require the paged pool."""
 
     prompt: list[int]
     max_new_tokens: int = 16
@@ -91,8 +98,10 @@ class Request:
     seed: int = 0
     eos_id: int | None = None
     deadline_s: float | None = None  # relative to submit time
-    kind: str = "generate"           # generate | classify
+    kind: str = "generate"       # generate | classify | prefill | resume
     classify_top_n: int = 5
+    pages: dict | None = None        # resume: the handed-off KV pages
+    first_token: int | None = None   # resume: the prefill's sampled token
 
 
 @dataclasses.dataclass
@@ -112,6 +121,9 @@ class Result:
     # which is how the accounting test ties streams to counters.
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Disaggregated prefill (ISSUE 12): the serialized KV pages a
+    # kind="prefill" request resolves with (None otherwise).
+    pages: dict | None = None
 
 
 class _InFlight:
@@ -175,6 +187,12 @@ class ContinuousBatcher:
             maxsize=cfg.max_queue
         )
         self._active: dict[int, _InFlight] = {}
+        # Chunked prefills in flight (ISSUE 12): slot -> (item, engine
+        # ChunkedPrefill state). One chunk runs per decode-loop
+        # iteration (oldest first), so a long cold prompt's prefill
+        # interleaves with decode steps instead of monopolizing them.
+        # Single-writer: the loop thread.
+        self._prefilling: dict[int, tuple] = {}
         # Requests the loop has dequeued but not yet admitted into
         # _active (mid-prefill). close(drain=True)'s poll must count
         # them or a drain landing in that window truncates an accepted
@@ -209,10 +227,22 @@ class ContinuousBatcher:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         item = _InFlight(req, fut, time.monotonic())
         budget = len(req.prompt) + (
-            req.max_new_tokens if req.kind == "generate" else 0
+            req.max_new_tokens
+            if req.kind in ("generate", "resume") else 0
         )
-        if req.kind not in ("generate", "classify"):
+        if req.kind not in ("generate", "classify", "prefill", "resume"):
             fut.set_exception(ValueError(f"unknown kind {req.kind!r}"))
+            reg.counter("serving/rejected_total").inc()
+            return fut
+        if req.kind in ("prefill", "resume") and not getattr(
+            self.engine, "paged", False
+        ):
+            # The handoff verbs move KV as serialized pages — only the
+            # block-paged pool has a page to move.
+            fut.set_exception(ValueError(
+                "disaggregated prefill/decode requires the paged KV "
+                "pool (set kv_block_size)"
+            ))
             reg.counter("serving/rejected_total").inc()
             return fut
         if not req.prompt or budget > self.engine.model_cfg.max_len:
@@ -233,6 +263,23 @@ class ContinuousBatcher:
             )
             reg.counter("serving/rejected_total").inc()
             return fut
+        if req.kind == "resume":
+            if not isinstance(req.pages, dict):
+                fut.set_exception(ValueError(
+                    "resume requires the prefill replica's 'pages' "
+                    "payload"
+                ))
+                reg.counter("serving/rejected_total").inc()
+                return fut
+            ft = req.first_token
+            if not isinstance(ft, int) or isinstance(ft, bool) \
+                    or not 0 <= ft < vocab:
+                fut.set_exception(ValueError(
+                    f"resume 'first_token' must be a token id in "
+                    f"[0, {vocab})"
+                ))
+                reg.counter("serving/rejected_total").inc()
+                return fut
         try:
             self._q.put_nowait(item)
         except queue.Full:
@@ -280,7 +327,8 @@ class ContinuousBatcher:
 
             def busy():
                 return bool(
-                    self._active or self._staged or not self._q.empty()
+                    self._active or self._staged or self._prefilling
+                    or not self._q.empty()
                 )
 
             while (
@@ -316,6 +364,9 @@ class ContinuousBatcher:
             except queue.Empty:
                 break
             item.future.set_exception(exc)
+        for item, _ in list(self._prefilling.values()):
+            self._prefilling.pop(item.slot, None)
+            self._retire(item, truncated="shutdown")
         for item in list(self._active.values()):
             self._retire(item, truncated="shutdown")
 
@@ -352,6 +403,12 @@ class ContinuousBatcher:
                             self._fail_active(e)
                     finally:
                         self._staged -= 1
+            if self._prefilling:
+                # ONE chunk per loop iteration (oldest admission
+                # first): the decode step below runs between chunks,
+                # which is the whole TTFT-vs-TPOT admission bargain.
+                self._wd("serve_prefill")
+                self._chunk_step()
             if not self._active:
                 continue
             self._wd("serve_decode")
@@ -469,11 +526,11 @@ class ContinuousBatcher:
         ``max_delay_s`` window so a burst prefills together. Busy:
         drain whatever is queued into the free slots, no waiting."""
         free = min(
-            self.max_batch - len(self._active),
+            self.max_batch - len(self._active) - len(self._prefilling),
             self.engine.pool.num_slots - self.engine.pool.active_slots,
         )
         staged: list[_InFlight] = []
-        if not self._active:
+        if not self._active and not self._prefilling:
             self._wd("serve_idle")
             try:
                 self._take(staged, timeout=0.05)
@@ -499,8 +556,16 @@ class ContinuousBatcher:
         return staged
 
     def _fail_active(self, exc: Exception) -> None:
-        """Fail and free every in-flight request (a step error lost or
-        poisoned the shared device state; next admissions start clean)."""
+        """Fail and free every in-flight request — decoding AND
+        mid-chunked-prefill, whose written blocks died with the same
+        donated device state (a step error lost or poisoned it; next
+        admissions start clean)."""
+        for it, _ in list(self._prefilling.values()):
+            del self._prefilling[it.slot]
+            self.engine.pool.free(it.slot)
+            it.slot = None
+            if not it.future.done():
+                it.future.set_exception(exc)
         for it in list(self._active.values()):
             del self._active[it.slot]
             self.engine.pool.free(it.slot)
@@ -544,6 +609,53 @@ class ContinuousBatcher:
         item.t_admit = now
         reg.histogram("serving/queue_wait").record(now - item.t_submit)
         req = item.req
+        if req.kind == "resume":
+            # Disaggregated decode (ISSUE 12): no prefill — map the
+            # handed-off KV pages in and continue the stream from the
+            # prefill replica's first token.
+            with span("serve_resume", tokens=len(req.prompt)):
+                self.engine.import_kv_pages(slot, req.pages, req.prompt)
+            item.t_first = time.monotonic()
+            reg.histogram("serving/ttft").record(
+                item.t_first - item.t_submit
+            )
+            item.tokens.append(req.first_token)
+            item.last_token = req.first_token
+            if self._draft is not None:
+                self._draft.begin(
+                    slot, list(req.prompt) + [req.first_token]
+                )
+            self._active[slot] = item
+            self._maybe_finish(item)
+            return
+        open_chunked = getattr(self.engine, "prefill_open", None)
+        if callable(open_chunked):
+            state = open_chunked(
+                slot, req.prompt, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+            )
+            if state is not None and len(state.spans) == 1:
+                # The COLD TAIL fits one chunk (a mostly-cached long
+                # prompt): run it inline — the documented chunking
+                # semantics key on the cold tail, and queueing this
+                # effectively-warm request behind an older 16k chunked
+                # prefill would stall its TTFT for nothing.
+                t0 = time.perf_counter()
+                with span("serve_prefill", tokens=len(req.prompt)):
+                    _, first, last_logits = self.engine.prefill_step(
+                        state
+                    )
+                reg.histogram("serving/prefill").record(
+                    time.perf_counter() - t0
+                )
+                self._finish_prefill(item, first, last_logits)
+                return
+            if state is not None:
+                # Chunked admission: the slot's blocks are claimed; the
+                # loop runs one chunk per iteration from here on and
+                # _finish_prefill fires on the final one.
+                self._prefilling[slot] = (item, state)
+                return
         t0 = time.perf_counter()
         with span("serve_prefill", tokens=len(req.prompt)):
             first, last_logits = self.engine.prefill(
@@ -551,6 +663,16 @@ class ContinuousBatcher:
                 temperature=req.temperature, top_k=req.top_k,
             )
         reg.histogram("serving/prefill").record(time.perf_counter() - t0)
+        self._finish_prefill(item, first, last_logits)
+
+    def _finish_prefill(self, item: _InFlight, first: int,
+                        last_logits) -> None:
+        """Shared tail of single-shot and chunked prefill: record TTFT
+        and route the request by kind (classify resolves the logits
+        head, prefill exports the KV pages, generate enters the decode
+        set)."""
+        reg = self.registry
+        req, slot = item.req, item.slot
         item.t_first = time.monotonic()
         reg.histogram("serving/ttft").record(item.t_first - item.t_submit)
         if req.kind == "classify":
@@ -566,6 +688,22 @@ class ContinuousBatcher:
                 ),
             )
             return
+        if req.kind == "prefill":
+            # Disaggregated prefill (ISSUE 12): the work product is the
+            # slot's KV pages, not a decode stream — export, free, and
+            # hand the payload (plus the first sampled token) back for
+            # the router to ship to a decode replica.
+            pages = self.engine.export_kv_pages(slot, req.prompt)
+            self.engine.pool.free(slot)
+            item.slot = None
+            self._resolve(
+                item,
+                Result(
+                    tokens=[first], prompt_len=len(req.prompt),
+                    pages=pages,
+                ),
+            )
+            return
         item.tokens.append(first)
         item.last_token = first
         if self._draft is not None:
@@ -573,6 +711,55 @@ class ContinuousBatcher:
             self._draft.begin(slot, list(req.prompt) + [first])
         self._active[slot] = item
         self._maybe_finish(item)
+
+    def _chunk_step(self) -> None:
+        """Run ONE chunk of the oldest in-flight chunked prefill; on
+        the final chunk the request joins the decode set exactly as a
+        single-shot admission would (token-identical: the final chunk's
+        sampling key is the unchunked prefill's)."""
+        reg = self.registry
+        slot = next(iter(self._prefilling))
+        item, state = self._prefilling[slot]
+        if item.deadline is not None and time.monotonic() > item.deadline:
+            # A dead-on-arrival stream must not keep stalling everyone
+            # else's decode steps for its remaining chunks — abandon it
+            # now, exactly like the queued-deadline expiry (504).
+            del self._prefilling[slot]
+            self.engine.pool.free(slot)
+            item.slot = None
+            reg.counter("serving/expired_total").inc()
+            if not item.future.done():
+                item.future.set_exception(DeadlineExceeded(
+                    f"deadline ({item.req.deadline_s:.3f}s) passed "
+                    "mid-chunked-prefill"
+                ))
+            return
+        try:
+            with span("serve_prefill_chunk"):
+                done, first, last_logits = self.engine.prefill_step(state)
+        except Exception as e:  # noqa: BLE001 — one bad chunk must not
+            # take the serve loop down
+            log.exception("prefill chunk failed; failing request")
+            self._prefilling.pop(slot, None)
+            self.engine.pool.free(slot)
+            item.slot = None
+            if not item.future.done():
+                item.future.set_exception(e)
+            reg.counter("serving/errors_total").inc()
+            if isinstance(e, EngineStepError):
+                self._fail_active(e)
+            return
+        if not done:
+            return
+        del self._prefilling[slot]
+        # Chunked prefill wall = admission to final chunk (decode steps
+        # interleave inside it — that is the point, and what an
+        # operator reading serving/prefill for a chunked request should
+        # see).
+        reg.histogram("serving/prefill").record(
+            time.monotonic() - item.t_admit
+        )
+        self._finish_prefill(item, first, last_logits)
 
     # ----------------------------------------------------------- retire
 
@@ -622,9 +809,15 @@ class ContinuousBatcher:
         reg = self.registry
         reg.histogram("serving/e2e").record(result.total_s)
         reg.counter("serving/completed_total").inc()
-        reg.counter("serving/generated_tokens_total").inc(
-            len(result.tokens)
+        # Handoff accounting: the DELIVERING replica owns the whole
+        # stream (resume counts the first token too), the prefill leg
+        # counts zero — so fleet-summed generated_tokens stays exact
+        # whether a handoff completes or falls back to the full path
+        # after a successful prefill leg.
+        generated = 0 if item.req.kind == "prefill" else len(
+            result.tokens
         )
+        reg.counter("serving/generated_tokens_total").inc(generated)
         if not item.future.set_running_or_notify_cancel():
             return  # caller gave up; nothing to deliver
         item.future.set_result(result)
@@ -655,7 +848,9 @@ class ContinuousBatcher:
                 derived[f"{name}_p50"] = h["p50"]
                 derived[f"{name}_p95"] = h["p95"]
         serving = {
-            "active_requests": len(self._active),
+            # Chunk-prefilling requests count as active: they hold a
+            # slot and stall one chunk per loop iteration.
+            "active_requests": len(self._active) + len(self._prefilling),
             "queue_depth": self._q.qsize(),
             "slots": self.engine.pool.num_slots,
             "kv_occupancy": self.engine.pool.occupancy,

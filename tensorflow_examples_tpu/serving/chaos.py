@@ -197,8 +197,9 @@ class ChaosFleet:
                 self.close()
                 raise e
         log.info(
-            "chaos fleet: %d replicas warm in %.1fs",
+            "chaos fleet: %d replicas warm in %.1fs (roles %s)",
             len(self.replicas), time.perf_counter() - t0,
+            self.role_census(),
         )
         self.router = Router(
             [r.url for r in self.replicas], cfg=self.router_cfg
@@ -211,6 +212,18 @@ class ChaosFleet:
     @property
     def urls(self) -> list:
         return [r.url for r in self.replicas]
+
+    def role_census(self) -> dict:
+        """{role: count} over the live replicas (ISSUE 12):
+        heterogeneous prefill/decode fleets are first-class chaos
+        subjects — the hetero golden asserts the topology it built."""
+        census: dict = {}
+        for rep in self.replicas:
+            role = "mixed"
+            if rep.engine is not None:
+                role = getattr(rep.engine.cfg, "role", "mixed")
+            census[role] = census.get(role, 0) + 1
+        return census
 
     def healthy_count(self) -> int:
         if self.router is None:

@@ -98,6 +98,28 @@ class ServeConfig:
     kv_dtype: str = ""           # "" -> cache_dtype | "int8" (per-block
     #                              scales, bounded-divergence mode)
     prefix_cache: bool = True    # reuse immutable full prompt blocks
+    # ---- cache-aware fleet scheduling (serving/scheduler.py; ISSUE 12) ----
+    role: str = "mixed"          # mixed | prefill | decode — the fleet
+    #                              scheduling role the replica publishes
+    #                              on /health. "mixed" (default) keeps
+    #                              every pre-ISSUE-12 behavior; prefill
+    #                              replicas run prompts to completion-of-
+    #                              prefill and export the KV pages,
+    #                              decode replicas import them and
+    #                              continue the stream. Advisory: any
+    #                              role still serves a full /generate
+    #                              (that is what makes role failover a
+    #                              plain in-flight failover).
+    prefill_chunk_tokens: int = 0  # >0: admission splits any cold
+    #                              prompt tail longer than this into
+    #                              block-aligned chunks run one per
+    #                              decode-loop iteration through the
+    #                              extend rungs, so a long prefill
+    #                              interleaves with decode steps
+    #                              instead of monopolizing them.
+    #                              Requires the paged pool with
+    #                              prefix_cache=True; must be a
+    #                              multiple of kv_block_size.
     # ---- continuous batcher (serving/batcher.py) ----
     max_batch: int = 0           # admission cap; 0 -> max_slots
     max_queue: int = 64          # bounded queue: beyond this, load-shed
@@ -588,6 +610,26 @@ class EngineStepError(RuntimeError):
     the whole active set, not just the request being stepped."""
 
 
+class ChunkedPrefill:
+    """In-progress chunked prefill (ISSUE 12): the slot's blocks are
+    already allocated (prefix reuse applied); ``spans`` are the
+    block-aligned chunk plan and ``idx`` the next chunk to run. The
+    batcher holds one of these per mid-prefill request and calls
+    ``engine.prefill_step`` once per decode-loop iteration."""
+
+    __slots__ = ("slot", "prompt", "spans", "idx", "seed",
+                 "temperature", "top_k")
+
+    def __init__(self, slot, prompt, spans, seed, temperature, top_k):
+        self.slot = slot
+        self.prompt = prompt
+        self.spans = spans
+        self.idx = 0
+        self.seed = seed
+        self.temperature = temperature
+        self.top_k = top_k
+
+
 class InferenceEngine:
     """Loads params once, owns the KV pool, runs the compiled steps.
 
@@ -682,6 +724,30 @@ class InferenceEngine:
                 "attention='paged_flash' is the fused paged-decode "
                 "kernel — it requires the paged pool (set kv_block_size)"
             )
+        if self.cfg.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"role={self.cfg.role!r} not in ('mixed', 'prefill', "
+                "'decode')"
+            )
+        if self.cfg.prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens={self.cfg.prefill_chunk_tokens} "
+                "must be >= 0"
+            )
+        if self.cfg.prefill_chunk_tokens:
+            if not self.paged or not self.cfg.prefix_cache:
+                raise ValueError(
+                    "prefill_chunk_tokens requires the paged pool with "
+                    "prefix_cache=True (the chunk program IS the "
+                    "per-tail-bucket extend rung)"
+                )
+            if self.cfg.prefill_chunk_tokens % self.cfg.kv_block_size:
+                raise ValueError(
+                    f"prefill_chunk_tokens="
+                    f"{self.cfg.prefill_chunk_tokens} must be a "
+                    f"multiple of kv_block_size={self.cfg.kv_block_size}"
+                    " (chunk boundaries scatter whole blocks)"
+                )
         if self.cfg.spec_decode_k < 0:
             raise ValueError(
                 f"spec_decode_k={self.cfg.spec_decode_k} must be >= 0"
@@ -1082,6 +1148,19 @@ class InferenceEngine:
                 f"{type(e).__name__}: {e}"
             ) from e
 
+    def _prefill_fault_tick(self, slot: int) -> None:
+        """Serve-side fault hook for PREFILL-role replicas (ISSUE 12):
+        a dedicated prefill replica's unit of work is the prefill, not
+        a decode step, so its fault schedule counts prefills — which is
+        what lets the chaos tier kill one deterministically
+        mid-handoff. Mixed/decode replicas keep the decode-step
+        counting every existing golden pins."""
+        if self.cfg.role != "prefill":
+            return
+        feng = faults_mod.serve_active()
+        if feng is not None:
+            feng.decode_step(self.replica_id, [slot])
+
     def prefill(self, slot: int, prompt: Sequence[int], *, seed: int = 0,
                 temperature: float = 0.0, top_k: int = 0):
         """Run a prompt into ``slot``; returns (first generated token,
@@ -1099,6 +1178,7 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt length {n} exceeds max_len {self.model_cfg.max_len}"
             )
+        self._prefill_fault_tick(slot)
         if self.paged:
             tok, last = self._paged_prefill(
                 slot, prompt, seed=seed, temperature=temperature,
@@ -1120,21 +1200,14 @@ class InferenceEngine:
         return int(tok), np.asarray(last)
 
     def _paged_prefill(self, slot, prompt, *, seed, temperature, top_k):
-        from tensorflow_examples_tpu.serving import paged_kv
-
         n = len(prompt)
         bs = self.cfg.kv_block_size
-        reused, ctx = self.pool.prefix_lookup(prompt)
-        if ctx and not self._extend_fns:  # prefix_cache=False never hits
-            self.pool.release_prefix(reused)
-            reused, ctx = [], 0
+        # A hit is only possible when the extend rungs exist to serve
+        # it: the pool's prefix cache and the engine's extend ladder
+        # are both keyed off cfg.prefix_cache, so claim_prompt_blocks
+        # returns ctx=0 exactly when there is no rung to run a tail on.
+        ctx, _ = self.pool.claim_prompt_blocks(slot, prompt)
         total_blocks = -(-n // bs)
-        try:
-            fresh = self.pool.alloc_blocks(total_blocks - len(reused))
-        except paged_kv.BlockExhausted:
-            self.pool.release_prefix(reused)
-            raise
-        self.pool.assign(slot, reused + fresh)
         key = request_key(seed, n)
         ftemp, ftk = jnp.float32(temperature), jnp.int32(top_k)
         if ctx == 0:
@@ -1173,6 +1246,229 @@ class InferenceEngine:
         self.pool.set_kv_state(kv)
         self.pool.insert_prefix(slot, prompt)
         return tok, last
+
+    # --------------------------------- chunked prefill (ISSUE 12 (b))
+
+    def prefill_open(self, slot: int, prompt: Sequence[int], *,
+                     seed: int = 0, temperature: float = 0.0,
+                     top_k: int = 0):
+        """Open a CHUNKED prefill when admission should split this
+        prompt (``prefill_chunk_tokens > 0`` and the cold portion
+        exceeds it); returns the :class:`ChunkedPrefill` state
+        ``prefill_step`` consumes, or None when the prompt needs no
+        chunking (the caller uses plain :meth:`prefill`). The slot's
+        blocks — reused prefix blocks first — are allocated here
+        all-or-nothing, so a ``BlockExhausted`` rejects the request
+        before any device work."""
+        chunk = self.cfg.prefill_chunk_tokens
+        n = len(prompt)
+        if chunk <= 0 or not self._extend_fns or n <= chunk:
+            return None
+        if n > self.model_cfg.max_len:
+            raise ValueError(
+                f"prompt length {n} exceeds max_len "
+                f"{self.model_cfg.max_len}"
+            )
+        self._prefill_fault_tick(slot)
+        from tensorflow_examples_tpu.serving import scheduler
+
+        bs = self.cfg.kv_block_size
+        ctx, _ = self.pool.claim_prompt_blocks(slot, prompt)
+        if ctx:
+            self.registry.counter("serving/prefix_reused_tokens").inc(ctx)
+        spans = scheduler.plan_chunks(n, ctx, chunk, bs)
+        if len(spans) > 1:
+            # Single-span plans (a mostly-cached prompt whose cold tail
+            # fits one chunk) are NOT chunked admissions — the batcher
+            # runs them inline, exactly like the plain prefix-hit path.
+            self.registry.counter("serving/chunked_prefills").inc()
+        return ChunkedPrefill(
+            slot, [int(t) for t in prompt], spans,
+            seed, temperature, top_k,
+        )
+
+    def prefill_step(self, state: ChunkedPrefill):
+        """Run ONE chunk of an open chunked prefill through the extend
+        rung (the chunk attends the already-written context blocks,
+        masked to the true covered length, plus itself causally).
+        Returns ``(done, first_token, last_logits)`` — the token/logits
+        are None until the final chunk, whose sampling key is
+        ``request_key(seed, n)``, exactly the unchunked prefill's, so
+        the chunked stream is token-identical to the single-shot one
+        (test-pinned)."""
+        bs = self.cfg.kv_block_size
+        slot, prompt = state.slot, state.prompt
+        start, end = state.spans[state.idx]
+        tail = end - start
+        tb = kv_mod.pick_bucket(self.prefill_ladder, tail)
+        first_block = start // bs
+        last_block = -(-end // bs)
+        tail_ids = np.zeros((tb // bs,), np.int32)
+        tail_ids[:last_block - first_block] = self.pool.block_tables[
+            slot, first_block:last_block
+        ]
+        tokens = np.zeros((1, tb), np.int32)
+        tokens[0, :tail] = prompt[start:end]
+        kv, tok, last = self._run_compiled(
+            "prefill", self._extend_fns[tb],
+            self.params, self.pool.kv_state(),
+            jnp.asarray(self.pool.block_tables[slot]),
+            jnp.asarray(tail_ids), jnp.asarray(tokens),
+            jnp.int32(start), jnp.int32(tail),
+            request_key(state.seed, end),
+            jnp.float32(state.temperature), jnp.int32(state.top_k),
+        )
+        self.pool.set_kv_state(kv)
+        state.idx += 1
+        self.registry.counter("serving/prefill_chunks").inc()
+        if state.idx < len(state.spans):
+            return False, None, None
+        n = len(prompt)
+        self.pool.lengths[slot] = n
+        self.pool.insert_prefix(slot, prompt)
+        self.registry.counter("serving/prefill_tokens").inc(n)
+        return True, int(tok), np.asarray(last)
+
+    # ----------------------------------- KV page handoff (ISSUE 12 (c))
+
+    def export_kv_pages(self, slot: int, prompt: Sequence[int]) -> dict:
+        """Serialize the slot's finished prompt KV blocks as the
+        prefill->decode handoff payload (``scheduler.encode_pages``
+        wire format, int8 scales included). The prefill-role half of
+        disaggregated serving: the importer's decode continues with
+        numerically identical cache state, so the handed-off stream is
+        token-identical to a mixed replica serving the whole request."""
+        if not self.paged:
+            raise ValueError(
+                "KV page export requires the paged pool (set "
+                "kv_block_size)"
+            )
+        from tensorflow_examples_tpu.serving import scheduler
+
+        n = len(prompt)
+        bs = self.cfg.kv_block_size
+        nb = -(-n // bs)
+        idx = jnp.asarray(
+            [int(b) for b in self.pool.block_tables[slot, :nb]]
+        )
+        state = self.pool.kv_state()
+        arrays = {
+            "k": np.asarray(state[0][:, idx]),
+            "v": np.asarray(state[1][:, idx]),
+        }
+        if self.pool.quantized:
+            arrays["k_scale"] = np.asarray(state[2][:, idx])
+            arrays["v_scale"] = np.asarray(state[3][:, idx])
+        meta = dict(
+            block_size=bs,
+            num_layers=self.model_cfg.num_layers,
+            num_heads=self.model_cfg.num_heads,
+            head_dim=self.model_cfg.head_dim,
+            length=n,
+            kv_bits=self.pool.kv_bits,
+        )
+        self.registry.counter("serving/kv_pages_exported").inc(nb)
+        return scheduler.encode_pages(meta, arrays)
+
+    def import_kv_pages(self, slot: int, payload,
+                        prompt: Sequence[int]) -> None:
+        """Map a handed-off page payload into ``slot``: validate the
+        geometry against this replica's pool (mismatch is a loud
+        ValueError -> 400, never a silently wrong cache), claim the
+        blocks, scatter the host arrays in, set the slot's length, and
+        publish the prompt into the local prefix cache so later
+        shared-prefix traffic gains affinity here too. A
+        ``BlockExhausted`` propagates before any write (503 upstream)."""
+        if not self.paged:
+            raise ValueError(
+                "KV page import requires the paged pool (set "
+                "kv_block_size)"
+            )
+        from tensorflow_examples_tpu.serving import scheduler
+
+        meta, arrays = scheduler.decode_pages(payload)
+        expect = dict(
+            block_size=self.cfg.kv_block_size,
+            num_layers=self.model_cfg.num_layers,
+            num_heads=self.model_cfg.num_heads,
+            head_dim=self.model_cfg.head_dim,
+            kv_bits=self.pool.kv_bits,
+        )
+        for key, want in expect.items():
+            if meta[key] != want:
+                raise ValueError(
+                    f"pages geometry mismatch: {key}={meta[key]} but "
+                    f"this replica serves {key}={want}"
+                )
+        n = meta["length"]
+        if n != len(prompt):
+            raise ValueError(
+                f"pages cover {n} tokens but the prompt has "
+                f"{len(prompt)}"
+            )
+        if n > self.model_cfg.max_len:
+            raise ValueError(
+                f"pages length {n} exceeds max_len "
+                f"{self.model_cfg.max_len}"
+            )
+        bs = self.cfg.kv_block_size
+        nb = -(-n // bs)
+        shapes = {
+            "k": (meta["num_layers"], nb, meta["num_heads"], bs,
+                  meta["head_dim"]),
+            "v": (meta["num_layers"], nb, meta["num_heads"], bs,
+                  meta["head_dim"]),
+        }
+        if self.pool.quantized:
+            shapes["k_scale"] = shapes["k"][:-1]
+            shapes["v_scale"] = shapes["v"][:-1]
+        for name, want_shape in shapes.items():
+            arr = arrays.get(name)
+            if arr is None:
+                raise ValueError(f"pages payload is missing {name!r}")
+            if tuple(arr.shape) != want_shape:
+                raise ValueError(
+                    f"pages array {name!r} has shape "
+                    f"{tuple(arr.shape)}, expected {want_shape}"
+                )
+        state = list(self.pool.kv_state())
+        names = ("k", "v", "k_scale", "v_scale")[: len(state)]
+        for i, name in enumerate(names):
+            # The payload arrays carry the DONOR's cache dtype; a
+            # same-width mismatch (f16 pages into a bf16 pool) would
+            # value-cast every KV entry — exactly the silently-wrong
+            # cache the wire format promises cannot happen. kv_bits
+            # catches width; this catches kind.
+            want = jnp.dtype(state[i].dtype)
+            got = jnp.dtype(arrays[name].dtype)
+            if got != want:
+                raise ValueError(
+                    f"pages dtype mismatch: {name!r} is {got} but "
+                    f"this replica's cache stores {want}"
+                )
+        # Leading blocks this pool ALREADY caches (a previous handoff
+        # or local prefill of the same prefix) are mapped, not
+        # re-scattered: chained exact-token keys guarantee identical
+        # content, so repeated handoffs of a shared system prompt hold
+        # one copy and pay the device write only for the cold tail.
+        ctx, fresh = self.pool.claim_prompt_blocks(slot, prompt)
+        if fresh:
+            start = nb - len(fresh)
+            idx = jnp.asarray(fresh)
+            for i, name in enumerate(names):
+                state[i] = state[i].at[:, idx].set(
+                    jnp.asarray(arrays[name][:, start:])
+                )
+            self.pool.set_kv_state(tuple(state))
+        self.pool.lengths[slot] = n
+        self.pool.insert_prefix(slot, prompt)
+        self.registry.counter("serving/kv_pages_imported").inc(
+            len(fresh)
+        )
+        if ctx:
+            self.registry.counter(
+                "serving/prefix_reused_tokens"
+            ).inc(ctx)
 
     def decode(self, entries: Sequence[tuple[int, int, int, float, int]]):
         """One continuous-decode step. ``entries`` is the active set:

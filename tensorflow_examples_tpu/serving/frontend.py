@@ -17,6 +17,16 @@ Endpoints:
 * ``POST /classify`` — same request shape (no generation knobs);
   replies the top-n next-token distribution
   ``{"top": [{"token": id, "logprob": lp}, ...]}``.
+* ``POST /prefill`` / ``POST /resume`` — the disaggregated-role
+  handoff pair (ISSUE 12, paged pool only). ``/prefill`` runs the
+  prompt to completion-of-prefill and replies ``{"first_token": id,
+  "pages": {...}}`` (``serving/scheduler.py`` wire format, int8 scales
+  included); ``/resume`` takes the same generate body plus
+  ``pages``/``first_token`` and continues the decode stream —
+  token-identical to a mixed replica serving the whole request. The
+  router orchestrates the pair; roles are advisory, so every replica
+  still answers a full ``/generate`` (that is what makes role failover
+  a plain in-flight failover).
 * ``GET /metrics`` — the registry as Prometheus text
   (``telemetry.serve.render_prometheus``): the ``serving/*`` counters
   and gauges plus the latency summaries — ``serving_queue_wait``,
@@ -75,6 +85,14 @@ from tensorflow_examples_tpu.train.resilience import PreemptionGuard
 log = logging.getLogger(__name__)
 
 _MAX_BODY = 1 << 20  # 1 MiB of JSON is already a pathological prompt
+# The /resume body carries a whole prompt's serialized KV pages —
+# sized for the repo's own worst case, not a guess: gpt2 at fp32 is
+# 2 (k+v) * 12 layers * 12 heads * 64 head_dim * 4 B ~= 72 KiB per
+# token, so a max_len=1024 prompt serializes to ~75 MiB raw and
+# ~100 MiB after base64. A cap below that would 413 exactly the
+# long-prompt handoffs disaggregation exists for, silently degrading
+# every such request to double-prefill fallback.
+_MAX_RESUME_BODY = 256 << 20
 
 
 class _TrackingHTTPServer(http.server.ThreadingHTTPServer):
@@ -126,9 +144,22 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
         "prompt", "text", "max_new_tokens", "temperature", "top_k",
         "seed", "eos_id", "deadline_s", "top_n",
     }
+    if kind == "resume":
+        known |= {"pages", "first_token"}
     unknown = set(body) - known
     if unknown:
         raise ValueError(f"unknown fields: {sorted(unknown)}")
+    pages = first_token = None
+    if kind == "resume":
+        pages = body.get("pages")
+        if not isinstance(pages, dict):
+            raise ValueError("'pages' must be the prefill replica's "
+                             "page payload object")
+        first_token = body.get("first_token")
+        if not isinstance(first_token, int) or isinstance(
+            first_token, bool
+        ):
+            raise ValueError("'first_token' must be a token id")
 
     def number(name, default, cls=float, minimum=None, maximum=None):
         v = body.get(name, default)
@@ -157,6 +188,8 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
         deadline_s=number("deadline_s", None, float, 0.0),
         kind=kind,
         classify_top_n=number("top_n", 5, int, 1),
+        pages=pages,
+        first_token=first_token,
     )
 
 
@@ -238,6 +271,12 @@ class ServingFrontend:
         }
         if kind == "classify":
             reply["top"] = result.top
+        elif kind == "prefill":
+            # Disaggregated handoff (ISSUE 12): the product is the KV
+            # pages + the first sampled token, which the router ships
+            # to a decode replica's /resume.
+            reply["first_token"] = result.tokens[0]
+            reply["pages"] = result.pages
         else:
             reply["tokens"] = result.tokens
             if self.tokenizer is not None:
@@ -250,19 +289,35 @@ class ServingFrontend:
         body = {
             "ok": not batcher.draining,
             "draining": batcher.draining,
-            "active_requests": len(batcher._active),
+            # Mid-chunked-prefill requests ARE active load (each one
+            # stalls a chunk per decode-loop iteration) — the router's
+            # load score and the affinity guard must see them.
+            "active_requests": (
+                len(batcher._active) + len(batcher._prefilling)
+            ),
             "queue_depth": batcher._q.qsize(),
             "slots": engine.pool.num_slots,
             "kv_occupancy": engine.pool.occupancy,
             "post_warmup_recompiles": engine.post_warmup_recompiles(),
             "warmed": engine.warmed,
         }
+        body["role"] = getattr(engine.cfg, "role", "mixed")
         paged = getattr(engine.pool, "paged_stats", None)
         if callable(paged):
             stats = paged()
             body["kv_block_occupancy"] = stats["kv_block_occupancy"]
             body["kv_slot_occupancy"] = stats["kv_slot_occupancy"]
             body["prefix_hit_rate"] = stats["prefix_hit_rate"]
+        digest = getattr(engine.pool, "prefix_digest", None)
+        if callable(digest):
+            # The affinity summary (ISSUE 12): content chain keys of
+            # the cached prefix blocks — what the router's
+            # prefix-affinity dispatch matches prompts against.
+            d = digest()
+            body["prefix_block_size"] = engine.pool.block_size
+            body["prefix_blocks"] = d["blocks"]
+            body["prefix_chains"] = d["chains"]
+            body["prefix_digest"] = d["keys"]
         wd = batcher._watchdog
         if wd is not None:
             status = wd.status()
@@ -304,11 +359,17 @@ class ServingFrontend:
                     # a reset, exactly like a died-mid-request process.
                     self.close_connection = True
                     return
-                if path not in ("/generate", "/classify"):
+                if path not in ("/generate", "/classify", "/prefill",
+                                "/resume"):
                     self._send_json(
-                        404, {"error": "POST endpoints: /generate /classify"}
+                        404,
+                        {"error": "POST endpoints: /generate /classify "
+                                  "/prefill /resume"},
                     )
                     return
+                max_body = (
+                    _MAX_RESUME_BODY if path == "/resume" else _MAX_BODY
+                )
                 try:
                     try:
                         n = int(self.headers.get("Content-Length", 0))
@@ -319,9 +380,9 @@ class ServingFrontend:
                             400, {"error": "bad Content-Length header"}
                         )
                         return
-                    if n > _MAX_BODY:
+                    if n > max_body:
                         self._send_json(
-                            413, {"error": f"body exceeds {_MAX_BODY} bytes"}
+                            413, {"error": f"body exceeds {max_body} bytes"}
                         )
                         return
                     try:
@@ -369,7 +430,8 @@ class ServingFrontend:
                             404,
                             "text/plain; charset=utf-8",
                             b"GET: /metrics /health /window   "
-                            b"POST: /generate /classify\n",
+                            b"POST: /generate /classify /prefill "
+                            b"/resume\n",
                         )
                 except ConnectionError:
                     pass

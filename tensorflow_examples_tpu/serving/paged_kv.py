@@ -60,6 +60,7 @@ from collections import OrderedDict
 import jax.numpy as jnp
 import numpy as np
 
+from tensorflow_examples_tpu.serving import scheduler
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 
 log = logging.getLogger(__name__)
@@ -163,6 +164,13 @@ class PagedKVPool:
         # blocks ("evictable": published but unreferenced).
         self._cache: dict[tuple, int] = {}
         self._cache_key: dict[int, tuple] = {}
+        # Content chain digests (ISSUE 12): per published block, the
+        # replica- and restart-stable scheduler.chain_key of its whole
+        # token prefix (+ its chain depth). The /health prefix digest
+        # and the router's affinity score are built from these — never
+        # from physical ids, which are meaningless across replicas.
+        self._chain_hash: dict[int, str] = {}
+        self._chain_depth: dict[int, int] = {}
         self._evictable: OrderedDict[int, None] = OrderedDict()
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -215,6 +223,8 @@ class PagedKVPool:
         self._evictable.clear()
         self._cache.clear()
         self._cache_key.clear()
+        self._chain_hash.clear()
+        self._chain_depth.clear()
 
     # ------------------------------------------------------------- slots
 
@@ -313,6 +323,8 @@ class PagedKVPool:
             bid, _ = self._evictable.popitem(last=False)
             key = self._cache_key.pop(bid)
             del self._cache[key]
+            self._chain_hash.pop(bid, None)
+            self._chain_depth.pop(bid, None)
             return bid
         self._reg().counter("serving/kv_exhausted_total").inc()
         log.warning(
@@ -451,6 +463,25 @@ class PagedKVPool:
                 self._release_block_locked(bid)
             self._publish()
 
+    def claim_prompt_blocks(self, slot: int, prompt) -> tuple[int, list]:
+        """Claim and install ``slot``'s whole prompt table — longest
+        reusable cached prefix first (refcounts taken), fresh private
+        blocks for the rest — all-or-nothing: on :class:`BlockExhausted`
+        the reused refcounts are released and nothing is claimed.
+        Returns ``(ctx, fresh)``: the cached token count and the fresh
+        block ids (the table rows from ``ctx // block_size`` on). The
+        ONE home of the claim discipline — the prefill, chunked-prefill,
+        and page-import paths all route through it."""
+        total = -(-len(prompt) // self.block_size)
+        reused, ctx = self.prefix_lookup(prompt)
+        try:
+            fresh = self.alloc_blocks(total - len(reused))
+        except BlockExhausted:
+            self.release_prefix(reused)
+            raise
+        self.assign(slot, reused + fresh)
+        return ctx, fresh
+
     def insert_prefix(self, slot: int, prompt) -> None:
         """Publish the slot's FULL prompt blocks for reuse. Idempotent
         per chain link; a block already published under a different
@@ -461,9 +492,14 @@ class PagedKVPool:
         bs = self.block_size
         with self._lock:
             parent = -1
+            parent_hash = ""
             for i in range(len(prompt) // bs):
                 block = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
                 key = (parent, block)
+                # The content chain digest walks alongside the physical
+                # chain: same tokens -> same hash on every replica and
+                # across resets (the /health digest contract).
+                parent_hash = scheduler.chain_key(parent_hash, block)
                 existing = self._cache.get(key)
                 if existing is not None:
                     parent = existing
@@ -473,8 +509,38 @@ class PagedKVPool:
                     break
                 self._cache[key] = bid
                 self._cache_key[bid] = key
+                self._chain_hash[bid] = parent_hash
+                self._chain_depth[bid] = i + 1
                 parent = bid
             self._publish()
+
+    def _chains_locked(self) -> int:
+        """Distinct chain HEADS — root blocks (parent -1) of the
+        published chains, i.e. how many distinct prompts' first blocks
+        this cache holds (caller holds the lock)."""
+        return sum(1 for key in self._cache if key[0] == -1)
+
+    def prefix_digest(self, max_keys: int = scheduler.DIGEST_MAX_KEYS
+                      ) -> dict:
+        """The replica's published prefix summary (ISSUE 12): the
+        content chain keys of every cached block (shallowest first,
+        capped at ``max_keys`` — shared system prompts are the
+        shallowest links, so the cap sheds the least-routable tails
+        first), plus ``blocks`` (published block count) and ``chains``
+        (distinct chain heads). Keys are pure functions of token
+        content, so the digest is stable across ``reset()`` and replica
+        restarts — the property the router's affinity match relies on
+        (test-pinned)."""
+        with self._lock:
+            items = sorted(
+                self._chain_hash.items(),
+                key=lambda kv: (self._chain_depth[kv[0]], kv[1]),
+            )
+            return {
+                "keys": [h for _, h in items[:max_keys]],
+                "blocks": len(self._cache),
+                "chains": self._chains_locked(),
+            }
 
     # -------------------------------------------------- byte accounting
 
@@ -508,6 +574,8 @@ class PagedKVPool:
             used = int((self._refcount > 0).sum())
             usable = self.num_blocks - 1
             hits, misses = self.prefix_hits, self.prefix_misses
+            chains = self._chains_locked()
+            published = len(self._cache)
         looked = hits + misses
         return {
             "block_size": self.block_size,
@@ -521,4 +589,8 @@ class PagedKVPool:
             "prefix_misses": misses,
             "prefix_hit_rate": (hits / looked) if looked else 0.0,
             "kv_bits": self.kv_bits,
+            # Schema v9 (ISSUE 12): the affinity digest's size — what
+            # the router's /replicas summary aggregates fleet-wide.
+            "prefix_blocks": published,
+            "prefix_chains": chains,
         }

@@ -13,6 +13,21 @@ tier, deliberately stdlib-only like every HTTP surface in the repo:
   pool's ``kv_occupancy`` is used-block fraction, so short-prompt
   replicas correctly read as roomy — the ISSUE 8 gauge-semantics fix
   is what makes this signal honest), ties broken by fewest dispatches.
+* **Prefix-affinity dispatch** (ISSUE 12, ``prefix_affinity`` on by
+  default) — paged replicas also publish a prefix digest (content
+  chain keys of their cached blocks, ``serving/scheduler.py``); the
+  router hashes the prompt's block-aligned prefix chain and prefers
+  the replica already holding the longest cached chain
+  (``router/affinity_hits_total``), load-guarded by
+  ``affinity_load_gap`` so affinity never starves a hot replica.
+* **Disaggregated roles** (ISSUE 12) — replicas publish a ``role``
+  (``mixed`` | ``prefill`` | ``decode``); when the fleet has both
+  specialist roles, generate traffic routes prefill-leg ->
+  KV-page handoff -> decode-leg (``/prefill`` -> ``/resume``,
+  ``router/handoffs_total``), falling back to the full path on any
+  leg failure (``router/handoff_fallbacks_total``) — roles are
+  advisory, every replica still serves a full ``/generate``, so a
+  dead role-holder is an ordinary in-flight failover.
 * **Drain-aware rollout** — ``drain(url)`` (or ``POST /drain``) stops
   NEW dispatch to a replica while its in-flight requests finish on the
   replica itself; a replica that starts draining on its own (SIGTERM —
@@ -111,6 +126,15 @@ class RouterConfig:
     unhealthy_after: int = 3        # consecutive probe failures
     canary_fraction: float = 0.25   # traffic share when a canary set
     #                                 is configured
+    prefix_affinity: bool = True    # ISSUE 12: prefer the replica
+    #                                 already holding the longest cached
+    #                                 chain of this prompt's blocks
+    #                                 (probe-published prefix digests)
+    affinity_load_gap: float = 2.0  # affinity never starves a hot
+    #                                 replica: a cached-chain holder is
+    #                                 only preferred while its load
+    #                                 score is within this gap of the
+    #                                 least-loaded eligible replica
 
 
 def _as_object(status: int, body) -> tuple[int, dict]:
@@ -183,6 +207,15 @@ class ReplicaState:
         self.dispatched = 0
         self.completed = 0
         self.errors = 0
+        # Cache-aware scheduling state (ISSUE 12), probe-sourced: the
+        # replica's role (mixed serves everything — the pre-ISSUE-12
+        # behavior), its prefix-cache block size, and the content chain
+        # keys of the blocks it currently caches (the affinity digest).
+        self.role = "mixed"
+        self.block_size = 0
+        self.prefix_digest: frozenset = frozenset()
+        self.prefix_blocks = 0
+        self.prefix_chains = 0
         # Circuit breaker (ISSUE 10). States: "closed" (normal),
         # "open" (ejected — no dispatch until the cooldown expires),
         # "half_open" (cooldown expired — exactly ONE trial in flight
@@ -222,10 +255,21 @@ class ReplicaState:
         pressure (used-block fraction under paging) breaks near-ties."""
         return float(self.queue_depth) + float(self.kv_occupancy)
 
+    def serves(self, role: str | None) -> bool:
+        """Role capability filter: a ``mixed`` replica serves every
+        leg; ``prefill``/``decode`` replicas serve their own leg.
+        ``role=None`` (a full /generate) matches everyone — roles are
+        a dispatch preference, not a capability wall, which is what
+        makes killing a role-holder an ordinary failover."""
+        return role is None or self.role in (role, "mixed")
+
     def snapshot(self) -> dict:
         return {
             "url": self.url,
             "set": self.set_name,
+            "role": self.role,
+            "prefix_blocks": self.prefix_blocks,
+            "prefix_chains": self.prefix_chains,
             "drained": self.drained,
             "draining_remote": self.draining_remote,
             "quarantined": self.quarantined,
@@ -379,10 +423,27 @@ class Router:
                     v = body.get(field)
                     if isinstance(v, (int, float)):
                         setattr(r, field, float(v))
-                for field in ("slots", "post_warmup_recompiles"):
+                for field in ("slots", "post_warmup_recompiles",
+                              "prefix_blocks", "prefix_chains"):
                     v = body.get(field)
                     if isinstance(v, (int, float)):
                         setattr(r, field, int(v))
+                # Cache-aware scheduling fields (ISSUE 12) — absent on
+                # dense-pool or pre-ISSUE-12 replicas, which simply
+                # never win an affinity preference.
+                role = body.get("role")
+                if isinstance(role, str) and role in (
+                    "mixed", "prefill", "decode"
+                ):
+                    r.role = role
+                bs = body.get("prefix_block_size")
+                if isinstance(bs, (int, float)) and int(bs) > 0:
+                    r.block_size = int(bs)
+                digest = body.get("prefix_digest")
+                if isinstance(digest, list):
+                    r.prefix_digest = frozenset(
+                        k for k in digest if isinstance(k, str)
+                    )
                 # Half-open probe -> readmit (ISSUE 10): once the
                 # breaker's cooldown has expired, a green /health is
                 # the trial — the replica rejoins dispatch without
@@ -487,12 +548,22 @@ class Router:
     # --------------------------------------------------------- dispatch
 
     def pick(self, *, set_name: str | None = None,
-             exclude: tuple = ()) -> ReplicaState | None:
+             exclude: tuple = (), prompt=None,
+             role: str | None = None,
+             key_cache: dict | None = None) -> ReplicaState | None:
         """Least-loaded eligible replica (of ``set_name`` when the
         canary split is routing), ties broken by fewest dispatches. A
         half-open replica may be picked for exactly one trial request
         at a time (the dispatch outcome closes or re-opens its
-        breaker)."""
+        breaker).
+
+        ISSUE 12: with ``prompt`` (token ids) and ``prefix_affinity``
+        on, the replica already holding the longest cached chain of the
+        prompt's block-aligned prefix wins — but only while its load
+        score stays within ``affinity_load_gap`` of the least-loaded
+        candidate, so affinity can never starve a hot replica.
+        ``role`` narrows the pool to replicas serving that leg
+        (mixed always qualifies)."""
         with self._lock:
             now = time.monotonic()
             pool = []
@@ -502,17 +573,51 @@ class Router:
                     r.eligible(self.cfg.unhealthy_after, now)
                     and r not in exclude
                     and (set_name is None or r.set_name == set_name)
+                    and r.serves(role)
                 ):
                     pool.append(r)
             if not pool:
                 return None
-            best = min(
-                pool, key=lambda r: (r.load_score(), r.dispatched)
-            )
+            best = self._pick_locked(pool, prompt, key_cache)
             best.dispatched += 1
             if best.breaker == "half_open":
                 best.half_open_trial = True
             return best
+
+    def _pick_locked(self, pool: list, prompt,
+                     key_cache: dict | None = None) -> ReplicaState:
+        """Affinity-then-load choice over an eligible pool (caller
+        holds the lock). ``key_cache`` ({block_size: chain keys},
+        request-scoped when handle() passes one) keeps the prompt
+        hashed at most once per block size per REQUEST — not per pick,
+        retry, leg, and fallback."""
+        least = min(pool, key=lambda r: (r.load_score(), r.dispatched))
+        if not self.cfg.prefix_affinity or not prompt:
+            return least
+        from tensorflow_examples_tpu.serving import scheduler
+
+        keys_by_bs = key_cache if key_cache is not None else {}
+        best, best_aff = least, 0
+        cap = least.load_score() + self.cfg.affinity_load_gap
+        for r in pool:
+            if not r.prefix_digest or r.block_size < 1:
+                continue
+            if r.load_score() > cap:
+                continue  # affinity must not starve a hot replica
+            keys = keys_by_bs.get(r.block_size)
+            if keys is None:
+                keys = scheduler.prompt_chain_keys(prompt, r.block_size)
+                keys_by_bs[r.block_size] = keys
+            aff = scheduler.affinity_blocks(keys, r.prefix_digest)
+            if aff > best_aff or (
+                aff == best_aff and aff > 0
+                and (r.load_score(), r.dispatched)
+                < (best.load_score(), best.dispatched)
+            ):
+                best, best_aff = r, aff
+        if best_aff > 0:
+            self.registry.counter("router/affinity_hits_total").inc()
+        return best
 
     def _route_set(self) -> str | None:
         """Which set this request goes to (None = no split): the canary
@@ -667,29 +772,174 @@ class Router:
                 first_failure = (status, reply)
         return first_failure
 
+    # ------------------------------------- disaggregated roles (ISSUE 12)
+
+    @staticmethod
+    def _clean_prompt(body: dict):
+        """The request's token ids when hashable for affinity/handoff
+        (a 'text' body has no ids until a replica tokenizes it)."""
+        prompt = body.get("prompt")
+        if (
+            isinstance(prompt, list) and prompt
+            and all(isinstance(t, int) and not isinstance(t, bool)
+                    for t in prompt)
+        ):
+            return prompt
+        return None
+
+    def _disagg_ready(self) -> bool:
+        """True when the fleet has BOTH an eligible prefill-role and an
+        eligible decode-role replica — the topology the handoff path
+        exists for. A dead prefill replica flips this off, and generate
+        traffic falls back to the full path on whoever is left."""
+        now = time.monotonic()
+        with self._lock:
+            roles = {
+                r.role for r in self.replicas
+                if r.eligible(self.cfg.unhealthy_after, now)
+            }
+        return "prefill" in roles and "decode" in roles
+
+    def _leg(self, body: dict, kind: str, role: str | None,
+             prompt, key_cache: dict | None = None) -> dict | None:
+        """One handoff leg with the same bounded-retry discipline as
+        the full path (different replica per attempt, leg-scoped wall
+        budget); None when the leg cannot complete — the caller falls
+        back to a full /generate, which is always safe because
+        generation is a pure function of (params, prompt, seed).
+
+        Deliberately SIMPLER than handle()'s loop: no cross-set
+        fallback and no wait-out-and-rescan on an empty pool — a leg
+        that cannot find a role-holder right now should not burn the
+        request's budget waiting for one, because the full path IS the
+        retry continuation and every replica can serve it."""
+        reg = self.registry
+        t0 = time.monotonic()
+        tried: list[ReplicaState] = []
+        attempts = 0
+        while True:
+            within = time.monotonic() - t0 < self.cfg.retry_budget_s
+            r = self.pick(
+                prompt=prompt, role=role, exclude=tuple(tried),
+                key_cache=key_cache,
+            )
+            if r is None:
+                return None
+            tried.append(r)
+            reg.counter("router/dispatched_total").inc()
+            status, reply = self._send_to(r, body, kind)
+            if status == 200:
+                return reply
+            if (
+                status in (0, 503)
+                and attempts < self.cfg.max_retries
+                and within
+            ):
+                attempts += 1
+                reg.counter("router/retries_total").inc()
+                if status == 0:
+                    # The role-holder died mid-leg: in-flight failover,
+                    # same accounting as the full path.
+                    reg.counter("router/failovers_total").inc()
+                backoff = self.cfg.retry_backoff_s * (2 ** (attempts - 1))
+                remaining = self.cfg.retry_budget_s - (
+                    time.monotonic() - t0
+                )
+                if backoff > 0 and remaining > 0:
+                    time.sleep(min(backoff, remaining))
+                continue
+            return None
+
+    def _handle_disagg(self, body: dict, prompt,
+                       key_cache: dict | None = None
+                       ) -> tuple[int, dict] | None:
+        """Prefill/decode handoff: run the prompt on a prefill-role
+        replica (affinity applies — that is where the prefix caches
+        live), ship the returned KV pages to a decode-role replica's
+        /resume, and reply its stream. Replica-measured ttft_s/total_s
+        both gain the prefill leg's wall so client-facing TPOT
+        ((total - ttft) / (n - 1)) stays a pure decode number. None on
+        any failure — the caller replays the request through the full
+        path (token-identical by seeding), so a dead role-holder costs
+        a failover, never a request."""
+        preply = self._leg(body, "prefill", "prefill", prompt, key_cache)
+        if (
+            not isinstance(preply, dict)
+            or not isinstance(preply.get("pages"), dict)
+            or not isinstance(preply.get("first_token"), int)
+        ):
+            return None
+        res_body = dict(body)
+        res_body["pages"] = preply["pages"]
+        res_body["first_token"] = preply["first_token"]
+        # The resume leg is affinity-routed too: importers publish the
+        # prompt into their own prefix cache, so repeated handoffs of a
+        # shared prompt park on the decode replica already holding it
+        # (one copy, cold-tail-only scatter) instead of spreading N
+        # copies across the decode tier.
+        dreply = self._leg(res_body, "resume", "decode", prompt,
+                           key_cache)
+        if not isinstance(dreply, dict):
+            return None
+        self.registry.counter("router/handoffs_total").inc()
+        pre_total = preply.get("total_s")
+        if isinstance(pre_total, (int, float)):
+            for key in ("ttft_s", "total_s"):
+                if isinstance(dreply.get(key), (int, float)):
+                    dreply[key] = dreply[key] + float(pre_total)
+        return 200, dreply
+
+    # ------------------------------------------------------ entry point
+
     def handle(self, body: dict, *, kind: str) -> tuple[int, dict]:
-        """Dispatch one generate/classify request: least-loaded pick,
-        bounded retry with backoff on 503/transport failure (different
-        replica of the same set, within the per-request wall budget).
-        A transport failure mid-request is an in-flight failover: the
-        re-dispatch replays the request from the prompt on another
-        replica, token-identical by the per-request seeding."""
+        """Dispatch one generate/classify request: least-loaded pick
+        with prefix affinity, bounded retry with backoff on
+        503/transport failure (different replica of the same set,
+        within the per-request wall budget). A transport failure
+        mid-request is an in-flight failover: the re-dispatch replays
+        the request from the prompt on another replica,
+        token-identical by the per-request seeding. On a fleet with
+        disaggregated roles, generate requests route through the
+        prefill->decode handoff first (canary split and hedging apply
+        to the full path only), falling back to the full path whenever
+        a leg cannot complete."""
         reg = self.registry
         reg.counter("router/requests_total").inc()
-        set_name = self._route_set()
         t0 = time.monotonic()
+        prompt = self._clean_prompt(body)
+        key_cache: dict = {}  # prompt chain keys, hashed once per request
+        if kind == "generate" and prompt is not None \
+                and self._disagg_ready():
+            out = self._handle_disagg(body, prompt, key_cache)
+            if out is not None:
+                status, reply = out
+                self._set_stats["base"].record(status, reply)
+                self.registry.histogram("router/e2e").record(
+                    time.monotonic() - t0
+                )
+                return status, reply
+            reg.counter("router/handoff_fallbacks_total").inc()
+        # The canary interleave slot is claimed only by requests that
+        # actually reach the full path — a completed handoff records
+        # under "base" without consuming one, so the canary set still
+        # receives its exact fraction of full-path traffic.
+        set_name = self._route_set()
         tried: list[ReplicaState] = []
         attempts = 0
         while True:
             within_budget = (
                 time.monotonic() - t0 < self.cfg.retry_budget_s
             )
-            r = self.pick(set_name=set_name, exclude=tuple(tried))
+            r = self.pick(
+                set_name=set_name, exclude=tuple(tried), prompt=prompt,
+                key_cache=key_cache,
+            )
             if r is None and tried and set_name is not None:
                 # The preferred set has no further replica: the retry
                 # may cross sets rather than fail the request (the
                 # canary compare just loses one sample).
-                r = self.pick(exclude=tuple(tried))
+                r = self.pick(exclude=tuple(tried), prompt=prompt,
+                              key_cache=key_cache)
             if r is None:
                 if (
                     tried
@@ -814,6 +1064,13 @@ class Router:
             ),
             "router_restarts": int(
                 counters.get("router/restarts_total", 0)
+            ),
+            # --- v9 (ISSUE 12): fleet-summed prefix-cache summary ---
+            "prefix_blocks": int(
+                sum(r.prefix_blocks for r in probed)
+            ),
+            "prefix_chains": int(
+                sum(r.prefix_chains for r in probed)
             ),
         }
         return {

@@ -1,0 +1,210 @@
+"""Cache-aware fleet scheduling primitives (ISSUE 12 tentpole).
+
+The fleet built in PRs 8–10 is fault-tolerant and fast per-replica but
+cache-blind: the prefix cache is per-replica, so N replicas hold N
+copies of every shared system prompt and a hit depends on luck of
+dispatch, while one long cold prefill monopolizes a replica's decode
+loop for every co-scheduled request. This module holds the three
+pure-function layers the scheduling tentpole composes — the router,
+batcher, and engine import from here so the wire format and the hash
+discipline have exactly one home:
+
+* **Prefix chain keys** — a content-addressed mirror of
+  ``paged_kv.py``'s chained prefix-cache keys. The pool's exact keys
+  chain ``(parent PHYSICAL block id, block tokens)`` — collision-free
+  on one replica, meaningless across replicas (physical ids are
+  replica-local). :func:`chain_key` replaces the physical parent with
+  the parent's own chain digest, so the key of block *i* is a pure
+  function of the first ``(i+1) * block_size`` prompt tokens: two
+  replicas that cached the same prefix publish the same keys, and the
+  router can measure "how much of THIS prompt does THAT replica
+  already hold" from a compact digest without shipping a single token.
+  Stability across ``reset()``/restart is by construction (no physical
+  id ever enters the hash) and test-pinned.
+* **Chunk planning** — :func:`plan_chunks` splits a cold prompt tail
+  into block-aligned spans of at most ``chunk_tokens`` each, the spans
+  the engine's per-tail-bucket extend rung (PR 8) runs one per decode-
+  loop iteration, so a long prefill interleaves with decode steps
+  instead of monopolizing them.
+* **KV page wire format** — :func:`encode_pages` / :func:`decode_pages`
+  serialize a finished prompt's KV blocks (int8-aware: blockwise scales
+  ride along) as a JSON-safe dict, the handoff payload a prefill-role
+  replica returns from ``POST /prefill`` and a decode-role replica
+  imports at ``POST /resume``. Geometry travels with the payload and is
+  validated on import — a page from a different model shape is a loud
+  400, never a silent garbage cache.
+
+Everything here is stdlib + numpy: no device, no sockets, no locks.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+
+import numpy as np
+
+ROLES = ("mixed", "prefill", "decode")
+
+# Wire-format version for the KV page payload (bumped on any layout
+# change; decode_pages rejects unknown versions loudly).
+PAGE_WIRE_VERSION = 1
+
+# Cap on the number of chain keys a replica publishes in its /health
+# digest — bounds the probe payload; shallow keys are kept first
+# because shared system prompts (the blocks worth routing for) are by
+# construction the shallowest links of every chain that reuses them.
+DIGEST_MAX_KEYS = 512
+
+
+# ---------------------------------------------------------- chain keys
+
+
+def chain_key(parent: str, block_tokens) -> str:
+    """Content chain digest of one full prefix block: a pure function
+    of (parent chain digest, the block's token ids). ``parent`` is ""
+    for the root block. 64-bit blake2b hex — replica- and
+    restart-stable, unlike the pool's physical-id chained keys."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent.encode("ascii"))
+    h.update(np.asarray(block_tokens, np.int64).tobytes())
+    return h.hexdigest()
+
+
+def prompt_chain_keys(prompt, block_size: int) -> list[str]:
+    """The chain keys of every REUSABLE full block of ``prompt`` —
+    capped at ``(len - 1) // block_size`` exactly like
+    ``paged_kv.prefix_lookup`` (at least one tail token always
+    prefills), so key ``i`` matching a replica's digest means that
+    replica can serve blocks ``[0, i]`` from cache."""
+    if block_size < 1:
+        raise ValueError(f"block_size={block_size} must be >= 1")
+    keys: list[str] = []
+    parent = ""
+    for i in range((len(prompt) - 1) // block_size):
+        parent = chain_key(
+            parent, prompt[i * block_size:(i + 1) * block_size]
+        )
+        keys.append(parent)
+    return keys
+
+
+def affinity_blocks(chain_keys: list[str], digest) -> int:
+    """How many leading blocks of a prompt (``chain_keys`` from
+    :func:`prompt_chain_keys`) a replica's published ``digest`` (a set
+    of chain keys) already holds — the router's affinity score. The
+    walk stops at the first miss: cached blocks are only mappable as a
+    chain from the root."""
+    n = 0
+    for key in chain_keys:
+        if key not in digest:
+            break
+        n += 1
+    return n
+
+
+# ------------------------------------------------------- chunk planning
+
+
+def plan_chunks(n: int, ctx: int, chunk_tokens: int,
+                block_size: int) -> list[tuple[int, int]]:
+    """Split the cold tail ``[ctx, n)`` of an ``n``-token prompt into
+    ``(start, end)`` spans of at most ``chunk_tokens`` each. Every
+    span start is block-aligned (the extend rung scatters whole
+    blocks; ``ctx`` is block-aligned by the prefix cache's contract
+    and ``chunk_tokens`` must be a block multiple); only the final
+    span's end may be ragged. One span per decode-loop iteration is
+    the admission discipline that bounds how long any chunk can stall
+    co-scheduled decode steps."""
+    if chunk_tokens < 1 or chunk_tokens % block_size:
+        raise ValueError(
+            f"chunk_tokens={chunk_tokens} must be a positive multiple "
+            f"of block_size={block_size}"
+        )
+    if ctx % block_size:
+        raise ValueError(f"ctx={ctx} is not block-aligned")
+    if not ctx <= n:
+        raise ValueError(f"ctx={ctx} exceeds prompt length {n}")
+    spans = []
+    start = ctx
+    while start < n:
+        end = min(start + chunk_tokens, n)
+        spans.append((start, end))
+        start = end
+    return spans
+
+
+# ----------------------------------------------------- KV page payload
+
+_PAGE_META = ("block_size", "num_layers", "num_heads", "head_dim",
+              "length", "kv_bits")
+
+
+def _b64(arr: np.ndarray) -> str:
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode(
+        "ascii"
+    )
+
+
+def encode_pages(meta: dict, arrays: dict) -> dict:
+    """Serialize a slot's finished KV blocks for the prefill->decode
+    handoff. ``arrays`` maps name -> numpy array (``k``/``v`` always,
+    ``k_scale``/``v_scale`` under int8); geometry rides in ``meta`` so
+    the importer can validate before touching its pool."""
+    missing = [k for k in _PAGE_META if k not in meta]
+    if missing:
+        raise ValueError(f"page meta missing {missing}")
+    payload = {"version": PAGE_WIRE_VERSION, **{k: int(meta[k]) for k in
+                                                _PAGE_META}}
+    payload["arrays"] = {
+        name: {
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "data": _b64(arr),
+        }
+        for name, arr in arrays.items()
+    }
+    return payload
+
+
+def decode_pages(payload) -> tuple[dict, dict]:
+    """Inverse of :func:`encode_pages`: ``(meta, arrays)``. Every
+    malformation — wrong version, missing geometry, torn base64, a
+    shape/bytes mismatch — raises ``ValueError`` with a client-facing
+    message (the frontend maps it to 400)."""
+    if not isinstance(payload, dict):
+        raise ValueError("pages payload must be a JSON object")
+    if payload.get("version") != PAGE_WIRE_VERSION:
+        raise ValueError(
+            f"unsupported pages wire version {payload.get('version')!r} "
+            f"(this replica speaks {PAGE_WIRE_VERSION})"
+        )
+    meta = {}
+    for key in _PAGE_META:
+        v = payload.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            raise ValueError(f"pages meta {key!r} = {v!r} is not a "
+                             "positive int")
+        meta[key] = v
+    raw = payload.get("arrays")
+    if not isinstance(raw, dict) or "k" not in raw or "v" not in raw:
+        raise ValueError("pages payload is missing the k/v arrays")
+    arrays = {}
+    for name, spec in raw.items():
+        if not isinstance(spec, dict):
+            raise ValueError(f"pages array {name!r} is not an object")
+        try:
+            dtype = np.dtype(spec["dtype"])
+            shape = tuple(int(d) for d in spec["shape"])
+            data = base64.b64decode(spec["data"], validate=True)
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed pages array {name!r}: {e}") \
+                from None
+        expect = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if len(data) != expect:
+            raise ValueError(
+                f"pages array {name!r}: {len(data)} bytes does not "
+                f"match shape {shape} of {dtype}"
+            )
+        arrays[name] = np.frombuffer(data, dtype).reshape(shape)
+    return meta, arrays

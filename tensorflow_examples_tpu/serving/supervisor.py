@@ -141,6 +141,11 @@ class Supervisor:
         # recovered N times must not be abandoned on failure N+1).
         self._attempts: dict[str, int] = {u: 0 for u in self.handles}
         self.given_up: set[str] = set()
+        # Last role each replica's /health reported (ISSUE 12):
+        # heterogeneous prefill/decode fleets are first-class, so an
+        # incident log must say WHICH role went down — a dead prefill
+        # replica stalls handoffs fleet-wide, not 1/N of traffic.
+        self.roles: dict[str, str] = {u: "mixed" for u in self.handles}
         # (url, event) rows: "detected" / "restarted" / "readmitted" /
         # "gave_up" — the chaos tier asserts the transition sequence.
         self.events: list[tuple[str, str]] = []
@@ -156,6 +161,8 @@ class Supervisor:
         )
         if status == 0:
             return False
+        if isinstance(body.get("role"), str):
+            self.roles[url] = body["role"]
         # Any well-formed HTTP answer means the process is responsive;
         # a 503 that is an orderly drain is NOT a stall (the replica is
         # finishing its work on purpose).
@@ -179,8 +186,9 @@ class Supervisor:
                 else f"/health stalled {stalled:.1f}s"
             )
             log.warning(
-                "SUPERVISOR: replica %s down (%s) — quarantining and "
-                "restarting", url, reason,
+                "SUPERVISOR: %s replica %s down (%s) — quarantining "
+                "and restarting", self.roles.get(url, "mixed"), url,
+                reason,
             )
             self.events.append((url, "detected"))
             self.router.quarantine(url)

@@ -4,7 +4,9 @@ paged-KV block/prefix-cache fields and router-tier fields on the
 ``serving`` object, see ``SERVING_KEYS_V6``; v7 in ISSUE 10 —
 fault-tolerance counters on the router's ``serving`` object, see
 ``SERVING_KEYS_V7``; v8 in ISSUE 11 — speculative-decoding measurement
-keys on the batcher's ``serving`` object, see ``SERVING_KEYS_V8``).
+keys on the batcher's ``serving`` object, see ``SERVING_KEYS_V8``; v9
+in ISSUE 12 — the prefix-cache summary behind cache-aware fleet
+scheduling, see ``SERVING_KEYS_V9``).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -124,9 +126,16 @@ SCHEMA_VERSION = 5
 # (accepted drafts / offered drafts) and accepted_per_step (mean
 # committed tokens per request verify step), all numeric; forbidden on
 # v4-v7 serving lines, same mislabeling rule as every earlier bump.
-SERVING_SCHEMA_VERSION = 8
+#
+# Version 9 (ISSUE 12): additive — a cache-aware serving line may
+# carry prefix_blocks (published prefix-cache blocks; the affinity
+# digest's size) and prefix_chains (distinct chain heads), both
+# numeric. The batcher stamps a paged replica's own counts; the router
+# stamps the probe-summed fleet totals. Forbidden on v4-v8 serving
+# lines, same mislabeling rule as every earlier bump.
+SERVING_SCHEMA_VERSION = 9
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -184,6 +193,12 @@ SERVING_KEYS_V7 = ("router_ejections", "router_readmits",
 # on write (a non-speculative line carries none), FORBIDDEN on v4-v7
 # serving lines.
 SERVING_KEYS_V8 = ("accepted_per_step", "draft_hit_rate", "spec_k")
+
+# v9-only serving-object keys (ISSUE 12): the prefix-cache summary
+# behind cache-aware fleet scheduling — published blocks (the affinity
+# digest's size) and distinct chain heads. Optional on write (a
+# dense-pool line carries neither), FORBIDDEN on v4-v8 serving lines.
+SERVING_KEYS_V9 = ("prefix_blocks", "prefix_chains")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -457,6 +472,13 @@ def validate_line(obj: Any) -> list[str]:
                     if key in obj["serving"]:
                         problems.append(
                             f"v8 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
+            if version < 9:
+                for key in SERVING_KEYS_V9:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v9 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
     elif "serving" in obj:

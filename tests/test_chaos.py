@@ -381,12 +381,14 @@ CHAOS_MODEL = dict(
 )
 
 
-def _real_engine_factory(spec_decode_k: int = 0):
+def _real_engine_factory(spec_decode_k: int = 0, role: str = "mixed"):
     """Tiny REAL paged engine for the golden: small enough that three
     warmups + one supervisor re-warm stay tier-1 friendly, real enough
     that the token-identity and zero-recompile claims mean something.
     ``spec_decode_k`` arms speculative decoding (ISSUE 11) — the chaos
-    contract must hold with the verify path on the hot loop too."""
+    contract must hold with the verify path on the hot loop too.
+    ``role`` builds the heterogeneous prefill/decode fleets of the
+    ISSUE 12 golden."""
     import jax
     import jax.numpy as jnp
 
@@ -405,7 +407,7 @@ def _real_engine_factory(spec_decode_k: int = 0):
         cfg=ServeConfig(
             max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=16,
             kv_block_size=8, max_delay_s=0.0, request_timeout_s=60.0,
-            spec_decode_k=spec_decode_k,
+            spec_decode_k=spec_decode_k, role=role,
         ),
         registry=MetricsRegistry(),
     )
@@ -413,6 +415,14 @@ def _real_engine_factory(spec_decode_k: int = 0):
 
 def _spec_engine_factory():
     return _real_engine_factory(spec_decode_k=2)
+
+
+def _prefill_engine_factory():
+    return _real_engine_factory(role="prefill")
+
+
+def _decode_engine_factory():
+    return _real_engine_factory(role="decode")
 
 
 class TestChaosGolden:
@@ -568,6 +578,113 @@ class TestChaosGolden:
             assert fleet.await_fleet_green(3, timeout_s=240)
             for rep in fleet.replicas:
                 assert rep.engine.post_warmup_recompiles() == 0
+        finally:
+            rfront.close()
+            fleet.close()
+
+    @pytest.mark.timeout(480)
+    def test_kill_prefill_replica_mid_handoff(self, serve_faults):
+        """ISSUE 12 acceptance: a HETEROGENEOUS fleet (1 prefill + 2
+        decode replicas) serves through the prefill->decode KV-page
+        handoff; killing the prefill replica mid-handoff (its fault
+        schedule counts prefills — the prefill-role unit of work)
+        yields ZERO failed requests: the router falls back to full
+        /generate on the decode replicas (roles are advisory, so the
+        failover is ordinary), every stream stays token-identical to
+        the unbatched reference, and the supervisor restores the
+        prefill replica — role preserved — without operator action."""
+        import serve_bench
+
+        fault_engine = serve_faults("crash@0:2")
+        fleet = ChaosFleet(
+            [_prefill_engine_factory, _decode_engine_factory,
+             _decode_engine_factory],
+            router_cfg=RouterConfig(
+                probe_interval_s=0.1, retry_budget_s=30.0,
+                max_retries=4, eject_after=1, eject_cooldown_s=1.0,
+            ),
+            supervisor_kw=dict(
+                poll_s=0.05, health_stall_s=3.0, warm_timeout_s=240.0,
+            ),
+        )
+        fleet.start()
+        assert fleet.role_census() == {"prefill": 1, "decode": 2}
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            # The probe sweep must learn the role topology before the
+            # first dispatch exercises the handoff path.
+            deadline = time.monotonic() + 30
+            while (
+                not fleet.router._disagg_ready()
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert fleet.router._disagg_ready()
+            n, max_new = 10, 5
+            prompts = serve_bench.make_prompts(
+                n, vocab=CHAOS_MODEL["vocab_size"],
+                max_len=CHAOS_MODEL["max_len"], max_new=max_new,
+                seed=29, shared_prefix_every=4,
+            )
+            out = serve_bench.drive(
+                None, prompts, concurrency=3, max_new=max_new,
+                temperature=0.7, top_k=0,
+                http_url=rfront.url("/generate"), timeout=60.0,
+            )
+            statuses = [
+                r[0] if r is not None else None for r in out["replies"]
+            ]
+            # ZERO failed requests across the prefill-replica kill.
+            assert statuses.count(200) == n, statuses
+            # The kill actually happened, mid-prefill on the prefill
+            # replica, and the router failed over.
+            assert ("crash", 0, 2) in fault_engine.fired
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/failovers_total", 0) >= 1
+            # Handoffs completed before the kill (the topology was
+            # exercised, not just built).
+            assert counters.get("router/handoffs_total", 0) >= 1
+            # Token-identical — handed-off, failed-over, and fallback
+            # full-path streams alike (pure function of params/prompt/
+            # seed).
+            ref_engine = fleet.replicas[1].engine
+            for i, prompt in enumerate(prompts):
+                expect = ref_engine.reference_generate(
+                    prompt, max_new=max_new, seed=i,
+                    temperature=0.7, top_k=0,
+                )
+                got = out["replies"][i][1]["tokens"]
+                assert got == expect, (
+                    f"request {i} diverged across the handoff kill: "
+                    f"{got} != {expect}"
+                )
+            # The supervisor restores the fleet — the restarted
+            # replica comes back with its PREFILL role.
+            assert fleet.await_fleet_green(3, timeout_s=240)
+            events = [
+                e for u, e in fleet.supervisor.events
+                if u == fleet.replicas[0].url
+            ]
+            assert events[:3] == ["detected", "restarted", "readmitted"]
+            assert fleet.role_census() == {"prefill": 1, "decode": 2}
+            for rep in fleet.replicas:
+                assert rep.engine.post_warmup_recompiles() == 0
+            # Post-restore, the handoff path serves again.
+            fleet.router.probe_once()
+            handoffs_before = counters.get("router/handoffs_total", 0)
+            status, reply = _post(
+                rfront.url("/generate"),
+                {"prompt": [11, 12, 13], "max_new_tokens": 3,
+                 "seed": 77},
+            )
+            assert status == 200
+            assert reply["tokens"] == ref_engine.reference_generate(
+                [11, 12, 13], max_new=3, seed=77
+            )
+            counters = fleet.router.registry.counter_values()
+            assert counters.get(
+                "router/handoffs_total", 0
+            ) > handoffs_before
         finally:
             rfront.close()
             fleet.close()

@@ -659,3 +659,42 @@ class TestRouterSchema:
         assert any(
             "v7 serving key" in p for p in schema.validate_line(v6)
         )
+
+    def test_v9_serving_keys_flagged_on_older_versions(self):
+        """ISSUE 12: the router's fleet-summed prefix summary is
+        v9-only — a 'v8' line carrying prefix_blocks is a mislabeled
+        v9 line, same rule as every earlier bump."""
+        r = Router(["http://a:1"])
+        rep = r.replicas[0]
+        rep.probed = True
+        rep.prefix_blocks, rep.prefix_chains = 5, 2
+        line = json.loads(json.dumps(r.stats_line()))
+        assert schema.validate_line(line) == []
+        assert line["serving"]["prefix_blocks"] == 5
+        assert line["serving"]["prefix_chains"] == 2
+        v8 = dict(line, schema_version=8)
+        assert any(
+            "v9 serving key" in p for p in schema.validate_line(v8)
+        )
+
+
+class TestRouterAffinityProbe:
+    @pytest.mark.timeout(120)
+    def test_probe_learns_role_and_digest_fields(self):
+        """The probe sweep parses the ISSUE 12 /health fields even from
+        a dense-pool replica (role only) and the /replicas snapshot
+        carries them."""
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe in replicas]
+        router = Router(urls, cfg=RouterConfig(probe_interval_s=60.0))
+        try:
+            router.probe_once()
+            rep = router.replicas[0]
+            assert rep.role == "mixed"  # ServeConfig default
+            assert rep.prefix_digest == frozenset()
+            snap = rep.snapshot()
+            assert snap["role"] == "mixed"
+            assert snap["prefix_blocks"] == 0
+        finally:
+            router.close()
+            _close(replicas)

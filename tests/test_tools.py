@@ -1230,6 +1230,62 @@ class TestBenchGate:
         ) == 1
         assert "[FAIL] tpot_speedup" in capsys.readouterr().out
 
+    def test_affinity_hit_rate_stamps_and_gates(self, tmp_path, capsys):
+        """ISSUE 12 satellite: the serve_affinity record's
+        with-affinity hit rate gates as a stamped MINIMUM — a scheduler
+        regression that quietly reverts the fleet to cache-blind
+        dispatch fails CI like any other perf loss."""
+        rec = {
+            "bench": "serve_affinity",
+            "prefix_hit_rate_affinity": 0.5,
+            "prefix_hit_rate_no_affinity": 0.33,
+            "affinity_hit_gain": 0.17,
+        }
+        good = tmp_path / "affinity.json"
+        good.write_text(json.dumps(rec))
+        floors = tmp_path / "affinity_floors.json"
+        assert self._gate(
+            ["--stamp", str(good), "--floors", str(floors)]
+        ) == 0
+        with open(floors) as f:
+            stamped = json.load(f)
+        assert stamped["prefix_hit_rate_affinity"] == {"min": 0.5}
+        assert self._gate(
+            ["--record", str(good), "--floors", str(floors)]
+        ) == 0
+        bad = tmp_path / "affinity_bad.json"
+        bad.write_text(
+            json.dumps(dict(rec, prefix_hit_rate_affinity=0.1))
+        )
+        assert self._gate(
+            ["--record", str(bad), "--floors", str(floors)]
+        ) == 1
+        assert "[FAIL] prefix_hit_rate_affinity" in capsys.readouterr().out
+
+    def test_affinity_keys_ranked_by_run_diff(self, tmp_path):
+        """ISSUE 12 satellite: the affinity keys land in run_diff's
+        DIFF_KEYS/GATE_KEYS — an affinity regression ranks and the
+        candidate's rate flattens for bench_gate --record."""
+        import run_diff
+
+        a = {"bench": "serve_affinity", "prefix_hit_rate_affinity": 0.5,
+             "affinity_hit_gain": 0.2}
+        b = {"bench": "serve_affinity", "prefix_hit_rate_affinity": 0.2,
+             "affinity_hit_gain": 0.0}
+        a_path, b_path = tmp_path / "a.json", tmp_path / "b.json"
+        a_path.write_text(json.dumps(a))
+        b_path.write_text(json.dumps(b))
+        out = tmp_path / "diff.json"
+        rc = run_diff.main(
+            [str(a_path), str(b_path), "--json", str(out)]
+        )
+        assert rc == 0
+        with open(out) as f:
+            doc = json.load(f)
+        ranked = {d["metric"]: d["verdict"] for d in doc["ranked"]}
+        assert ranked["prefix_hit_rate_affinity"] == "regressed"
+        assert doc["prefix_hit_rate_affinity"] == 0.2
+
     def test_floorless_report_lists_unbanked_gate_keys(
         self, tmp_path, capsys
     ):
@@ -1518,6 +1574,43 @@ class TestServeBench:
         assert rec["router_no_replica"] == 0
         for key in ("req_per_s", "tok_per_s", "ttft_p95_ms",
                     "tpot_p95_ms", "e2e_p95_ms"):
+            assert isinstance(rec[key], (int, float)) and rec[key] > 0
+
+    @pytest.mark.timeout(420)
+    def test_affinity_ab_smoke_banks_record(self, tmp_path):
+        """ISSUE 12 CI satellite: ``--smoke --router --affinity ab``
+        drives the SAME shared-prefix traffic through an affinity-off
+        fleet then an affinity-on one (deterministic sequential
+        dispatch with manual probes) and banks the ``serve_affinity``
+        record — the acceptance claim is prefix_hit_rate strictly
+        GREATER with affinity on, verified streams token-identical,
+        zero post-warmup recompiles across both fleets."""
+        import serve_bench
+
+        out = tmp_path / "affinity_record.json"
+        rc = serve_bench.main(
+            ["--smoke", "--router", "--affinity", "ab",
+             "--requests", "12", "--out", str(out)]
+        )
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        assert rec["bench"] == "serve_affinity"
+        assert rec["errors"] == 0 and rec["ok"] is True
+        # THE acceptance inequality, measured not sampled.
+        assert (
+            rec["prefix_hit_rate_affinity"]
+            > rec["prefix_hit_rate_no_affinity"]
+        )
+        assert rec["affinity_hit_gain"] > 0
+        assert rec["prefix_hits_on"] > rec["prefix_hits_off"]
+        # Affinity dispatch actually fired (the counter, not luck).
+        assert rec["affinity_dispatches"] >= 1
+        assert rec["post_warmup_recompiles"] == 0
+        assert rec["verified"] == 3 and rec["verify_ok"] is True
+        # The shared-vs-cold TTFT split is banked for the record.
+        for key in ("ttft_shared_p50_ms", "ttft_shared_p95_ms",
+                    "ttft_cold_p50_ms", "ttft_cold_p95_ms"):
             assert isinstance(rec[key], (int, float)) and rec[key] > 0
 
     @pytest.mark.timeout(420)

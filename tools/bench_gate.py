@@ -90,6 +90,11 @@ RECORD_KEYS: dict[str, str] = {
     # chaos-vs-baseline p95 ratio as a declared-multiple maximum.
     "error_rate": "max",
     "p95_vs_baseline": "max",
+    # Cache-aware scheduling (ISSUE 12): serve_bench --router
+    # --affinity ab banks the A/B record; the -affinity hit rate is
+    # the floor that catches a scheduler regression quietly reverting
+    # the fleet to cache-blind dispatch.
+    "prefix_hit_rate_affinity": "min",
     # Speculative decoding (ISSUE 11): serve_bench --spec-decode banks
     # the off/on TPOT ratio — the one number the tentpole claims. A
     # stamped floor pins it so a drafter/verify regression that quietly

@@ -81,6 +81,9 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("tpot_speedup", "higher", "x", 1.0),
     ("draft_hit_rate", "higher", "", 1.0),
     ("accepted_per_step", "higher", "", 1.0),
+    # ---- cache-aware scheduling records (ISSUE 12) ----
+    ("prefix_hit_rate_affinity", "higher", "", 1.0),
+    ("affinity_hit_gain", "higher", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -106,6 +109,8 @@ GATE_KEYS = (
     # speculative-decoding gate keys (ISSUE 11)
     "tpot_speedup",
     "draft_hit_rate",
+    # cache-aware scheduling gate keys (ISSUE 12)
+    "prefix_hit_rate_affinity",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

@@ -59,6 +59,17 @@ Modes:
   ``p95_vs_baseline`` as a max. ``--smoke --chaos`` is the tier-1
   chaos smoke.
 
+* ``--affinity {on,off,ab}`` (ISSUE 12, with ``--router``) — prefix-
+  affinity dispatch control. ``ab`` is the A/B mode: the SAME shared-
+  prefix-heavy prompt sequence through an affinity-off fleet then an
+  affinity-on one, driven sequentially with manual probe sweeps so
+  routing is deterministic, banking a ``serve_affinity`` record —
+  ``prefix_hit_rate_affinity`` strictly above
+  ``prefix_hit_rate_no_affinity`` is the acceptance inequality ``ok``
+  asserts, with the shared-vs-cold TTFT split and zero post-warmup
+  recompiles alongside. ``bench_gate`` pins the -affinity rate as a
+  stamped minimum.
+
 * ``--spec-decode K`` (ISSUE 11) — speculative-decoding A/B: the SAME
   prompt-like prompts (tiled motifs — the traffic speculation exists
   for) through two engines, speculation off then on at draft window K,
@@ -213,6 +224,46 @@ def make_prompts(n: int, *, vocab: int, max_len: int, max_new: int,
     return prompts
 
 
+def make_affinity_prompts(n: int, *, vocab: int, max_len: int,
+                          max_new: int, block: int = 16,
+                          seed: int = 0):
+    """The affinity A/B's traffic shape (ISSUE 12): two distinct
+    shared prefixes (block-aligned, so their chain keys are exactly
+    matchable) interleaved with cold prompts —
+    ``(prompts, groups)`` where groups[i] is "shared" or "cold". A
+    cache-BLIND router spreads each shared group over the fleet (every
+    replica pays its own cold prefill of the prefix); an affinity
+    router parks each group on the replica already holding its chain,
+    which is the measured hit-rate gap the record banks."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cap = max(block + 2, max_len - max_new)
+    pre_len = max(block, (cap * 2 // 3) // block * block)
+    pre_len = min(pre_len, (cap - 2) // block * block)
+    prefixes = {
+        "A": [int(t) for t in rng.integers(0, vocab, pre_len)],
+        "B": [int(t) for t in rng.integers(0, vocab, pre_len)],
+    }
+    prompts, groups = [], []
+    for i in range(n):
+        g = ("A", "B", "cold")[i % 3]
+        if g == "cold":
+            ln = int(rng.integers(2, cap + 1))
+            prompts.append(
+                [int(t) for t in rng.integers(0, vocab, ln)]
+            )
+            groups.append("cold")
+        else:
+            tail = 1 + int(rng.integers(0, max(1, cap - pre_len)))
+            prompts.append(
+                prefixes[g]
+                + [int(t) for t in rng.integers(0, vocab, tail)]
+            )
+            groups.append("shared")
+    return prompts, groups
+
+
 def _post_json(url: str, body: dict, timeout: float) -> tuple[int, dict]:
     # The serving stack's one JSON-over-HTTP client: transport-level
     # failures (URLError, reset, timeout, torn JSON body) come back as
@@ -340,34 +391,17 @@ def _pct_from_values(values, q):
     return round(float(np.percentile(vals, q)) * 1e3, 3)
 
 
-def run_router_bench(args) -> dict:
-    """Stand up --replicas in-proc serving stacks behind the router and
-    drive the tier end-to-end; returns the ``serve_router`` record."""
-    import jax
-
+def build_replica_stacks(args, serve_kw, n: int) -> list:
+    """``n`` warmed in-proc serving stacks — (engine, batcher,
+    frontend, registry) each on its own loopback port. Warmups run
+    concurrently: XLA compilation releases the GIL, so N replicas warm
+    in roughly one replica's wall time."""
     from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
     from tensorflow_examples_tpu.serving.engine import ServeConfig
     from tensorflow_examples_tpu.serving.frontend import ServingFrontend
-    from tensorflow_examples_tpu.serving.router import (
-        Router,
-        RouterConfig,
-        RouterFrontend,
-    )
     from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
 
-    kv_block = args.kv_block_size if args.kv_block_size >= 0 else 16
-    serve_kw = dict(
-        max_slots=args.max_slots,
-        max_delay_s=0.002,
-        request_timeout_s=args.timeout,
-        kv_block_size=kv_block,
-        kv_dtype=args.kv_dtype,
-    )
-    if args.smoke:
-        serve_kw.update(prefill_bucket_floor=16, kv_bucket_floor=32)
-
-    replicas: list = [None] * args.replicas
-    t0 = time.perf_counter()
+    replicas: list = [None] * n
 
     def build_one(k: int) -> None:
         reg = MetricsRegistry()
@@ -386,16 +420,41 @@ def run_router_bench(args) -> dict:
         frontend = ServingFrontend(batcher, port=0).start()
         replicas[k] = (engine, batcher, frontend, reg)
 
-    # Replica warmups run concurrently: XLA compilation releases the
-    # GIL, so N replicas warm in roughly one replica's wall time.
     warm_threads = [
         threading.Thread(target=build_one, args=(k,), daemon=True)
-        for k in range(args.replicas)
+        for k in range(n)
     ]
     for t in warm_threads:
         t.start()
     for t in warm_threads:
         t.join()
+    return replicas
+
+
+def run_router_bench(args) -> dict:
+    """Stand up --replicas in-proc serving stacks behind the router and
+    drive the tier end-to-end; returns the ``serve_router`` record."""
+    import jax
+
+    from tensorflow_examples_tpu.serving.router import (
+        Router,
+        RouterConfig,
+        RouterFrontend,
+    )
+
+    kv_block = args.kv_block_size if args.kv_block_size >= 0 else 16
+    serve_kw = dict(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        kv_block_size=kv_block,
+        kv_dtype=args.kv_dtype,
+    )
+    if args.smoke:
+        serve_kw.update(prefill_bucket_floor=16, kv_bucket_floor=32)
+
+    t0 = time.perf_counter()
+    replicas = build_replica_stacks(args, serve_kw, args.replicas)
     warmup_s = time.perf_counter() - t0
     print(
         f"# {args.replicas} replicas warm "
@@ -409,7 +468,8 @@ def run_router_bench(args) -> dict:
     router = Router(
         urls,
         cfg=RouterConfig(
-            probe_interval_s=0.2, request_timeout_s=args.timeout
+            probe_interval_s=0.2, request_timeout_s=args.timeout,
+            prefix_affinity=(args.affinity != "off"),
         ),
     ).start()
     rfront = RouterFrontend(router, port=0).start()
@@ -537,10 +597,213 @@ def run_router_bench(args) -> dict:
         "verified": min(verify, n),
         "verify_ok": verify_ok,
         "warmup_s": round(warmup_s, 3),
+        "affinity": args.affinity != "off",
         "transport": "router-http",
     }
     rec["ok"] = bool(
         errors == 0 and verify_ok and recompiles == 0
+    )
+    return rec
+
+
+def run_affinity_bench(args) -> dict:
+    """``--router --affinity ab`` (ISSUE 12): the SAME shared-prefix-
+    heavy prompt sequence through one 2-replica fleet twice — prefix
+    affinity OFF, then ON, with every replica's prefix cache reset
+    between phases so both start cold — banking one ``serve_affinity``
+    record. Requests run sequentially with a manual probe sweep before
+    each dispatch, so routing (and therefore the hit counts) is
+    deterministic: the record's claim is measured, not sampled.
+
+    The claims it carries: ``prefix_hit_rate_affinity`` strictly above
+    ``prefix_hit_rate_no_affinity`` on shared-prefix traffic (the
+    acceptance headline — bench_gate pins the -affinity rate as a
+    minimum), TTFT p50/p95 split shared-vs-cold for the on phase, every
+    verified stream token-identical to the unbatched reference, and
+    zero post-warmup recompiles across both phases."""
+    import jax
+
+    from tensorflow_examples_tpu.serving.router import (
+        Router,
+        RouterConfig,
+    )
+
+    kv_block = args.kv_block_size if args.kv_block_size >= 0 else 16
+    serve_kw = dict(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        kv_block_size=kv_block,
+        kv_dtype=args.kv_dtype,
+    )
+    if args.smoke:
+        # A single coarse bucket per program family: the A/B measures
+        # ROUTING (hit counts, shared-vs-cold TTFT), which is bucket-
+        # granularity-agnostic — a 3-program ladder keeps the tier-1
+        # smoke's two warmups cheap.
+        serve_kw.update(prefill_bucket_floor=64, kv_bucket_floor=64)
+
+    n = args.requests or (12 if args.smoke else 48)
+    verify = args.verify if args.verify >= 0 else (3 if args.smoke else 0)
+    n_rep = args.replicas  # main() already defaulted it (2 for --router)
+
+    t0 = time.perf_counter()
+    replicas = build_replica_stacks(args, serve_kw, n_rep)
+    warmup_s = time.perf_counter() - t0
+    urls = [f"http://127.0.0.1:{fe.port}" for _, _, fe, _ in replicas]
+
+    def phase(affinity: bool, prompts):
+        # Fresh caches per phase (the A/B must compare cold-start to
+        # cold-start) without paying a second fleet warmup: reset every
+        # pool — prefix cache, hit counters, and all slots — while the
+        # batchers idle between the sequential requests.
+        for engine, _, _, _ in replicas:
+            engine.pool.reset()
+        # No probe thread: one manual sweep before every dispatch keeps
+        # the router's load/digest view exact, so the phase's routing
+        # is a pure function of the prompt sequence.
+        router = Router(
+            urls,
+            cfg=RouterConfig(
+                probe_interval_s=3600.0,
+                request_timeout_s=args.timeout,
+                prefix_affinity=affinity,
+            ),
+        )
+        replies = []
+        t0 = time.perf_counter()
+        try:
+            for i, prompt in enumerate(prompts):
+                router.probe_once()
+                replies.append(router.handle(
+                    {
+                        "prompt": prompt,
+                        "max_new_tokens": args.max_new_tokens,
+                        "temperature": args.temperature,
+                        "top_k": args.top_k,
+                        "seed": i,
+                    },
+                    kind="generate",
+                ))
+            wall = time.perf_counter() - t0
+            verify_ok = True
+            for i in range(min(verify, len(prompts))):
+                status, reply = replies[i]
+                ref = replicas[0][0].reference_generate(
+                    prompts[i], max_new=args.max_new_tokens, seed=i,
+                    temperature=args.temperature, top_k=args.top_k,
+                )
+                if status != 200 or reply.get("tokens") != ref:
+                    verify_ok = False
+                    print(
+                        f"# VERIFY FAIL affinity req {i}: "
+                        f"{reply.get('tokens')} != reference {ref}",
+                        file=sys.stderr,
+                    )
+            hits = sum(
+                getattr(e.pool, "prefix_hits", 0)
+                for e, _, _, _ in replicas
+            )
+            misses = sum(
+                getattr(e.pool, "prefix_misses", 0)
+                for e, _, _, _ in replicas
+            )
+            recompiles = sum(
+                e.post_warmup_recompiles() for e, _, _, _ in replicas
+            )
+            affinity_hits = int(
+                router.registry.counter_values().get(
+                    "router/affinity_hits_total", 0
+                )
+            )
+        finally:
+            router.close()
+        return {
+            "replies": replies,
+            "wall_s": wall,
+            "hits": hits,
+            "misses": misses,
+            "recompiles": recompiles,
+            "affinity_hits": affinity_hits,
+            "verify_ok": verify_ok,
+        }
+
+    if args.workdir:
+        from tensorflow_examples_tpu.workloads import gpt2
+
+        model_cfg = gpt2.model_config(gpt2.Gpt2Config())
+    else:
+        from tensorflow_examples_tpu.models import transformer
+
+        model_cfg = transformer.TransformerConfig(**SMOKE_MODEL)
+    prompts, groups = make_affinity_prompts(
+        n, vocab=model_cfg.vocab_size, max_len=model_cfg.max_len,
+        max_new=args.max_new_tokens, block=kv_block,
+    )
+    try:
+        off = phase(False, prompts)
+        on = phase(True, prompts)
+    finally:
+        for _, batcher, frontend, _ in replicas:
+            batcher.close(drain=True)
+            frontend.close()
+
+    def rate(p):
+        looked = p["hits"] + p["misses"]
+        return p["hits"] / looked if looked else 0.0
+
+    def group_ttfts(p, want):
+        return [
+            reply.get("ttft_s")
+            for (status, reply), g in zip(p["replies"], groups)
+            if status == 200 and g == want
+        ]
+
+    errors = sum(
+        1 for p in (off, on) for status, _ in p["replies"]
+        if status != 200
+    )
+    on_rate, off_rate = rate(on), rate(off)
+    recompiles = off["recompiles"] + on["recompiles"]
+    rec = {
+        "bench": "serve_affinity",
+        "backend": jax.default_backend(),
+        "replicas": n_rep,
+        "requests": 2 * n,
+        "requests_per_phase": n,
+        "shared_requests_per_phase": groups.count("shared"),
+        "errors": errors,
+        "wall_s": round(off["wall_s"] + on["wall_s"], 3),
+        "warmup_s": round(warmup_s, 3),
+        "kv_block_size": kv_block,
+        "prefix_hit_rate_affinity": round(on_rate, 4),
+        "prefix_hit_rate_no_affinity": round(off_rate, 4),
+        "affinity_hit_gain": round(on_rate - off_rate, 4),
+        "prefix_hits_on": on["hits"],
+        "prefix_hits_off": off["hits"],
+        "affinity_dispatches": on["affinity_hits"],
+        "ttft_shared_p50_ms": _pct_from_values(
+            group_ttfts(on, "shared"), 50
+        ),
+        "ttft_shared_p95_ms": _pct_from_values(
+            group_ttfts(on, "shared"), 95
+        ),
+        "ttft_cold_p50_ms": _pct_from_values(
+            group_ttfts(on, "cold"), 50
+        ),
+        "ttft_cold_p95_ms": _pct_from_values(
+            group_ttfts(on, "cold"), 95
+        ),
+        "post_warmup_recompiles": recompiles,
+        "verified": min(verify, n),
+        "verify_ok": bool(off["verify_ok"] and on["verify_ok"]),
+        "transport": "router-http",
+    }
+    rec["ok"] = bool(
+        errors == 0
+        and rec["verify_ok"]
+        and recompiles == 0
+        and on_rate > off_rate
     )
     return rec
 
@@ -907,6 +1170,16 @@ def main(argv=None) -> int:
                          "step); banks the serve_spec record "
                          "(tpot_speedup, draft_hit_rate, "
                          "accepted_per_step, tokens_identical)")
+    ap.add_argument("--affinity", choices=("on", "off", "ab"),
+                    default="on",
+                    help="ISSUE 12 (--router): prefix-affinity dispatch"
+                         " on/off, or 'ab' — drive the same shared-"
+                         "prefix traffic through an affinity-off fleet "
+                         "then an affinity-on one and bank the "
+                         "serve_affinity A/B record "
+                         "(prefix_hit_rate_affinity vs "
+                         "prefix_hit_rate_no_affinity, shared-vs-cold "
+                         "TTFT)")
     ap.add_argument("--fault-spec", default="",
                     help="serve fault schedule for --chaos "
                          "(utils/faults.py grammar, e.g. 'crash@1:4,"
@@ -938,8 +1211,19 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not args.smoke and not args.workdir:
         ap.error("pick a target: --smoke or --workdir DIR")
+    if args.affinity == "ab" and not args.router:
+        ap.error("--affinity ab is a --router A/B mode")
     if args.replicas <= 0:
         args.replicas = 3 if args.chaos else 2
+
+    if args.router and args.affinity == "ab":
+        rec = run_affinity_bench(args)
+        print(json.dumps(rec))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        return 0 if rec["ok"] else 1
 
     if args.spec_decode > 0:
         rec = run_spec_bench(args)

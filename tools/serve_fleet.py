@@ -110,6 +110,15 @@ def main(argv=None) -> int:
     ap.add_argument("--eject-cooldown", type=float, default=3.0,
                     help="circuit breaker: seconds ejected before the "
                          "half-open probe")
+    ap.add_argument("--no-affinity", action="store_true",
+                    help="disable prefix-affinity dispatch (ISSUE 12; "
+                         "on by default — the router prefers the "
+                         "replica already caching the prompt's prefix "
+                         "chain, load-guarded)")
+    ap.add_argument("--affinity-load-gap", type=float, default=2.0,
+                    help="affinity only wins while the chain-holder's "
+                         "load score is within this gap of the "
+                         "least-loaded replica")
     args = ap.parse_args(argv)
     if not args.replica and not args.spawn:
         ap.error("at least one --replica URL or --spawn command is "
@@ -174,6 +183,8 @@ def main(argv=None) -> int:
             eject_after=args.eject_after,
             eject_cooldown_s=args.eject_cooldown,
             canary_fraction=args.canary_fraction,
+            prefix_affinity=not args.no_affinity,
+            affinity_load_gap=args.affinity_load_gap,
         ),
     ).start()
     supervisor = None
@@ -187,10 +198,18 @@ def main(argv=None) -> int:
             max_restarts=args.max_restarts,
         ).start()
     frontend = RouterFrontend(router, port=args.port).start()
+    # Role topology (ISSUE 12): heterogeneous prefill/decode fleets are
+    # first-class — say what the probe sweep actually found, so a
+    # mis-roled rollout is visible before it serves.
+    roles: dict = {}
+    for rep in router.replicas:
+        roles[rep.role] = roles.get(rep.role, 0) + 1
     print(
         f"router on :{frontend.port} over {len(replica_urls)} base + "
         f"{len(args.canary)} canary replica(s)"
-        + (f", supervising {len(spawned)}" if spawned else ""),
+        + (f", supervising {len(spawned)}" if spawned else "")
+        + f"; roles {roles}; prefix affinity "
+        + ("off" if args.no_affinity else "on"),
         file=sys.stderr,
     )
 

@@ -95,6 +95,23 @@ flags.DEFINE_integer(
     "per decode-loop iteration, so a long prefill interleaves with "
     "decode steps. Requires --kv_block_size (+ prefix_cache) and must "
     "be a multiple of it. 0 disables.")
+flags.DEFINE_boolean(
+    "brownout", False,
+    "overload brownout ladder (docs/serving.md overload section): "
+    "under pressure shed batch -> cap max_new_tokens -> skip "
+    "speculation -> shed interactive, stepped with hysteresis; the "
+    "level is published on /health for the router and autoscaler.")
+flags.DEFINE_integer(
+    "brownout_queue_hi", 0,
+    "brownout queue-depth high watermark (0 = 2 * max_slots)")
+flags.DEFINE_float(
+    "brownout_hold_s", 0.5,
+    "brownout hysteresis: min dwell per rung up, sustained-clear "
+    "time per rung down")
+flags.DEFINE_integer(
+    "brownout_max_new_tokens", 8,
+    "brownout level-2 generation cap (streams retire early as a "
+    "prefix, truncated='brownout')")
 flags.DEFINE_string("vocab_dir", "", "dir with vocab.json+merges.txt")
 flags.DEFINE_string(
     "serve_sharding_config", "",
@@ -235,6 +252,10 @@ def main(argv):
             draft_ngram=FLAGS.draft_ngram,
             role=FLAGS.role,
             prefill_chunk_tokens=FLAGS.prefill_chunk_tokens,
+            brownout=FLAGS.brownout,
+            brownout_queue_hi=FLAGS.brownout_queue_hi,
+            brownout_hold_s=FLAGS.brownout_hold_s,
+            brownout_max_new_tokens=FLAGS.brownout_max_new_tokens,
             **(
                 {"attention": FLAGS.decode_attention}
                 if FLAGS.decode_attention else {}

@@ -48,6 +48,23 @@ stay token-identical to the non-speculative path. TPOT records
 wall/committed per token; ``serving/accepted_per_step`` and the
 ``serving/spec_*`` counters carry the acceptance story onto the
 schema-v8 stats line.
+
+SLO classes (ISSUE 13): every request carries an ``slo`` class —
+``interactive`` (default) or ``batch`` — and the batcher keeps one
+bounded queue per class. Interactive is served first at every decision
+point: admission drains the interactive queue before the batch queue,
+chunked-prefill turns prefer interactive, and when the slots are full
+an interactive arrival PREEMPTS the most recently admitted batch
+request (its slot is freed and the request re-queued; replay from the
+prompt is token-identical by the per-request seeding, so preemption is
+a latency event, never a content one). Latency histograms and shed
+counters exist per class (``serving/ttft_interactive`` /
+``serving/shed_batch_total`` / ...) next to the class-blind ones, and
+the schema-v10 stats line carries the split. Under pressure the
+brownout ladder (``serving/overload.py``, ``ServeConfig.brownout``)
+sheds batch FIRST, then caps generation budgets, then drops
+speculation's extra verify work, and sheds interactive only as the
+last rung before falling over.
 """
 
 from __future__ import annotations
@@ -60,12 +77,17 @@ import threading
 import time
 
 from tensorflow_examples_tpu.serving.engine import EngineStepError
+from tensorflow_examples_tpu.serving.overload import OverloadController
 from tensorflow_examples_tpu.serving.paged_kv import BlockExhausted
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry import schema
 from tensorflow_examples_tpu.telemetry.spans import span
 
 log = logging.getLogger(__name__)
+
+# SLO classes, in service-priority order: admission, chunk turns and
+# preemption all prefer earlier classes (ISSUE 13).
+SLO_CLASSES = ("interactive", "batch")
 
 
 class QueueFull(RuntimeError):
@@ -102,6 +124,10 @@ class Request:
     classify_top_n: int = 5
     pages: dict | None = None        # resume: the handed-off KV pages
     first_token: int | None = None   # resume: the prefill's sampled token
+    slo: str = "interactive"     # interactive | batch (ISSUE 13):
+    #                              interactive is served first
+    #                              everywhere; batch absorbs shedding
+    #                              and preemption first
 
 
 @dataclasses.dataclass
@@ -111,7 +137,10 @@ class Result:
     tokens: list[int]               # generated tokens (generate)
     prompt_len: int
     top: list[dict] | None = None   # classify payload
-    truncated: str | None = None  # None | "deadline" | "max_len" | "shutdown"
+    truncated: str | None = None  # None | "deadline" | "max_len"
+    #                               | "shutdown" | "brownout" (the
+    #                               level-2 generation cap bit: tokens
+    #                               are a PREFIX of the uncapped stream)
     queue_wait_s: float = 0.0
     ttft_s: float | None = None
     total_s: float = 0.0
@@ -130,7 +159,7 @@ class _InFlight:
     __slots__ = (
         "req", "future", "slot", "t_submit", "t_admit", "t_first",
         "deadline", "tokens", "last_token", "spec_drafted",
-        "spec_accepted",
+        "spec_accepted", "max_new_eff",
     )
 
     def __init__(self, req: Request, future, t_submit: float):
@@ -152,6 +181,11 @@ class _InFlight:
         # content one (test-pinned).
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # Effective generation budget (ISSUE 13): the brownout level-2
+        # cap at ADMISSION time, <= req.max_new_tokens. A capped stream
+        # retires with truncated="brownout" — still a prefix of the
+        # uncapped stream.
+        self.max_new_eff = req.max_new_tokens
 
 
 class ContinuousBatcher:
@@ -183,8 +217,34 @@ class ContinuousBatcher:
         self.registry = (
             registry if registry is not None else engine.registry
         )
-        self._q: queue.Queue[_InFlight] = queue.Queue(
-            maxsize=cfg.max_queue
+        # One bounded queue per SLO class (ISSUE 13): admission drains
+        # interactive first; a class sheds only against its OWN bound,
+        # and the brownout ladder sheds batch fleet-wide before
+        # interactive ever queues deep.
+        self._queues: dict[str, queue.Queue] = {
+            cls: queue.Queue(maxsize=cfg.max_queue)
+            for cls in SLO_CLASSES
+        }
+        # Signaled on every submit so the idle loop can block on
+        # "anything arrived in ANY class queue".
+        self._arrival = threading.Event()
+        # Brownout overload controller (serving/overload.py): ticked
+        # once per loop iteration with queue depth + KV occupancy (+
+        # its own recent-TTFT window); submit() reads its level.
+        self._overload = OverloadController(
+            registry=self.registry,
+            enabled=bool(getattr(cfg, "brownout", False)),
+            queue_hi=(
+                int(getattr(cfg, "brownout_queue_hi", 0) or 0)
+                or 2 * cfg.max_slots
+            ),
+            kv_hi=float(getattr(cfg, "brownout_kv_hi", 0.92)),
+            ttft_hi_s=float(getattr(cfg, "brownout_ttft_hi_s", 0.0)),
+            clear_frac=float(getattr(cfg, "brownout_clear_frac", 0.5)),
+            hold_s=float(getattr(cfg, "brownout_hold_s", 0.5)),
+            max_new_tokens_cap=int(
+                getattr(cfg, "brownout_max_new_tokens", 8)
+            ),
         )
         self._active: dict[int, _InFlight] = {}
         # Chunked prefills in flight (ISSUE 12): slot -> (item, engine
@@ -224,6 +284,25 @@ class ContinuousBatcher:
         if self._draining or self._stop.is_set():
             reg.counter("serving/rejected_total").inc()
             raise Draining("serving is draining; retry against a live host")
+        if req.slo not in SLO_CLASSES:
+            fut = concurrent.futures.Future()
+            fut.set_exception(ValueError(
+                f"unknown slo class {req.slo!r}; one of {SLO_CLASSES}"
+            ))
+            reg.counter("serving/rejected_total").inc()
+            return fut
+        if self._overload.sheds(req.slo):
+            # Brownout shed (ISSUE 13): the ladder sheds batch at level
+            # 1 and interactive only at level 4 — a 503 NOW, before the
+            # queue, so degradation lands on the class that can absorb
+            # it.
+            reg.counter("serving/shed_total").inc()
+            reg.counter(f"serving/shed_{req.slo}_total").inc()
+            reg.counter("serving/brownout_shed_total").inc()
+            raise QueueFull(
+                f"brownout level {self._overload.level}: shedding "
+                f"{req.slo} traffic; retry later"
+            )
         fut: concurrent.futures.Future = concurrent.futures.Future()
         item = _InFlight(req, fut, time.monotonic())
         budget = len(req.prompt) + (
@@ -280,22 +359,26 @@ class ContinuousBatcher:
                 ))
                 reg.counter("serving/rejected_total").inc()
                 return fut
+        q = self._queues[req.slo]
         try:
-            self._q.put_nowait(item)
+            q.put_nowait(item)
         except queue.Full:
             reg.counter("serving/shed_total").inc()
+            reg.counter(f"serving/shed_{req.slo}_total").inc()
             raise QueueFull(
-                f"request queue at capacity ({self._q.maxsize}); load shed"
+                f"{req.slo} request queue at capacity ({q.maxsize}); "
+                "load shed"
             ) from None
+        self._arrival.set()
         if self._draining or self._stop.is_set():
             # Raced close(): its queue sweep may already have passed,
             # leaving this item unresolved in a dead batcher (the caller
             # would block its full request timeout instead of getting an
             # instant 503). Pull it back out if the loop hasn't taken
             # it; whoever dequeued it first resolves the future.
-            with self._q.mutex:
+            with q.mutex:
                 try:
-                    self._q.queue.remove(item)
+                    q.queue.remove(item)
                     removed = True
                 except ValueError:
                     removed = False
@@ -304,8 +387,17 @@ class ContinuousBatcher:
                 raise Draining(
                     "serving is draining; retry against a live host"
                 )
-        reg.gauge("serving/queue_depth").set(self._q.qsize())
+        reg.gauge("serving/queue_depth").set(self.queue_depth())
         return fut
+
+    def queue_depth(self) -> int:
+        """Total queued requests across SLO classes (the load signal
+        the frontend's /health and the brownout controller read)."""
+        return sum(q.qsize() for q in self._queues.values())
+
+    @property
+    def brownout_level(self) -> int:
+        return self._overload.level
 
     # --------------------------------------------------------- lifecycle
 
@@ -328,7 +420,7 @@ class ContinuousBatcher:
             def busy():
                 return bool(
                     self._active or self._staged or self._prefilling
-                    or not self._q.empty()
+                    or self.queue_depth()
                 )
 
             while (
@@ -358,12 +450,13 @@ class ContinuousBatcher:
         return self._draining
 
     def _fail_pending(self, exc: Exception) -> None:
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue.Empty:
-                break
-            item.future.set_exception(exc)
+        for q in self._queues.values():
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                item.future.set_exception(exc)
         for item, _ in list(self._prefilling.values()):
             self._prefilling.pop(item.slot, None)
             self._retire(item, truncated="shutdown")
@@ -380,6 +473,19 @@ class ContinuousBatcher:
         reg = self.registry
         decode_steps = 0
         while not self._stop.is_set():
+            # Brownout tick (ISSUE 13): one controller evaluation per
+            # loop iteration — queue depth + KV occupancy here, the
+            # controller's own recent-TTFT window inside. Cheap host
+            # math; the ladder's hysteresis does the rate limiting.
+            self._overload.update(
+                queue_depth=self.queue_depth(),
+                kv_occupancy=float(self.engine.pool.occupancy),
+            )
+            # Interactive preempts batch for decode slots (ISSUE 13):
+            # free slots for waiting interactive requests BEFORE this
+            # iteration's admission, so the preempted batch slots are
+            # immediately reusable.
+            self._preempt_for_interactive()
             staged = self._gather()
             if staged:
                 self._wd("serve_prefill")
@@ -452,6 +558,9 @@ class ContinuousBatcher:
             reg.histogram("serving/decode_step").record(dt)
             for slot, toks in out.items():
                 item = self._active[slot]
+                cls_tpot = reg.histogram(
+                    f"serving/tpot_{item.req.slo}"
+                )
                 item.spec_drafted += drafts_by_slot.get(slot, 0)
                 item.spec_accepted += len(toks) - 1
                 per_tok = dt / len(toks)
@@ -461,6 +570,7 @@ class ContinuousBatcher:
                     item.last_token = token
                     committed.append(token)
                     tpot.record(per_tok)
+                    cls_tpot.record(per_tok)
                     if item.req.eos_id is not None \
                             and token == item.req.eos_id:
                         # Tokens past eos in the same verify window are
@@ -485,8 +595,11 @@ class ContinuousBatcher:
         drafts (capped at the request's remaining budget minus the one
         token the verify itself samples) and run the verify_k rung; a
         step where NO request has a draft falls back to the plain
-        one-token decode rung — same tokens, (k+1)x less compute."""
-        if self._draft is None:
+        one-token decode rung — same tokens, (k+1)x less compute.
+        Brownout level 3+ (ISSUE 13) forces that fallback every step:
+        speculation's extra verify compute is the cheapest thing to
+        drop under pressure, and dropping it never changes tokens."""
+        if self._draft is None or self._overload.spec_disabled():
             out = self.engine.decode([
                 (
                     it.slot, it.last_token, it.req.seed,
@@ -500,6 +613,7 @@ class ContinuousBatcher:
         for it in self._active.values():
             remaining = it.req.max_new_tokens - len(it.tokens)
             k_eff = min(self.spec_k, remaining - 1)
+            k_eff = min(k_eff, it.max_new_eff - len(it.tokens) - 1)
             drafts = (
                 self._draft.propose(it.slot, k_eff) if k_eff > 0 else []
             )
@@ -552,7 +666,9 @@ class ContinuousBatcher:
                     self._take(staged)
                 except queue.Empty:
                     break
-        self.registry.gauge("serving/queue_depth").set(self._q.qsize())
+        self.registry.gauge("serving/queue_depth").set(
+            self.queue_depth()
+        )
         return staged
 
     def _fail_active(self, exc: Exception) -> None:
@@ -578,15 +694,100 @@ class ContinuousBatcher:
             self._draft.end(slot)
 
     def _take(self, staged: list, timeout: float | None = None) -> None:
-        """Dequeue one request into ``staged``, counted in ``_staged``
-        the moment it leaves the queue so the drain poll never sees it
-        in neither place."""
-        item = (
-            self._q.get(timeout=timeout)
-            if timeout is not None else self._q.get_nowait()
+        """Dequeue one request into ``staged`` — INTERACTIVE FIRST
+        (ISSUE 13: the class order is the admission order), counted in
+        ``_staged`` the moment it leaves a queue so the drain poll
+        never sees it in neither place. With a timeout, blocks on the
+        arrival event until any class queue has an item."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
         )
-        self._staged += 1
-        staged.append(item)
+        while True:
+            for cls in SLO_CLASSES:
+                try:
+                    item = self._queues[cls].get_nowait()
+                except queue.Empty:
+                    continue
+                self._staged += 1
+                staged.append(item)
+                return
+            if deadline is None:
+                raise queue.Empty
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise queue.Empty
+            # Clear-then-recheck closes the missed-wakeup race with
+            # submit()'s put-then-set.
+            self._arrival.clear()
+            if any(not q.empty() for q in self._queues.values()):
+                continue
+            if not self._arrival.wait(timeout=remaining):
+                raise queue.Empty
+
+    # ------------------------------------------------------- preemption
+
+    def _preempt_for_interactive(self) -> None:
+        """Interactive preempts batch for decode slots (ISSUE 13):
+        when interactive requests are queued and the slots are
+        exhausted, evict batch requests — most recently admitted first
+        (least sunk work), mid-chunked-prefill before decoding — and
+        re-queue them at the back of the batch queue. Replay from the
+        prompt is token-identical by the per-request seeding, so a
+        preemption costs the batch request latency, never content.
+        Loop-thread only."""
+        waiting = self._queues["interactive"].qsize()
+        if not waiting:
+            return
+        free = min(
+            self.max_batch - len(self._active) - len(self._prefilling),
+            self.engine.pool.num_slots - self.engine.pool.active_slots,
+        )
+        need = waiting - max(free, 0)
+        if need <= 0:
+            return
+        victims: list[_InFlight] = [
+            it for it, _ in self._prefilling.values()
+            if it.req.slo == "batch"
+        ]
+        victims += sorted(
+            (it for it in self._active.values()
+             if it.req.slo == "batch"),
+            key=lambda it: it.t_admit or 0.0, reverse=True,
+        )
+        for item in victims[:need]:
+            self._preempt(item)
+
+    def _preempt(self, item: _InFlight) -> None:
+        reg = self.registry
+        slot = item.slot
+        self._prefilling.pop(slot, None)
+        self._active.pop(slot, None)
+        self.engine.pool.free(slot)
+        self._drop_draft(slot)
+        # Full reset: re-admission replays prefill + decode from the
+        # prompt (same tokens by seeding); the original t_submit keeps
+        # queue-wait/deadline accounting honest about the total wait.
+        item.slot = None
+        item.t_admit = None
+        item.t_first = None
+        item.tokens = []
+        item.last_token = None
+        item.spec_drafted = 0
+        item.spec_accepted = 0
+        item.max_new_eff = item.req.max_new_tokens
+        reg.counter("serving/preempted_total").inc()
+        try:
+            self._queues["batch"].put_nowait(item)
+        except queue.Full:
+            # The batch queue itself is saturated: the preemption
+            # becomes a shed — batch absorbs it, by design.
+            reg.counter("serving/shed_total").inc()
+            reg.counter("serving/shed_batch_total").inc()
+            if not item.future.done():
+                item.future.set_exception(QueueFull(
+                    "preempted for interactive traffic and the batch "
+                    "queue is full; load shed"
+                ))
 
     def _admit(self, item: _InFlight) -> None:
         reg = self.registry
@@ -609,6 +810,15 @@ class ContinuousBatcher:
         item.t_admit = now
         reg.histogram("serving/queue_wait").record(now - item.t_submit)
         req = item.req
+        reg.histogram(
+            f"serving/queue_wait_{req.slo}"
+        ).record(now - item.t_submit)
+        cap = self._overload.max_new_cap()
+        if cap is not None and req.kind in ("generate", "resume"):
+            # Brownout level 2 (ISSUE 13): cap the generation budget at
+            # admission — the stream retires early with
+            # truncated="brownout", still a prefix of the full stream.
+            item.max_new_eff = min(req.max_new_tokens, cap)
         if req.kind == "resume":
             # Disaggregated decode (ISSUE 12): no prefill — map the
             # handed-off KV pages in and continue the stream from the
@@ -616,9 +826,10 @@ class ContinuousBatcher:
             with span("serve_resume", tokens=len(req.prompt)):
                 self.engine.import_kv_pages(slot, req.pages, req.prompt)
             item.t_first = time.monotonic()
-            reg.histogram("serving/ttft").record(
-                item.t_first - item.t_submit
-            )
+            ttft = item.t_first - item.t_submit
+            reg.histogram("serving/ttft").record(ttft)
+            reg.histogram(f"serving/ttft_{req.slo}").record(ttft)
+            self._overload.note_ttft(ttft)
             item.tokens.append(req.first_token)
             item.last_token = req.first_token
             if self._draft is not None:
@@ -674,7 +885,10 @@ class ContinuousBatcher:
         reg = self.registry
         req, slot = item.req, item.slot
         item.t_first = time.monotonic()
-        reg.histogram("serving/ttft").record(item.t_first - item.t_submit)
+        ttft = item.t_first - item.t_submit
+        reg.histogram("serving/ttft").record(ttft)
+        reg.histogram(f"serving/ttft_{req.slo}").record(ttft)
+        self._overload.note_ttft(ttft)
         if req.kind == "classify":
             from tensorflow_examples_tpu.serving.engine import top_logprobs
 
@@ -716,9 +930,16 @@ class ContinuousBatcher:
         """Run ONE chunk of the oldest in-flight chunked prefill; on
         the final chunk the request joins the decode set exactly as a
         single-shot admission would (token-identical: the final chunk's
-        sampling key is the unchunked prefill's)."""
+        sampling key is the unchunked prefill's). Interactive chunked
+        prefills take the turn before batch ones (ISSUE 13) — the
+        chunk turn is a decode-slot-adjacent resource, and the class
+        order is the service order."""
         reg = self.registry
-        slot = next(iter(self._prefilling))
+        slot = next(
+            (s for s, (it, _) in self._prefilling.items()
+             if it.req.slo == "interactive"),
+            next(iter(self._prefilling)),
+        )
         item, state = self._prefilling[slot]
         if item.deadline is not None and time.monotonic() > item.deadline:
             # A dead-on-arrival stream must not keep stalling everyone
@@ -769,6 +990,14 @@ class ContinuousBatcher:
             len(item.tokens) >= req.max_new_tokens
             or (req.eos_id is not None and item.last_token == req.eos_id)
         )
+        if not done and len(item.tokens) >= item.max_new_eff:
+            # Brownout level-2 cap (ISSUE 13): retire early with what
+            # we have — a prefix of the full stream, labeled so the
+            # client knows the fleet cheapened it, not the model.
+            done, truncated = True, "brownout"
+            self.registry.counter(
+                "serving/brownout_truncated_total"
+            ).inc()
         if not done and item.deadline is not None \
                 and time.monotonic() > item.deadline:
             done, truncated = True, "deadline"
@@ -808,6 +1037,9 @@ class ContinuousBatcher:
         result.total_s = now - item.t_submit
         reg = self.registry
         reg.histogram("serving/e2e").record(result.total_s)
+        reg.histogram(
+            f"serving/e2e_{item.req.slo}"
+        ).record(result.total_s)
         reg.counter("serving/completed_total").inc()
         # Handoff accounting: the DELIVERING replica owns the whole
         # stream (resume counts the first token too), the prefill leg
@@ -851,7 +1083,7 @@ class ContinuousBatcher:
             # Chunk-prefilling requests count as active: they hold a
             # slot and stall one chunk per loop iteration.
             "active_requests": len(self._active) + len(self._prefilling),
-            "queue_depth": self._q.qsize(),
+            "queue_depth": self.queue_depth(),
             "slots": self.engine.pool.num_slots,
             "kv_occupancy": self.engine.pool.occupancy,
             "post_warmup_recompiles": (
@@ -873,6 +1105,27 @@ class ContinuousBatcher:
             serving["accepted_per_step"] = (
                 (steps + accepted) / steps if steps else 0.0
             )
+        # Schema-v10 overload keys (ISSUE 13): the SLO-class split and
+        # the brownout ladder's state — the per-class latency story an
+        # operator reads to see WHO is paying for an overload.
+        for cls in SLO_CLASSES:
+            for name in ("queue_wait", "ttft", "tpot"):
+                h = hists.get(f"serving/{name}_{cls}")
+                if h and h["count"]:
+                    serving[f"{name}_p95_{cls}"] = h["p95"]
+        serving["shed_interactive"] = int(
+            counters.get("serving/shed_interactive_total", 0)
+        )
+        serving["shed_batch"] = int(
+            counters.get("serving/shed_batch_total", 0)
+        )
+        serving["preempted_batch"] = int(
+            counters.get("serving/preempted_total", 0)
+        )
+        serving["brownout_level"] = int(self._overload.level)
+        serving["brownout_transitions"] = int(
+            self._overload.transitions()
+        )
         paged = getattr(self.engine.pool, "paged_stats", None)
         if callable(paged):
             serving.update(paged())

@@ -139,6 +139,22 @@ class InProcReplica:
     def close(self) -> None:
         self._teardown()
 
+    def stop(self) -> None:
+        """GRACEFUL teardown (the autoscaler's scale-down verb, ISSUE
+        13): finish everything already accepted, close the port
+        politely — the opposite of ``kill()``/``close()``, which die
+        like a SIGKILLed process. Callers drain at the router first,
+        so by the time this runs the replica should already be idle."""
+        with self._lock:
+            batcher, self.batcher = self.batcher, None
+            frontend, self.frontend = self.frontend, None
+            self.engine = None
+            self._dead = True
+        if batcher is not None:
+            batcher.close(drain=True)
+        if frontend is not None:
+            frontend.close()
+
 
 class ChaosFleet:
     """N in-proc replicas + hardened router + supervisor, as one unit.

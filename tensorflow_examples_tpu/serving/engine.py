@@ -122,9 +122,25 @@ class ServeConfig:
     #                              multiple of kv_block_size.
     # ---- continuous batcher (serving/batcher.py) ----
     max_batch: int = 0           # admission cap; 0 -> max_slots
-    max_queue: int = 64          # bounded queue: beyond this, load-shed
+    max_queue: int = 64          # bounded queue PER SLO CLASS: beyond
+    #                              this, load-shed
     max_delay_s: float = 0.002   # idle coalescing window before first prefill
     watchdog_secs: float = 0.0   # 0 disables the serve-loop watchdog
+    # ---- brownout overload controller (serving/overload.py; ISSUE 13) ----
+    brownout: bool = False       # enable the degradation ladder: shed
+    #                              batch -> cap max_new_tokens -> skip
+    #                              speculation -> shed interactive,
+    #                              stepped with hysteresis as pressure
+    #                              builds/clears
+    brownout_queue_hi: int = 0   # queue-depth high watermark; 0 ->
+    #                              2 * max_slots
+    brownout_kv_hi: float = 0.92  # KV-occupancy high watermark
+    brownout_ttft_hi_s: float = 0.0  # recent-window TTFT p95 high
+    #                              watermark; 0 disables the signal
+    brownout_clear_frac: float = 0.5  # clear watermark = frac * hi
+    brownout_hold_s: float = 0.5  # hysteresis: min dwell per rung (up),
+    #                              sustained-clear time per rung (down)
+    brownout_max_new_tokens: int = 8  # the level-2 generation cap
     # ---- frontend ----
     request_timeout_s: float = 120.0
 

@@ -9,8 +9,11 @@ Endpoints:
 
 * ``POST /generate`` — body ``{"prompt": [ids], "max_new_tokens": n,
   "temperature": t, "top_k": k, "seed": s, "eos_id": id,
-  "deadline_s": d}`` (all but ``prompt`` optional; ``"text"`` may
-  replace ``prompt`` when the frontend was built with a tokenizer).
+  "deadline_s": d, "slo": "interactive"|"batch"}`` (all but ``prompt``
+  optional; ``"text"`` may replace ``prompt`` when the frontend was
+  built with a tokenizer). ``slo`` is the ISSUE 13 service class:
+  batch queues behind interactive and absorbs shedding/preemption
+  first.
   Replies ``{"tokens": [...], "prompt_len": n, "truncated": null,
   "queue_wait_s": ..., "ttft_s": ..., "total_s": ...}`` (+ ``"text"``
   with a tokenizer).
@@ -103,6 +106,13 @@ class _TrackingHTTPServer(http.server.ThreadingHTTPServer):
     (serving/chaos.py) and the ``crash@R:N`` serve fault are the
     consumers; normal shutdown never touches this."""
 
+    # An overloaded replica must SHED (a 503 the class queues decide),
+    # never silently drop connections: the stdlib default accept
+    # backlog of 5 overflows under a flash crowd's connection burst
+    # and turns correct shedding into spurious transport failures
+    # (ISSUE 13).
+    request_queue_size = 128
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self.conn_lock = threading.Lock()
@@ -142,13 +152,18 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
         raise ValueError("'prompt' must be a non-empty list of token ids")
     known = {
         "prompt", "text", "max_new_tokens", "temperature", "top_k",
-        "seed", "eos_id", "deadline_s", "top_n",
+        "seed", "eos_id", "deadline_s", "top_n", "slo",
     }
     if kind == "resume":
         known |= {"pages", "first_token"}
     unknown = set(body) - known
     if unknown:
         raise ValueError(f"unknown fields: {sorted(unknown)}")
+    slo = body.get("slo", "interactive")
+    if slo not in ("interactive", "batch"):
+        raise ValueError(
+            "'slo' must be 'interactive' or 'batch'"
+        )
     pages = first_token = None
     if kind == "resume":
         pages = body.get("pages")
@@ -190,6 +205,7 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
         classify_top_n=number("top_n", 5, int, 1),
         pages=pages,
         first_token=first_token,
+        slo=slo,
     )
 
 
@@ -242,12 +258,21 @@ class ServingFrontend:
         except Draining as e:
             return 503, {"error": str(e), "draining": True}
         except QueueFull as e:
-            return 503, {"error": str(e), "retry": True}
+            # "shed": true marks a LOAD shed (queue full / brownout) —
+            # what lets serve_bench (ISSUE 13 satellite) count correct
+            # shedding apart from transport failures in its records.
+            return 503, {"error": str(e), "retry": True, "shed": True}
         except BlockExhausted as e:
-            # Paged-KV capacity shed: same contract as QueueFull — the
-            # pool cannot back the request's tokens right now; a load
-            # balancer should retry elsewhere/later.
-            return 503, {"error": str(e), "retry": True}
+            # Paged-KV capacity shed: same retry contract as QueueFull,
+            # but "exhausted" marks it apart — a wedged-full pool can
+            # shed FOREVER (leaked refcounts, stuck long requests), so
+            # the router still counts these against the circuit breaker
+            # where a policy shed (queue/brownout, transient by
+            # construction) does not.
+            return 503, {
+                "error": str(e), "retry": True, "shed": True,
+                "exhausted": True,
+            }
         except DeadlineExceeded as e:
             return 504, {"error": str(e)}
         except ValueError as e:
@@ -295,13 +320,20 @@ class ServingFrontend:
             "active_requests": (
                 len(batcher._active) + len(batcher._prefilling)
             ),
-            "queue_depth": batcher._q.qsize(),
+            "queue_depth": batcher.queue_depth(),
             "slots": engine.pool.num_slots,
             "kv_occupancy": engine.pool.occupancy,
             "post_warmup_recompiles": engine.post_warmup_recompiles(),
             "warmed": engine.warmed,
         }
         body["role"] = getattr(engine.cfg, "role", "mixed")
+        # Brownout state (ISSUE 13): the router's probe and the
+        # autoscaler both read the level here — a browning-out replica
+        # is visible to the fleet BEFORE it sheds interactive traffic.
+        body["brownout_level"] = int(batcher.brownout_level)
+        body["brownout_transitions"] = int(
+            batcher._overload.transitions()
+        )
         paged = getattr(engine.pool, "paged_stats", None)
         if callable(paged):
             stats = paged()
@@ -318,6 +350,9 @@ class ServingFrontend:
             body["prefix_blocks"] = d["blocks"]
             body["prefix_chains"] = d["chains"]
             body["prefix_digest"] = d["keys"]
+            # ISSUE 13 satellite: say when the digest is capped, so
+            # affinity misses on very large caches are diagnosable.
+            body["digest_truncated"] = bool(d.get("truncated"))
         wd = batcher._watchdog
         if wd is not None:
             status = wd.status()
@@ -527,7 +562,7 @@ def run_until_preempted(
             time.sleep(poll_s)
         log.warning(
             "preemption requested: draining %d active + %d queued requests",
-            len(batcher._active), batcher._q.qsize(),
+            len(batcher._active), batcher.queue_depth(),
         )
         batcher.registry.counter("serving/preemptions").inc()
         batcher.close(drain=True, timeout=drain_timeout_s)

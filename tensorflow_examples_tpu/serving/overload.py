@@ -1,0 +1,238 @@
+"""Brownout overload controller: graceful degradation under load
+(ISSUE 13 tentpole (2)).
+
+PR 9 made replica *failure* a normal input; this module does the same
+for *load*. When a flash crowd outruns the fleet, the failure mode
+must not be undifferentiated 503s for everyone — it must be an ordered
+ladder of cheapened service, walked one rung at a time and walked back
+down as pressure clears:
+
+    level 0  normal
+    level 1  shed batch       — new batch-class submits are load-shed
+                                (503, retryable); interactive flows
+    level 2  cap tokens       — + generate requests are capped at
+                                ``brownout_max_new_tokens`` (streams
+                                stay a PREFIX of the uncapped stream —
+                                ``truncated="brownout"`` says so)
+    level 3  no speculation   — + the batcher skips draft/verify work
+                                (plain 1-token decode steps: less
+                                compute per step, same tokens)
+    level 4  shed interactive — + new interactive submits are shed:
+                                the last rung before falling over
+
+The controller is a pure host-side state machine the batcher loop
+ticks once per iteration with the signals the ISSUE names — queue
+depth, KV occupancy, and a recent-window TTFT p95 — and it applies
+**hysteresis** in both directions: one rung per ``hold_s`` on the way
+up (an overloaded tick escalates progressively, not 0->4), and a rung
+down only after every signal has stayed below the clear watermark
+(``clear_frac`` x its high watermark) for a full ``hold_s``. Every
+transition is counted (``serving/brownout_transitions_total``),
+logged, gauged (``serving/brownout_level``) and kept in ``events`` for
+the acceptance tier; the frontend's ``/health`` exposes the level so
+the router (and the autoscaler reading the router's view) can see a
+browning-out replica before it sheds.
+
+Wired knobs live on ``ServeConfig`` (``brownout*``); the controller is
+off by default — ``serve_bench --traffic`` and the overload tier turn
+it on explicitly.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+# The ladder, in escalation order. Index == level.
+LADDER = (
+    "normal",            # 0
+    "shed_batch",        # 1
+    "cap_tokens",        # 2
+    "no_spec",           # 3
+    "shed_interactive",  # 4
+)
+MAX_LEVEL = len(LADDER) - 1
+
+# Level thresholds the enforcement sites key on.
+LEVEL_SHED_BATCH = 1
+LEVEL_CAP_TOKENS = 2
+LEVEL_NO_SPEC = 3
+LEVEL_SHED_INTERACTIVE = 4
+
+# Recent-window TTFT samples kept for the p95 signal.
+_TTFT_WINDOW = 256
+_TTFT_WINDOW_S = 5.0
+
+# Transition-event tail kept for observability. A replica flapping at
+# the hysteresis boundary transitions ~2/hold_s forever; the durable
+# count lives in _transitions + the registry counter, so the event
+# list can stay bounded in a weeks-long serving process.
+_MAX_EVENTS = 4096
+
+
+class OverloadController:
+    """The brownout ladder as a tickable state machine.
+
+    Single-writer by design: :meth:`update` runs on the batcher loop
+    thread. ``level`` reads are lock-free int loads (submit() on
+    frontend threads reads it), ``note_ttft`` takes the small sample
+    lock only.
+    """
+
+    def __init__(
+        self,
+        *,
+        registry,
+        enabled: bool = True,
+        queue_hi: int = 16,
+        kv_hi: float = 0.92,
+        ttft_hi_s: float = 0.0,      # 0 disables the TTFT signal
+        clear_frac: float = 0.5,
+        hold_s: float = 0.5,
+        max_new_tokens_cap: int = 8,
+        clock=time.monotonic,
+    ):
+        self.registry = registry
+        self.enabled = bool(enabled)
+        self.queue_hi = max(1, int(queue_hi))
+        self.kv_hi = float(kv_hi)
+        self.ttft_hi_s = float(ttft_hi_s)
+        self.clear_frac = float(clear_frac)
+        self.hold_s = float(hold_s)
+        self.max_new_tokens_cap = max(1, int(max_new_tokens_cap))
+        self._clock = clock
+        self.level = 0
+        # (wall_unix, from_level, to_level, reason) — the acceptance
+        # tier asserts engage-then-clear off this. Bounded: the oldest
+        # half is dropped past _MAX_EVENTS; _transitions keeps the
+        # full count.
+        self.events: list[tuple[float, int, int, str]] = []
+        self._transitions = 0
+        # Backdated one hold: the FIRST hot tick escalates immediately;
+        # the hold paces successive rungs, not the initial reaction.
+        self._last_change = clock() - self.hold_s
+        self._clear_since: float | None = None
+        self._ttft_lock = threading.Lock()
+        self._ttft: collections.deque = collections.deque(
+            maxlen=_TTFT_WINDOW
+        )
+        registry.gauge("serving/brownout_level").set(0)
+
+    # --------------------------------------------------------- signals
+
+    def note_ttft(self, value_s: float) -> None:
+        """Feed one TTFT observation (the batcher calls this where it
+        records the TTFT histogram)."""
+        with self._ttft_lock:
+            self._ttft.append((self._clock(), float(value_s)))
+
+    def ttft_p95(self, window_s: float = _TTFT_WINDOW_S) -> float | None:
+        """p95 over the TTFT samples of the last ``window_s`` seconds
+        (None with no recent sample) — a *recent* pressure signal, not
+        the run-cumulative histogram."""
+        cutoff = self._clock() - window_s
+        with self._ttft_lock:
+            vals = sorted(v for t, v in self._ttft if t >= cutoff)
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(0.95 * len(vals)))]
+
+    # ----------------------------------------------------- enforcement
+
+    def sheds(self, slo: str) -> bool:
+        """Does the current level shed NEW submits of this class?"""
+        if slo == "batch":
+            return self.level >= LEVEL_SHED_BATCH
+        return self.level >= LEVEL_SHED_INTERACTIVE
+
+    def max_new_cap(self) -> int | None:
+        """Generation-budget cap at the current level (None = no cap)."""
+        if self.level >= LEVEL_CAP_TOKENS:
+            return self.max_new_tokens_cap
+        return None
+
+    def spec_disabled(self) -> bool:
+        """Level 3+: skip speculation's extra verify compute."""
+        return self.level >= LEVEL_NO_SPEC
+
+    # ------------------------------------------------------------ tick
+
+    def update(self, *, queue_depth: int, kv_occupancy: float) -> int:
+        """One controller tick (batcher loop thread). Returns the
+        (possibly changed) level."""
+        if not self.enabled:
+            return 0
+        now = self._clock()
+        p95 = self.ttft_p95() if self.ttft_hi_s > 0 else None
+        hot_reasons = []
+        if queue_depth >= self.queue_hi:
+            hot_reasons.append(
+                f"queue_depth {queue_depth} >= {self.queue_hi}"
+            )
+        if kv_occupancy >= self.kv_hi:
+            hot_reasons.append(
+                f"kv_occupancy {kv_occupancy:.2f} >= {self.kv_hi:.2f}"
+            )
+        if p95 is not None and p95 >= self.ttft_hi_s:
+            hot_reasons.append(
+                f"ttft_p95 {p95:.3f}s >= {self.ttft_hi_s:.3f}s"
+            )
+        clear = (
+            queue_depth <= self.clear_frac * self.queue_hi
+            and kv_occupancy <= self.clear_frac * self.kv_hi
+            and (
+                self.ttft_hi_s <= 0
+                or p95 is None
+                or p95 <= self.clear_frac * self.ttft_hi_s
+            )
+        )
+        if hot_reasons:
+            self._clear_since = None
+            if (
+                self.level < MAX_LEVEL
+                and now - self._last_change >= self.hold_s
+            ):
+                self._step(+1, "; ".join(hot_reasons), now)
+        elif clear and self.level > 0:
+            if self._clear_since is None:
+                self._clear_since = now
+            elif now - self._clear_since >= self.hold_s:
+                self._step(-1, "pressure cleared", now)
+                self._clear_since = now  # a full hold per rung down
+        else:
+            self._clear_since = None
+        return self.level
+
+    def _step(self, delta: int, reason: str, now: float) -> None:
+        old, new = self.level, self.level + delta
+        self.level = new
+        self._last_change = now
+        self._transitions += 1
+        self.events.append((time.time(), old, new, reason))
+        if len(self.events) > _MAX_EVENTS:
+            del self.events[: _MAX_EVENTS // 2]
+        reg = self.registry
+        reg.counter("serving/brownout_transitions_total").inc()
+        if delta > 0:
+            reg.counter("serving/brownout_escalations_total").inc()
+        reg.gauge("serving/brownout_level").set(new)
+        msg = (
+            "BROWNOUT level %d -> %d (%s): %s",
+            old, new, LADDER[new], reason,
+        )
+        if delta > 0:
+            log.warning(*msg)
+        else:
+            log.info(*msg)
+
+    # ------------------------------------------------------------ stats
+
+    def transitions(self) -> int:
+        # max() keeps harness-injected events (tests seed the list
+        # directly) counted alongside real _step transitions after the
+        # event tail starts dropping.
+        return max(self._transitions, len(self.events))

@@ -530,16 +530,21 @@ class PagedKVPool:
         (distinct chain heads). Keys are pure functions of token
         content, so the digest is stable across ``reset()`` and replica
         restarts — the property the router's affinity match relies on
-        (test-pinned)."""
+        (test-pinned). ``truncated`` says the cap actually bit (ISSUE
+        13 satellite): on a very large cache the shed tail keys can
+        never win an affinity match, so the flag makes those misses
+        diagnosable on ``/health`` instead of invisible."""
         with self._lock:
             items = sorted(
                 self._chain_hash.items(),
                 key=lambda kv: (self._chain_depth[kv[0]], kv[1]),
             )
+            truncated = len(items) > max_keys
             return {
                 "keys": [h for _, h in items[:max_keys]],
                 "blocks": len(self._cache),
                 "chains": self._chains_locked(),
+                "truncated": truncated,
             }
 
     # -------------------------------------------------- byte accounting
@@ -593,4 +598,11 @@ class PagedKVPool:
             # the router's /replicas summary aggregates fleet-wide.
             "prefix_blocks": published,
             "prefix_chains": chains,
+            # Schema v10 (ISSUE 13 satellite): 1 when the published
+            # /health digest is capped below the cached chain set —
+            # affinity misses on the shed tails are expected, not a
+            # routing bug.
+            "digest_truncated": int(
+                published > scheduler.DIGEST_MAX_KEYS
+            ),
         }

@@ -62,6 +62,19 @@ tier, deliberately stdlib-only like every HTTP surface in the repo:
   abandoned (``router/hedges_total`` / ``hedge_wins_total`` /
   ``hedge_cancelled_total``). Requests are idempotent-by-seeding, so
   hedging can never produce divergent streams — it only caps p99.
+* **Fleet-down fast-fail** (ISSUE 13 satellite) — when not one replica
+  is eligible and at least one is hard-down (every breaker open, probes
+  failing, quarantined), requests shed immediately with their own
+  counter (``router/fleet_down_total``) instead of burning
+  ``retry_budget_s`` each rediscovering the same dead fleet; a
+  fully-drained fleet (operator rollout, no failure) still gets the
+  plain no-replica 503.
+* **Elastic fleet verbs** (ISSUE 13) — ``add_replica(url)`` /
+  ``remove_replica(url)`` let the autoscaler
+  (``serving/supervisor.py``) resize the fleet at runtime; the probe
+  also learns each replica's ``brownout_level`` from ``/health``, so
+  the router's ``/health``/``/replicas``/stats line carry the fleet
+  overload view (worst level, summed transitions).
 * **Supervision hooks** — ``quarantine(url)`` / ``readmit(url)`` let
   ``serving/supervisor.py`` rotate a dead replica out while it is
   restarted and re-warmed, and re-admit it only after its ``/health``
@@ -216,6 +229,12 @@ class ReplicaState:
         self.prefix_digest: frozenset = frozenset()
         self.prefix_blocks = 0
         self.prefix_chains = 0
+        # Overload state (ISSUE 13), probe-sourced: the replica's
+        # brownout ladder level, its transition count, and its
+        # digest-truncation flag.
+        self.brownout_level = 0
+        self.brownout_transitions = 0
+        self.digest_truncated = False
         # Circuit breaker (ISSUE 10). States: "closed" (normal),
         # "open" (ejected — no dispatch until the cooldown expires),
         # "half_open" (cooldown expired — exactly ONE trial in flight
@@ -270,6 +289,8 @@ class ReplicaState:
             "role": self.role,
             "prefix_blocks": self.prefix_blocks,
             "prefix_chains": self.prefix_chains,
+            "brownout_level": self.brownout_level,
+            "digest_truncated": self.digest_truncated,
             "drained": self.drained,
             "draining_remote": self.draining_remote,
             "quarantined": self.quarantined,
@@ -424,10 +445,13 @@ class Router:
                     if isinstance(v, (int, float)):
                         setattr(r, field, float(v))
                 for field in ("slots", "post_warmup_recompiles",
-                              "prefix_blocks", "prefix_chains"):
+                              "prefix_blocks", "prefix_chains",
+                              "brownout_level",
+                              "brownout_transitions"):
                     v = body.get(field)
                     if isinstance(v, (int, float)):
                         setattr(r, field, int(v))
+                r.digest_truncated = bool(body.get("digest_truncated"))
                 # Cache-aware scheduling fields (ISSUE 12) — absent on
                 # dense-pool or pre-ISSUE-12 replicas, which simply
                 # never win an affinity preference.
@@ -486,6 +510,47 @@ class Router:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+    # ------------------------------------------------ elastic fleet (ISSUE 13)
+
+    def add_replica(self, url: str,
+                    set_name: str = "base") -> ReplicaState:
+        """Register a replica at runtime (the autoscaler's scale-up
+        verb). Idempotent per URL. The replica list is replaced
+        copy-on-write, so the probe sweep and pick() iterate a stable
+        snapshot without holding the lock."""
+        url = url.rstrip("/")
+        with self._lock:
+            for r in self.replicas:
+                if r.url == url:
+                    return r
+            r = ReplicaState(url, set_name)
+            self.replicas = self.replicas + [r]
+            self.has_canary = any(
+                rep.set_name == "canary" for rep in self.replicas
+            )
+        self.registry.counter("router/replicas_added_total").inc()
+        log.info("replica %s added (fleet now %d)", url,
+                 len(self.replicas))
+        return r
+
+    def remove_replica(self, url: str) -> bool:
+        """Deregister a replica at runtime (the autoscaler's
+        scale-down verb — callers drain first; removal itself never
+        cancels anything)."""
+        url = url.rstrip("/")
+        with self._lock:
+            keep = [r for r in self.replicas if r.url != url]
+            if len(keep) == len(self.replicas):
+                return False
+            self.replicas = keep
+            self.has_canary = any(
+                r.set_name == "canary" for r in self.replicas
+            )
+        self.registry.counter("router/replicas_removed_total").inc()
+        log.info("replica %s removed (fleet now %d)", url,
+                 len(self.replicas))
+        return True
 
     # ---------------------------------------------------------- rollout
 
@@ -619,6 +684,30 @@ class Router:
             self.registry.counter("router/affinity_hits_total").inc()
         return best
 
+    def fleet_down(self) -> bool:
+        """True when NOT ONE replica is eligible AND at least one is
+        hard-down — breaker open, probe-failed, or quarantined (ISSUE
+        13 satellite). The fast-fail check: a total outage must shed
+        each request in milliseconds, not burn ``retry_budget_s`` per
+        queued request rediscovering the same dead fleet. A fleet
+        that is merely drained everywhere (an operator rollout, no
+        failure anywhere) is NOT an outage — that stays the plain
+        no-replica 503."""
+        now = time.monotonic()
+        hard_down = False
+        with self._lock:
+            for r in self.replicas:
+                r.breaker_poll(now)
+                if r.eligible(self.cfg.unhealthy_after, now):
+                    return False
+                if (
+                    r.quarantined
+                    or r.failures >= self.cfg.unhealthy_after
+                    or r.breaker == "open"
+                ):
+                    hard_down = True
+        return hard_down
+
     def _route_set(self) -> str | None:
         """Which set this request goes to (None = no split): the canary
         set receives ``canary_fraction`` of traffic, interleaved
@@ -647,12 +736,18 @@ class Router:
                 )
 
     def _note_failure(self, r: ReplicaState, *, transport: bool,
-                      draining: bool, breaker: bool = True) -> None:
+                      draining: bool, breaker: bool = True,
+                      shed: bool = False) -> None:
         """Book one dispatch failure. ``transport`` also bumps the
         probe-failure count (the replica may be gone); ``draining``
         marks the replica's own drain instead of tripping the breaker
-        (an orderly drain is not a fault); ``breaker=False`` for 4xx
-        replies (the request's fault, not the replica's)."""
+        (an orderly drain is not a fault); ``shed`` marks a POLICY 503
+        (queue full / brownout — the replica answered, it is alive and
+        healthy, just overloaded: under a flash crowd the breaker
+        tripping on sheds would eject the whole fleet and turn correct
+        batch-class shedding into an interactive outage, ISSUE 13);
+        ``breaker=False`` for 4xx replies (the request's fault, not the
+        replica's)."""
         now = time.monotonic()
         with self._lock:
             r.errors += 1
@@ -661,6 +756,17 @@ class Router:
             if draining:
                 r.draining_remote = True
                 r.half_open_trial = False
+                return
+            if shed:
+                # An answered shed is PROOF of life: reset the breaker
+                # streak (a replica alternating sheds and transport
+                # errors is flapping, not dispatch-failing) and release
+                # any half-open trial so the probe path can readmit.
+                r.consec_errors = 0
+                r.half_open_trial = False
+                self.registry.counter(
+                    "router/replica_sheds_total"
+                ).inc()
                 return
             if not breaker:
                 return
@@ -701,6 +807,14 @@ class Router:
             self._note_failure(
                 r, transport=(status == 0),
                 draining=bool(reply.get("draining")),
+                # A policy shed (queue/brownout) is breaker-exempt; a
+                # KV-exhaustion shed is NOT — a wedged-full pool sheds
+                # forever and must still be ejectable.
+                shed=(
+                    status == 503
+                    and bool(reply.get("shed"))
+                    and not reply.get("exhausted")
+                ),
             )
         else:
             # The replica ANSWERED (400/404/500/504): never re-run the
@@ -906,6 +1020,20 @@ class Router:
         reg = self.registry
         reg.counter("router/requests_total").inc()
         t0 = time.monotonic()
+        if self.fleet_down():
+            # Fast-fail (ISSUE 13 satellite): a fleet-wide outage
+            # sheds NOW — no per-request retry-budget burn, no backoff
+            # loop rediscovering the same dead fleet. Its own counter
+            # so an operator can tell "total outage" from "one replica
+            # briefly unpickable".
+            reg.counter("router/fleet_down_total").inc()
+            reply = {
+                "error": "no healthy replica (fleet-wide outage)",
+                "retry": True, "shed": True, "fleet_down": True,
+            }
+            self._set_stats["base"].record(503, reply)
+            reg.histogram("router/e2e").record(time.monotonic() - t0)
+            return 503, reply
         prompt = self._clean_prompt(body)
         key_cache: dict = {}  # prompt chain keys, hashed once per request
         if kind == "generate" and prompt is not None \
@@ -941,6 +1069,18 @@ class Router:
                 r = self.pick(exclude=tuple(tried), prompt=prompt,
                               key_cache=key_cache)
             if r is None:
+                if self.fleet_down():
+                    # Mid-retry total outage (e.g. the last survivor's
+                    # breaker just opened): shed immediately — the
+                    # wait-and-rescan below exists for TRANSIENT
+                    # ineligibility, not a dead fleet.
+                    reg.counter("router/fleet_down_total").inc()
+                    status, reply = 503, {
+                        "error": "no healthy replica (fleet-wide "
+                                 "outage)",
+                        "retry": True, "shed": True, "fleet_down": True,
+                    }
+                    break
                 if (
                     tried
                     and attempts <= self.cfg.max_retries
@@ -959,6 +1099,7 @@ class Router:
                 reg.counter("router/no_replica_total").inc()
                 status, reply = 503, {
                     "error": "no live replica available", "retry": True,
+                    "shed": True,
                 }
                 break
             tried.append(r)
@@ -1072,6 +1213,19 @@ class Router:
             "prefix_chains": int(
                 sum(r.prefix_chains for r in probed)
             ),
+            # --- v10 (ISSUE 13): fleet overload view — the WORST
+            # replica's brownout level (one browning-out replica is an
+            # incident, not an average), summed transitions, and
+            # whether any affinity digest is capped.
+            "brownout_level": int(
+                max((r.brownout_level for r in probed), default=0)
+            ),
+            "brownout_transitions": int(
+                sum(r.brownout_transitions for r in probed)
+            ),
+            "digest_truncated": int(
+                any(r.digest_truncated for r in probed)
+            ),
         }
         return {
             "schema_version": schema.SERVING_SCHEMA_VERSION,
@@ -1098,8 +1252,33 @@ class Router:
             "replicas": len(self.replicas),
             "eligible": len(eligible),
             "sets": sorted({r.set_name for r in self.replicas}),
+            # Fleet overload view (ISSUE 13): worst replica's brownout
+            # level + fleet-summed transition count, and the fast-fail
+            # outage counter — the operator's "is the fleet browning
+            # out or down" one-liner.
+            "brownout_max": int(max(
+                (r.brownout_level for r in self.replicas), default=0
+            )),
+            "brownout_transitions": int(sum(
+                r.brownout_transitions for r in self.replicas
+            )),
+            "fleet_down_total": int(
+                self.registry.counter_values().get(
+                    "router/fleet_down_total", 0
+                )
+            ),
+            "digest_truncated": bool(any(
+                r.digest_truncated for r in self.replicas
+            )),
         }
         return (200 if body["ok"] else 503), body
+
+
+class _RouterHTTPServer(http.server.ThreadingHTTPServer):
+    # The fleet's front door: a flash crowd's connection burst must
+    # reach the dispatcher (which sheds by POLICY), not bounce off the
+    # stdlib's 5-entry accept backlog as transport failures (ISSUE 13).
+    request_queue_size = 128
 
 
 class RouterFrontend:
@@ -1224,7 +1403,7 @@ class RouterFrontend:
             def log_message(self, fmt, *args):  # quiet under load
                 log.debug("router frontend: " + fmt, *args)
 
-        self._httpd = http.server.ThreadingHTTPServer(
+        self._httpd = _RouterHTTPServer(
             (self.bind_host, self.requested_port), Handler
         )
         self._httpd.daemon_threads = True

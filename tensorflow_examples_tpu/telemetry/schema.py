@@ -6,7 +6,9 @@ fault-tolerance counters on the router's ``serving`` object, see
 ``SERVING_KEYS_V7``; v8 in ISSUE 11 — speculative-decoding measurement
 keys on the batcher's ``serving`` object, see ``SERVING_KEYS_V8``; v9
 in ISSUE 12 — the prefix-cache summary behind cache-aware fleet
-scheduling, see ``SERVING_KEYS_V9``).
+scheduling, see ``SERVING_KEYS_V9``; v10 in ISSUE 13 — SLO-class
+admission, brownout, and digest-truncation observability, see
+``SERVING_KEYS_V10``).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -133,9 +135,19 @@ SCHEMA_VERSION = 5
 # numeric. The batcher stamps a paged replica's own counts; the router
 # stamps the probe-summed fleet totals. Forbidden on v4-v8 serving
 # lines, same mislabeling rule as every earlier bump.
-SERVING_SCHEMA_VERSION = 9
+#
+# Version 10 (ISSUE 13): additive — an overload-aware serving line may
+# carry the SLO-class split (per-class queue-wait/TTFT/TPOT p95s and
+# shed counters, batch preemptions), the brownout controller's state
+# (brownout_level / brownout_transitions), and the paged pool's
+# digest_truncated flag (0/1 — the affinity digest hit its cap, so
+# affinity misses on very large caches are diagnosable). The batcher
+# stamps its own numbers; the router stamps the fleet view (max
+# brownout level, summed transitions). Forbidden on v4-v9 serving
+# lines, same mislabeling rule as every earlier bump.
+SERVING_SCHEMA_VERSION = 10
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -199,6 +211,20 @@ SERVING_KEYS_V8 = ("accepted_per_step", "draft_hit_rate", "spec_k")
 # digest's size) and distinct chain heads. Optional on write (a
 # dense-pool line carries neither), FORBIDDEN on v4-v8 serving lines.
 SERVING_KEYS_V9 = ("prefix_blocks", "prefix_chains")
+
+# v10-only serving-object keys (ISSUE 13): the overload story — the
+# SLO-class split (interactive vs batch latency p95s, per-class shed
+# counters, batch preemptions), the brownout ladder's state, and the
+# paged pool's digest-truncation flag. All numeric; optional on write
+# (a pre-overload line carries none), FORBIDDEN on v4-v9 serving
+# lines, same mislabeling rule as every earlier bump.
+SERVING_KEYS_V10 = (
+    "queue_wait_p95_interactive", "queue_wait_p95_batch",
+    "ttft_p95_interactive", "ttft_p95_batch",
+    "tpot_p95_interactive", "tpot_p95_batch",
+    "shed_interactive", "shed_batch", "preempted_batch",
+    "brownout_level", "brownout_transitions", "digest_truncated",
+)
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -479,6 +505,13 @@ def validate_line(obj: Any) -> list[str]:
                     if key in obj["serving"]:
                         problems.append(
                             f"v9 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
+            if version < 10:
+                for key in SERVING_KEYS_V10:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v10 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
     elif "serving" in obj:

@@ -367,6 +367,104 @@ class TestProcessSupervision:
             rep.close()
 
 
+# ------------------------------------------- crash-loop abandonment
+
+
+class TestCrashLoopAbandonment:
+    """ISSUE 13 satellite: a replica that exhausts ``max_restarts``
+    while traffic is in flight stays quarantined — the router never
+    re-dispatches to it, and its in-flight requests fail over
+    token-identically."""
+
+    @pytest.mark.timeout(120)
+    def test_crash_looping_replica_abandoned_under_load(
+        self, serve_faults
+    ):
+        serve_faults("crash@0:2")
+        builds = [0]
+
+        def flaky_factory():
+            # First build (fleet start) succeeds; every supervisor
+            # restart of this replica fails — a crash-looping build.
+            builds[0] += 1
+            if builds[0] > 1:
+                raise RuntimeError("crash-looping build")
+            return _FakeEngine(step_delay=0.005, replica_id=0)
+
+        fleet = ChaosFleet(
+            [flaky_factory,
+             lambda: _FakeEngine(step_delay=0.005, replica_id=1)],
+            router_cfg=RouterConfig(
+                probe_interval_s=0.05, retry_budget_s=20.0,
+                max_retries=4, eject_after=1, eject_cooldown_s=0.5,
+            ),
+            supervisor_kw=dict(
+                poll_s=0.05, health_stall_s=2.0, warm_timeout_s=30.0,
+                max_restarts=2, restart_backoff_s=0.01,
+            ),
+        )
+        fleet.start()
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            import serve_bench
+
+            url = rfront.url("/generate")
+            n, max_new = 10, 4
+            prompts = [[3 * i + 1] for i in range(n)]
+            # Concurrent load across the kill: replica 0 dies
+            # mid-decode (crash@0:2) and every restart attempt fails.
+            out = serve_bench.drive(
+                None, prompts, concurrency=4, max_new=max_new,
+                temperature=0.0, top_k=0, http_url=url, timeout=30.0,
+            )
+            vocab = fleet.replicas[1].engine.model_cfg.vocab_size
+            for prompt, reply in zip(prompts, out["replies"]):
+                assert reply is not None and reply[0] == 200, reply
+                # Token-identical failover: the fake stream is a pure
+                # function of the prompt, so a replayed victim matches.
+                assert reply[1]["tokens"] == [
+                    (prompt[-1] + 1 + j) % vocab for j in range(max_new)
+                ]
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/failovers_total", 0) >= 1
+            # The supervisor exhausts max_restarts and gives up.
+            url0 = fleet.replicas[0].url
+            deadline = time.monotonic() + 30
+            while (
+                url0 not in fleet.supervisor.given_up
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert url0 in fleet.supervisor.given_up
+            events = [
+                e for u, e in fleet.supervisor.events if u == url0
+            ]
+            assert events[0] == "detected"
+            assert events[-1] == "gave_up"
+            assert "readmitted" not in events
+            # Abandoned = quarantined, ineligible, never restarted.
+            state0 = fleet.router._find(url0)
+            assert state0.quarantined
+            assert not state0.eligible(fleet.router.cfg.unhealthy_after)
+            assert fleet.supervisor.restarts[url0] == 0
+            assert counters.get("router/restarts_total", 0) == 0
+            # The router never re-dispatches to the abandoned replica:
+            # follow-up traffic serves 200 off the survivor alone.
+            dispatched_before = state0.dispatched
+            for i in range(4):
+                status, reply = _post(
+                    url, {"prompt": [50 + i], "max_new_tokens": 2}
+                )
+                assert status == 200
+                assert reply["tokens"] == [
+                    (50 + i + 1 + j) % vocab for j in range(2)
+                ]
+            assert state0.dispatched == dispatched_before
+        finally:
+            rfront.close()
+            fleet.close()
+
+
 # --------------------------------------------------- THE chaos golden
 
 

@@ -249,7 +249,7 @@ class TestPrefixDigest:
         self._publish(pool, list(range(20)))
         pool.reallocate()
         assert pool.prefix_digest() == {
-            "keys": [], "blocks": 0, "chains": 0
+            "keys": [], "blocks": 0, "chains": 0, "truncated": False,
         }
 
 
@@ -736,7 +736,7 @@ class TestSchemaV9:
         batcher = ContinuousBatcher(engine)
         line = json.loads(json.dumps(batcher.stats_line()))
         assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION
-        assert line["schema_version"] == 9
+        assert line["schema_version"] == 10
         assert schema.validate_line(line) == []
         assert line["serving"]["prefix_blocks"] == 0
         assert line["serving"]["prefix_chains"] == 0

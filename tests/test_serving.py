@@ -541,7 +541,7 @@ class TestBatcherFlowControl:
             if b._staged:
                 break
             time.sleep(0.005)
-        assert b._staged == 1 and b._q.empty() and not b._active
+        assert b._staged == 1 and not b.queue_depth() and not b._active
         closer = threading.Thread(
             target=lambda: b.close(drain=True, timeout=10)
         )
@@ -559,7 +559,7 @@ class TestBatcherFlowControl:
         dead batcher."""
         eng = _FakeEngine()
         b = ContinuousBatcher(eng)  # never started
-        orig_put = b._q.put_nowait
+        orig_put = b._queues["interactive"].put_nowait
 
         def racing_put(item):  # close() lands between enqueue + recheck
             orig_put(item)
@@ -569,24 +569,24 @@ class TestBatcherFlowControl:
             # recheck must defer to it rather than double-resolve.
             b._fail_pending(Draining("shut down"))
 
-        b._q.put_nowait = racing_put
+        b._queues["interactive"].put_nowait = racing_put
         fut = b.submit(Request(prompt=[1], max_new_tokens=1))
         with pytest.raises(Draining):
             fut.result(timeout=5)
         # And the variant where the sweep already ran BEFORE the
         # enqueue: submit itself must remove + reject.
         b2 = ContinuousBatcher(eng)
-        orig_put2 = b2._q.put_nowait
+        orig_put2 = b2._queues["interactive"].put_nowait
 
         def racing_put2(item):
             orig_put2(item)
             b2._draining = True
             b2._stop.set()
 
-        b2._q.put_nowait = racing_put2
+        b2._queues["interactive"].put_nowait = racing_put2
         with pytest.raises(Draining):
             b2.submit(Request(prompt=[1], max_new_tokens=1))
-        assert b2._q.empty()
+        assert not b2.queue_depth()
 
     def test_close_without_drain_fails_queued(self):
         """A request still in the queue at shutdown gets Draining — a

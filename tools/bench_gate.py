@@ -101,6 +101,16 @@ RECORD_KEYS: dict[str, str] = {
     # eats the speedup fails CI like any other perf loss.
     "tpot_speedup": "min",
     "draft_hit_rate": "min",
+    # Overload robustness (ISSUE 13): serve_bench --traffic banks the
+    # serve_traffic record — per-class latency maxima (the SLO split
+    # the admission tier exists for), the interactive shed rate pinned
+    # as a maximum (interactive must not absorb an overload batch
+    # could have), and the autoscaler's scale-up latency (decision ->
+    # green -> routed) as a maximum.
+    "ttft_p95_interactive_ms": "max",
+    "ttft_p95_batch_ms": "max",
+    "shed_rate_interactive": "max",
+    "scale_up_latency_s": "max",
 }
 
 

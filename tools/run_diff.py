@@ -84,6 +84,13 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     # ---- cache-aware scheduling records (ISSUE 12) ----
     ("prefix_hit_rate_affinity", "higher", "", 1.0),
     ("affinity_hit_gain", "higher", "", 1.0),
+    # ---- overload/traffic records (ISSUE 13) ----
+    ("ttft_p95_interactive_ms", "lower", "ms", 1.0),
+    ("ttft_p95_batch_ms", "lower", "ms", 1.0),
+    ("shed_rate_interactive", "lower", "", 1.0),
+    ("shed_rate_batch", "lower", "", 1.0),
+    ("scale_up_latency_s", "lower", "s", 1.0),
+    ("p95_during_resize_ms", "lower", "ms", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -111,6 +118,11 @@ GATE_KEYS = (
     "draft_hit_rate",
     # cache-aware scheduling gate keys (ISSUE 12)
     "prefix_hit_rate_affinity",
+    # overload/traffic gate keys (ISSUE 13)
+    "ttft_p95_interactive_ms",
+    "ttft_p95_batch_ms",
+    "shed_rate_interactive",
+    "scale_up_latency_s",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

@@ -80,6 +80,22 @@ Modes:
   post-warmup recompiles across both engines. ``bench_gate`` gates
   ``tpot_speedup`` as a stamped minimum.
 
+* ``--traffic {ramp,flash,diurnal}`` (ISSUE 13) — the replayable
+  open-loop traffic model: seeded exponential arrivals at a per-mode
+  rate profile, heavy-tail prompt lengths, a seeded interactive/batch
+  SLO mix, driven open-loop (arrivals never back off) against a
+  brownout-enabled fleet. ``flash`` pins the flash-crowd golden (all
+  shedding on batch, interactive flash TTFT p95 within
+  ``FLASH_TTFT_BUDGET`` x steady, token-identical streams, ladder
+  cleared); ``ramp`` pins the autoscaler golden (1 -> ``--max-
+  replicas`` -> 1, drain-first, ``scale_up_latency_s`` +
+  ``p95_during_resize_ms`` stamped); ``diurnal`` is the long-horizon
+  shape. Banks the ``serve_traffic`` record whose per-class p95s /
+  shed rates / scale-up latency ``bench_gate`` accepts. Same
+  ``--traffic-seed`` = byte-identical scenario — composable with a
+  ``--fault-spec``-style chaos schedule by arming the fault env
+  around the run.
+
 ``--inproc`` skips the HTTP hop (batcher futures driven directly) to
 separate transport cost from engine cost; ``--out`` banks the record
 as a JSON file next to the BENCH_r*.json trajectory.
@@ -322,6 +338,38 @@ def drive(frontend, prompts, *, concurrency: int, max_new: int,
     return {"replies": replies, "client_s": client_s, "wall_s": wall}
 
 
+def tally_replies(replies) -> dict:
+    """Split non-200 outcomes by MEANING (ISSUE 13 satellite): a
+    503 load-shed is correct overload behavior, a 4xx is the request's
+    own fault, and only transport failures / unexpected statuses are
+    ``errors`` — so an overload run with correct shedding doesn't read
+    as a broken fleet, and a chaos record's error_rate-at-0 criterion
+    stays honest about what it counts."""
+    completed = shed = rejected = transport = other = 0
+    for r in replies:
+        if r is None:
+            transport += 1  # the worker never got an answer
+            continue
+        status = r[0]
+        if status == 200:
+            completed += 1
+        elif status == 0:
+            transport += 1
+        elif status == 503:
+            shed += 1
+        elif 400 <= status < 500:
+            rejected += 1
+        else:
+            other += 1
+    return {
+        "completed": completed,
+        "shed_total": shed,
+        "rejected_total": rejected,
+        "transport_errors": transport,
+        "errors": transport + other,
+    }
+
+
 def bench_record(engine, registry, outcome, prompts, *, concurrency,
                  verified, verify_ok, backend) -> dict:
     hists = registry.histogram_summaries()
@@ -336,13 +384,16 @@ def bench_record(engine, registry, outcome, prompts, *, concurrency,
     toks = sum(len(r[1].get("tokens", ())) for r in done)
     wall = outcome["wall_s"]
     counters = registry.counter_values()
-    errors = len(replies) - len(done)
+    tally = tally_replies(replies)
     rec = {
         "bench": "serving",
         "backend": backend,
         "requests": len(prompts),
         "completed": len(done),
-        "errors": errors,
+        "errors": tally["errors"],
+        "shed_total": tally["shed_total"],
+        "rejected_total": tally["rejected_total"],
+        "transport_errors": tally["transport_errors"],
         "concurrency": concurrency,
         "max_slots": engine.cfg.max_slots,
         "wall_s": round(wall, 3),
@@ -372,8 +423,11 @@ def bench_record(engine, registry, outcome, prompts, *, concurrency,
         rec["prefix_hits"] = stats["prefix_hits"]
         rec["prefix_misses"] = stats["prefix_misses"]
         rec["prefix_hit_rate"] = stats["prefix_hit_rate"]
+    # Closed-loop benches must COMPLETE everything — a shed here is a
+    # misconfigured bench, not acceptable overload behavior — but the
+    # record still says which kind of non-200 happened.
     rec["ok"] = bool(
-        errors == 0
+        len(done) == len(replies)
         and verify_ok
         and rec["post_warmup_recompiles"] == 0
     )
@@ -523,7 +577,8 @@ def run_router_bench(args) -> dict:
     done = [r for r in replies if r is not None and r[0] == 200]
     toks = sum(len(r[1].get("tokens", ())) for r in done)
     wall = outcome["wall_s"]
-    errors = len(replies) - len(done)
+    tally = tally_replies(replies)
+    errors = tally["errors"]
 
     def field(name):
         return [r[1].get(name) for r in done]
@@ -555,6 +610,9 @@ def run_router_bench(args) -> dict:
         "requests": len(prompts),
         "completed": len(done),
         "errors": errors,
+        "shed_total": tally["shed_total"],
+        "rejected_total": tally["rejected_total"],
+        "transport_errors": tally["transport_errors"],
         "concurrency": args.concurrency,
         "max_slots": args.max_slots,
         "wall_s": round(wall, 3),
@@ -601,7 +659,7 @@ def run_router_bench(args) -> dict:
         "transport": "router-http",
     }
     rec["ok"] = bool(
-        errors == 0 and verify_ok and recompiles == 0
+        len(done) == len(replies) and verify_ok and recompiles == 0
     )
     return rec
 
@@ -940,13 +998,20 @@ def run_chaos_bench(args) -> dict:
         router = fleet.router
         fleet.close()
 
-    def phase(outcome):
-        replies = outcome["replies"]
-        done = [r for r in replies if r is not None and r[0] == 200]
-        return len(done), len(replies) - len(done)
-
-    base_done, base_errors = phase(base_out)
-    chaos_done, chaos_errors = phase(chaos_out)
+    base_tally = tally_replies(base_out["replies"])
+    chaos_tally = tally_replies(chaos_out["replies"])
+    base_done = base_tally["completed"]
+    chaos_done = chaos_tally["completed"]
+    # ISSUE 13 satellite: error_rate counts transport failures and
+    # unexpected statuses ONLY — a load-shed 503 is stamped separately
+    # (shed_total), so the error_rate-at-0 gate criterion says "no
+    # request was LOST", not "the fleet never shed".
+    base_errors = base_tally["errors"]
+    chaos_errors = chaos_tally["errors"]
+    shed_total = base_tally["shed_total"] + chaos_tally["shed_total"]
+    rejected_total = (
+        base_tally["rejected_total"] + chaos_tally["rejected_total"]
+    )
     base_p95 = _client_p95_ms(base_out)
     chaos_p95 = _client_p95_ms(chaos_out)
     p95_ratio = (
@@ -971,6 +1036,12 @@ def run_chaos_bench(args) -> dict:
         "completed": base_done + chaos_done,
         "errors": errors,
         "error_rate": round(errors / (2 * n), 4),
+        "shed_total": shed_total,
+        "rejected_total": rejected_total,
+        "transport_errors": (
+            base_tally["transport_errors"]
+            + chaos_tally["transport_errors"]
+        ),
         "concurrency": args.concurrency,
         "baseline_e2e_p95_ms": base_p95,
         "chaos_e2e_p95_ms": chaos_p95,
@@ -995,8 +1066,11 @@ def run_chaos_bench(args) -> dict:
         "kv_block_size": kv_block,
         "transport": "router-http",
     }
+    # ok still requires every request SERVED (shed included in the
+    # completeness check — this closed-loop tier must not shed), but
+    # error_rate itself stays an honest lost-request rate.
     rec["ok"] = bool(
-        errors == 0
+        base_done + chaos_done == 2 * n
         and verify_ok
         and restored
         and fired
@@ -1150,6 +1224,610 @@ def run_spec_bench(args) -> dict:
     return rec
 
 
+# ---------------------------------------------------------------------------
+# Replayable traffic model (ISSUE 13 tentpole (4)): "millions of
+# users" as a seeded, deterministic scenario.
+
+# Flash-crowd acceptance budget: interactive TTFT p95 during the flash
+# window must stay within this multiple of the steady-state window's.
+# The golden's 2x — the whole point of SLO classes + brownout is that
+# a 3x arrival spike lands on batch, not on interactive latency.
+FLASH_TTFT_BUDGET = 2.0
+
+
+def traffic_rate_multiplier(mode: str, frac: float,
+                            flash_factor: float) -> float:
+    """Arrival-rate multiplier at request-index fraction ``frac`` of
+    the run — index-based, so the shape is exact for any n and fully
+    deterministic."""
+    if mode == "flash":
+        # Steady -> 3x flash crowd -> steady.
+        return flash_factor if 0.35 <= frac < 0.70 else 1.0
+    if mode == "ramp":
+        # Quiet start -> sustained peak (the scale-up forcing
+        # function) -> cool-down (lets the autoscaler drain back).
+        if frac < 0.10:
+            return 0.3
+        if frac < 0.70:
+            return 1.0
+        return 0.2
+    if mode == "diurnal":
+        # Two "days" of sinusoidal load.
+        import math
+
+        return 0.25 + 0.75 * (
+            0.5 - 0.5 * math.cos(2 * math.pi * 2 * frac)
+        )
+    raise ValueError(f"unknown traffic mode {mode!r}")
+
+
+def traffic_phase(mode: str, frac: float) -> str:
+    if mode == "flash":
+        if frac < 0.35:
+            return "steady"
+        return "flash" if frac < 0.70 else "recover"
+    if mode == "ramp":
+        if frac < 0.10:
+            return "low"
+        return "peak" if frac < 0.70 else "cool"
+    return "diurnal"
+
+
+def make_traffic_schedule(mode: str, n: int, *, rate: float,
+                          vocab: int, max_len: int, max_new: int,
+                          batch_fraction: float = 0.3,
+                          flash_factor: float = 3.0,
+                          seed: int = 0) -> list[dict]:
+    """A seeded OPEN-LOOP arrival schedule: n requests with exponential
+    inter-arrival times at the mode's rate profile, heavy-tail
+    (lognormal) prompt lengths, and a seeded interactive/batch class
+    mix. Same seed -> byte-identical schedule, so every scenario —
+    including a flash crowd composed with a chaos fault spec — replays
+    exactly."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cap = max(4, max_len - max_new)
+    median = max(3, cap // 6)
+    schedule = []
+    t = 0.0
+    for i in range(n):
+        frac = i / max(n - 1, 1)
+        r = rate * traffic_rate_multiplier(mode, frac, flash_factor)
+        t += float(rng.exponential(1.0 / r))
+        ln = int(np.clip(
+            rng.lognormal(mean=np.log(median), sigma=0.9), 1, cap
+        ))
+        schedule.append({
+            "t": t,
+            "prompt": [int(x) for x in rng.integers(0, vocab, (ln,))],
+            "slo": (
+                "batch" if rng.random() < batch_fraction
+                else "interactive"
+            ),
+            "seed": i,
+            "max_new": max_new,
+            "phase": traffic_phase(mode, frac),
+        })
+    return schedule
+
+
+def drive_open_loop(frontend, schedule, *, http_url: str | None,
+                    timeout: float, temperature: float = 0.0,
+                    top_k: int = 0, workers: int | None = None) -> dict:
+    """OPEN-loop driver: requests fire at their scheduled arrival time
+    whether or not earlier ones resolved — the load does not politely
+    back off when the fleet slows down, which is exactly what a flash
+    crowd doesn't do. ``workers`` defaults to one per request (true
+    open loop); an explicit cap can serialize arrivals once every
+    worker is tied up in a slow request, so late fires (> 50 ms behind
+    schedule) are counted in the outcome's ``late_fires`` rather than
+    silently skewing the phase-labeled percentiles. Returns
+    index-aligned replies, client wall times, and each request's fire
+    time (wall clock, for the resize-window percentile)."""
+    import concurrent.futures as cf
+
+    n = len(schedule)
+    if workers is None:
+        workers = min(n, 1024)
+    replies: list = [None] * n
+    client_s: list = [None] * n
+    fired_unix: list = [None] * n
+    late = [0]
+    late_lock = threading.Lock()
+
+    def fire(i: int, ev: dict) -> None:
+        if (time.perf_counter() - t0) - ev["t"] > 0.05:
+            with late_lock:
+                late[0] += 1
+        body = {
+            "prompt": ev["prompt"],
+            "max_new_tokens": ev["max_new"],
+            "temperature": temperature,
+            "top_k": top_k,
+            "seed": ev["seed"],
+            "slo": ev["slo"],
+        }
+        fired_unix[i] = time.time()
+        t_req = time.perf_counter()
+        if http_url is not None:
+            replies[i] = _post_json(http_url, body, timeout)
+        else:
+            replies[i] = frontend.handle_request(body, kind="generate")
+        client_s[i] = time.perf_counter() - t_req
+
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+        for i, ev in enumerate(schedule):
+            delay = ev["t"] - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            pool.submit(fire, i, ev)
+    if late[0]:
+        print(
+            f"# open-loop driver: {late[0]}/{n} requests fired "
+            ">50ms behind schedule (worker saturation)",
+            file=sys.stderr,
+        )
+    return {
+        "replies": replies,
+        "client_s": client_s,
+        "fired_unix": fired_unix,
+        "late_fires": late[0],
+        "wall_s": time.perf_counter() - t0,
+    }
+
+
+def _stream_matches(reply: dict, ref: list) -> bool:
+    """Token-identity under brownout: a level-2-capped stream is a
+    PREFIX of the reference; anything else must match exactly."""
+    toks = reply.get("tokens") or []
+    if reply.get("truncated") == "brownout":
+        return bool(toks) and toks == ref[: len(toks)]
+    return toks == ref
+
+
+def _class_values(outcome, schedule, field: str, *, slo: str,
+                  phases=None) -> list:
+    return [
+        r[1].get(field)
+        for r, ev in zip(outcome["replies"], schedule)
+        if r is not None and r[0] == 200 and ev["slo"] == slo
+        and (phases is None or ev["phase"] in phases)
+    ]
+
+
+def run_traffic_bench(args) -> dict:
+    """``--traffic {ramp,flash,diurnal}`` (ISSUE 13): the replayable
+    million-user traffic model, driven open-loop against a
+    brownout-enabled fleet, banking one ``serve_traffic`` record.
+
+    * ``flash`` — a fixed fleet (default 2 replicas) under a seeded
+      3x flash crowd. The record's headline claims: all shedding lands
+      on the batch class (``shed_interactive == 0``), interactive TTFT
+      p95 during the flash stays within ``FLASH_TTFT_BUDGET`` x the
+      steady window's, every delivered stream token-identical (prefix
+      under a brownout cap) to ``reference_generate``, zero post-warmup
+      recompiles fleet-wide, and the brownout ladder fully cleared by
+      the end of the run.
+    * ``ramp`` — a 1-replica fleet + the telemetry-driven autoscaler
+      (supervisor.Autoscaler over in-proc replicas). The record stamps
+      ``scale_up_latency_s`` (decision -> green -> routed),
+      ``p95_during_resize_ms``, peak replica count, and drain-first
+      scale-down back to min with zero lost requests.
+    * ``diurnal`` — two sinusoidal load "days" over the fixed fleet;
+      the long-horizon stability shape the chaos tier can compose
+      with.
+    """
+    import jax
+
+    from tensorflow_examples_tpu.serving.router import (
+        Router,
+        RouterConfig,
+        RouterFrontend,
+    )
+
+    mode = args.traffic
+    kv_block = args.kv_block_size if args.kv_block_size >= 0 else 16
+    serve_kw = dict(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        kv_block_size=kv_block,
+        kv_dtype=args.kv_dtype,
+        # The whole point of the traffic tier: overload is a
+        # first-class input. Ladder thresholds scale with the slot
+        # count; the hold is short so a CI-scale run can walk the
+        # ladder up AND back down.
+        brownout=True,
+        brownout_queue_hi=max(4, 2 * args.max_slots),
+        brownout_hold_s=0.25,
+        brownout_max_new_tokens=max(2, args.max_new_tokens // 2),
+    )
+    if args.smoke:
+        serve_kw.update(prefill_bucket_floor=16, kv_bucket_floor=32)
+
+    if mode == "ramp":
+        # The ramp's peak must actually OUTRUN one replica or there is
+        # nothing to autoscale: the smoke default arrives well above a
+        # single smoke engine's throughput, so the queue builds, the
+        # ladder engages, and the scale-up golden has a forcing
+        # function (flash/diurnal run at a fixed-fleet rate instead).
+        n = args.requests or (240 if args.smoke else 400)
+        rate = args.rate or (300.0 if args.smoke else 50.0)
+    else:
+        n = args.requests or (60 if args.smoke else 400)
+        rate = args.rate or (25.0 if args.smoke else 50.0)
+    verify = args.verify if args.verify >= 0 else (3 if args.smoke else 0)
+
+    t0 = time.perf_counter()
+    autoscaler = supervisor = None
+    spawned: list = []
+    # Telemetry of replicas the autoscaler scaled DOWN mid-run: stop()
+    # tears the engine/batcher away, so their recompiles, brownout
+    # events, and counters are snapshotted here first — otherwise a
+    # drained replica's numbers silently vanish from the record (and
+    # "zero post-warmup recompiles fleet-wide" could pass falsely).
+    harvest: dict = {"recompiles": 0, "events": [], "counters": {}}
+    if mode == "ramp":
+        from tensorflow_examples_tpu.serving.chaos import InProcReplica
+        from tensorflow_examples_tpu.serving.engine import ServeConfig
+        from tensorflow_examples_tpu.serving.supervisor import (
+            Autoscaler,
+            AutoscalerConfig,
+            Supervisor,
+        )
+        from tensorflow_examples_tpu.telemetry.registry import (
+            MetricsRegistry,
+        )
+
+        def build_engine():
+            reg = MetricsRegistry()
+            cfg = ServeConfig(**serve_kw)
+            if args.workdir:
+                return build_checkpoint_engine(
+                    args.workdir, cfg, registry=reg
+                )
+            return build_smoke_engine(cfg, registry=reg)
+
+        class _HarvestingReplica(InProcReplica):
+            def stop(self):
+                eng, batcher = self.engine, self.batcher
+                if eng is not None:
+                    harvest["recompiles"] += \
+                        eng.post_warmup_recompiles()
+                    for k, v in eng.registry.counter_values().items():
+                        harvest["counters"][k] = \
+                            harvest["counters"].get(k, 0) + v
+                if batcher is not None:
+                    harvest["events"].extend(batcher._overload.events)
+                super().stop()
+
+        first = _HarvestingReplica(build_engine, replica_id=0).start()
+        spawned.append(first)
+        router = Router(
+            [first.url],
+            cfg=RouterConfig(
+                probe_interval_s=0.1, request_timeout_s=args.timeout,
+            ),
+        ).start()
+        supervisor = Supervisor(
+            router, [first], poll_s=0.25, health_stall_s=15.0,
+        ).start()
+
+        def spawn(idx):
+            rep = _HarvestingReplica(
+                build_engine, replica_id=idx
+            ).start()
+            spawned.append(rep)
+            return rep
+
+        autoscaler = Autoscaler(
+            router,
+            supervisor,
+            spawn,
+            cfg=AutoscalerConfig(
+                min_replicas=1,
+                max_replicas=args.max_replicas,
+                target_queue_depth=args.target_queue,
+                hold_s=0.4,
+                scale_down_idle_s=1.0,
+                drain_timeout_s=args.timeout,
+                warm_timeout_s=300.0,
+                evaluate_every_s=0.15,
+            ),
+        ).start()
+        engines = lambda: [  # noqa: E731 - tiny accessor
+            rep.engine for rep in spawned if rep.engine is not None
+        ]
+        regs = lambda: [  # noqa: E731
+            rep.engine.registry for rep in spawned
+            if rep.engine is not None
+        ]
+        batchers = lambda: [  # noqa: E731
+            rep.batcher for rep in spawned if rep.batcher is not None
+        ]
+        n_initial = 1
+    else:
+        replicas = build_replica_stacks(args, serve_kw, args.replicas)
+        router = Router(
+            [f"http://127.0.0.1:{fe.port}" for _, _, fe, _ in replicas],
+            cfg=RouterConfig(
+                probe_interval_s=0.1, request_timeout_s=args.timeout,
+            ),
+        ).start()
+        engines = lambda: [e for e, _, _, _ in replicas]  # noqa: E731
+        regs = lambda: [r for _, _, _, r in replicas]  # noqa: E731
+        batchers = lambda: [b for _, b, _, _ in replicas]  # noqa: E731
+        n_initial = args.replicas
+    rfront = RouterFrontend(router, port=0).start()
+    warmup_s = time.perf_counter() - t0
+    model_cfg = engines()[0].model_cfg
+    schedule = make_traffic_schedule(
+        mode, n, rate=rate, vocab=model_cfg.vocab_size,
+        max_len=model_cfg.max_len, max_new=args.max_new_tokens,
+        batch_fraction=args.batch_fraction,
+        flash_factor=args.flash_factor, seed=args.traffic_seed,
+    )
+    print(
+        f"# traffic={mode} n={n} rate={rate}/s "
+        f"batch_fraction={args.batch_fraction} over "
+        f"{n_initial} replica(s), warm in {warmup_s:.1f}s",
+        file=sys.stderr,
+    )
+
+    # Sample the fleet size during the drive (ramp's replicas_peak).
+    peak = [len(router.replicas)]
+    sampling = threading.Event()
+
+    def sampler():
+        while not sampling.is_set():
+            peak[0] = max(peak[0], len(router.replicas))
+            time.sleep(0.05)
+
+    sampler_thread = threading.Thread(target=sampler, daemon=True)
+    sampler_thread.start()
+
+    try:
+        outcome = drive_open_loop(
+            None, schedule, http_url=rfront.url("/generate"),
+            timeout=args.timeout, temperature=args.temperature,
+            top_k=args.top_k,
+        )
+        # Let the ladder walk back down (and, in ramp mode, the
+        # autoscaler drain back to min) before the verdict: "engages
+        # AND fully clears within the run" is the acceptance claim.
+        settle_deadline = time.monotonic() + (
+            30.0 if args.smoke else 120.0
+        )
+        while time.monotonic() < settle_deadline:
+            levels = [b.brownout_level for b in batchers()]
+            scaled_in = (
+                autoscaler is None
+                or (len(router.replicas) <= 1
+                    and not autoscaler.acting())
+            )
+            if all(lv == 0 for lv in levels) and scaled_in:
+                break
+            time.sleep(0.2)
+        # Verify the first --verify completed interactive streams
+        # against the unbatched reference (prefix-identical under a
+        # brownout cap).
+        verify_ok = True
+        checked = 0
+        ref_engine = engines()[0]
+        for i, ev in enumerate(schedule):
+            if checked >= verify:
+                break
+            reply = outcome["replies"][i]
+            if reply is None or reply[0] != 200:
+                continue
+            checked += 1
+            ref = ref_engine.reference_generate(
+                ev["prompt"], max_new=ev["max_new"], seed=ev["seed"],
+                temperature=args.temperature, top_k=args.top_k,
+            )
+            if not _stream_matches(reply[1], ref):
+                verify_ok = False
+                print(
+                    f"# VERIFY FAIL traffic req {i}: "
+                    f"{reply[1].get('tokens')} !~ reference {ref}",
+                    file=sys.stderr,
+                )
+        brownout_events = list(harvest["events"])
+        for b in batchers():
+            brownout_events.extend(b._overload.events)
+        # A scaled-down replica's frozen level is moot (it was drained
+        # and removed); "cleared" is about the LIVE fleet.
+        brownout_levels = [b.brownout_level for b in batchers()]
+        recompiles = harvest["recompiles"] + sum(
+            e.post_warmup_recompiles() for e in engines()
+        )
+        counter_sum: dict = dict(harvest["counters"])
+        for reg in regs():
+            for k, v in reg.counter_values().items():
+                counter_sum[k] = counter_sum.get(k, 0) + v
+    finally:
+        sampling.set()
+        sampler_thread.join(timeout=2)
+        rfront.close()
+        if autoscaler is not None:
+            autoscaler.close()
+        if supervisor is not None:
+            supervisor.close()
+        router.close()
+        if mode == "ramp":
+            for rep in spawned:
+                rep.close()
+        else:
+            for _, batcher, fe, _ in replicas:
+                batcher.close(drain=True)
+                fe.close()
+
+    tally = tally_replies(outcome["replies"])
+    by_class = {
+        slo: [
+            r for r, ev in zip(outcome["replies"], schedule)
+            if ev["slo"] == slo and r is not None
+        ]
+        for slo in ("interactive", "batch")
+    }
+    shed_by_class = {
+        slo: sum(1 for r in rs if r[0] == 503)
+        for slo, rs in by_class.items()
+    }
+    n_by_class = {
+        slo: sum(1 for ev in schedule if ev["slo"] == slo)
+        for slo in ("interactive", "batch")
+    }
+    steady_p95 = _pct_from_values(
+        _class_values(outcome, schedule, "ttft_s",
+                      slo="interactive", phases=("steady",)), 95,
+    )
+    flash_p95 = _pct_from_values(
+        _class_values(outcome, schedule, "ttft_s",
+                      slo="interactive", phases=("flash",)), 95,
+    )
+    # Resize-window latency (ramp): TTFT p95 of requests fired while a
+    # scale action was in flight (scale-up: decision -> green; plus a
+    # 2s tail after any event while dispatch redistributes).
+    resize_windows = []
+    if autoscaler is not None:
+        up_times = [
+            t for t, verb, _ in autoscaler.events if verb == "scale_up"
+        ]
+        for t, lat in zip(up_times, autoscaler.scale_up_latencies):
+            resize_windows.append((t - lat, t + 2.0))
+        for t, verb, _ in autoscaler.events:
+            if verb == "scale_down":
+                resize_windows.append((t, t + 2.0))
+    resize_ttfts = [
+        r[1].get("ttft_s")
+        for r, fu in zip(outcome["replies"], outcome["fired_unix"])
+        if r is not None and r[0] == 200 and fu is not None
+        and any(a <= fu <= b for a, b in resize_windows)
+    ]
+    scale_up_lat = (
+        max(autoscaler.scale_up_latencies)
+        if autoscaler is not None and autoscaler.scale_up_latencies
+        else None
+    )
+    brownout_max_level = max(
+        (to for _, _, to, _ in brownout_events), default=0
+    )
+    rec = {
+        "bench": "serve_traffic",
+        "traffic": mode,
+        "backend": jax.default_backend(),
+        "seed": args.traffic_seed,
+        "replicas": n_initial,
+        "replicas_peak": peak[0],
+        "replicas_final": len(router.replicas),
+        "requests": n,
+        "completed": tally["completed"],
+        "errors": tally["errors"],
+        "shed_total": tally["shed_total"],
+        "rejected_total": tally["rejected_total"],
+        "transport_errors": tally["transport_errors"],
+        "shed_interactive": shed_by_class["interactive"],
+        "shed_batch": shed_by_class["batch"],
+        "shed_rate_interactive": round(
+            shed_by_class["interactive"]
+            / max(n_by_class["interactive"], 1), 4
+        ),
+        "shed_rate_batch": round(
+            shed_by_class["batch"] / max(n_by_class["batch"], 1), 4
+        ),
+        "preempted_batch": int(
+            counter_sum.get("serving/preempted_total", 0)
+        ),
+        "rate_req_per_s": rate,
+        "flash_factor": args.flash_factor,
+        "batch_fraction": args.batch_fraction,
+        "wall_s": round(outcome["wall_s"], 3),
+        "late_fires": outcome["late_fires"],
+        "warmup_s": round(warmup_s, 3),
+        "ttft_p50_interactive_ms": _pct_from_values(
+            _class_values(outcome, schedule, "ttft_s",
+                          slo="interactive"), 50),
+        "ttft_p95_interactive_ms": _pct_from_values(
+            _class_values(outcome, schedule, "ttft_s",
+                          slo="interactive"), 95),
+        "ttft_p95_batch_ms": _pct_from_values(
+            _class_values(outcome, schedule, "ttft_s", slo="batch"),
+            95),
+        "e2e_p95_interactive_ms": _pct_from_values(
+            _class_values(outcome, schedule, "total_s",
+                          slo="interactive"), 95),
+        "e2e_p95_batch_ms": _pct_from_values(
+            _class_values(outcome, schedule, "total_s", slo="batch"),
+            95),
+        "steady_ttft_p95_interactive_ms": steady_p95,
+        "flash_ttft_p95_interactive_ms": flash_p95,
+        "flash_vs_steady_ttft": (
+            round(flash_p95 / steady_p95, 3)
+            if steady_p95 and flash_p95 else None
+        ),
+        "flash_ttft_budget": FLASH_TTFT_BUDGET,
+        "brownout_max_level": brownout_max_level,
+        "brownout_transitions": len(brownout_events),
+        "brownout_engaged": bool(brownout_events),
+        "brownout_cleared": bool(
+            all(lv == 0 for lv in brownout_levels)
+        ),
+        "scale_ups": (
+            int(len(autoscaler.scale_up_latencies))
+            if autoscaler is not None else 0
+        ),
+        "scale_downs": (
+            int(sum(1 for _, verb, _ in autoscaler.events
+                    if verb == "scale_down"))
+            if autoscaler is not None else 0
+        ),
+        "scale_up_latency_s": (
+            round(scale_up_lat, 3) if scale_up_lat else None
+        ),
+        "p95_during_resize_ms": _pct_from_values(resize_ttfts, 95),
+        "post_warmup_recompiles": recompiles,
+        "verified": checked,
+        "verify_ok": verify_ok,
+        "kv_block_size": kv_block,
+        "transport": "router-http",
+    }
+    if mode == "flash":
+        rec["ok"] = bool(
+            rec["errors"] == 0
+            and rec["shed_interactive"] == 0
+            and verify_ok
+            and recompiles == 0
+            and rec["brownout_cleared"]
+            and (
+                rec["flash_vs_steady_ttft"] is None
+                or rec["flash_vs_steady_ttft"] <= FLASH_TTFT_BUDGET
+            )
+        )
+    elif mode == "ramp":
+        rec["ok"] = bool(
+            rec["errors"] == 0
+            and verify_ok
+            and recompiles == 0
+            and rec["scale_ups"] >= 1
+            and rec["replicas_peak"] >= min(args.max_replicas, 2)
+            and rec["replicas_final"] <= 1
+            and rec["scale_up_latency_s"] is not None
+            and rec["brownout_engaged"]
+            and rec["brownout_cleared"]
+        )
+    else:
+        rec["ok"] = bool(
+            rec["errors"] == 0
+            and verify_ok
+            and recompiles == 0
+            and rec["brownout_cleared"]
+        )
+    return rec
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
@@ -1180,6 +1858,32 @@ def main(argv=None) -> int:
                          "(prefix_hit_rate_affinity vs "
                          "prefix_hit_rate_no_affinity, shared-vs-cold "
                          "TTFT)")
+    ap.add_argument("--traffic", choices=("ramp", "flash", "diurnal"),
+                    default="",
+                    help="ISSUE 13: replayable open-loop traffic model "
+                         "against a brownout-enabled fleet. 'flash' = "
+                         "3x flash crowd over a fixed fleet (per-class "
+                         "shed/latency claims); 'ramp' = the "
+                         "autoscaler golden (1->max->1, drain-first); "
+                         "'diurnal' = two sinusoidal load days. Banks "
+                         "the serve_traffic record")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="traffic: steady-state arrival rate req/s "
+                         "(default 25 smoke / 50)")
+    ap.add_argument("--batch-fraction", type=float, default=0.3,
+                    help="traffic: fraction of arrivals in the batch "
+                         "SLO class")
+    ap.add_argument("--flash-factor", type=float, default=3.0,
+                    help="traffic flash: arrival-rate multiple during "
+                         "the flash window")
+    ap.add_argument("--traffic-seed", type=int, default=0,
+                    help="traffic: schedule seed (same seed = "
+                         "byte-identical scenario)")
+    ap.add_argument("--max-replicas", type=int, default=3,
+                    help="traffic ramp: autoscaler ceiling")
+    ap.add_argument("--target-queue", type=float, default=3.0,
+                    help="traffic ramp: autoscaler queue-depth target "
+                         "per replica")
     ap.add_argument("--fault-spec", default="",
                     help="serve fault schedule for --chaos "
                          "(utils/faults.py grammar, e.g. 'crash@1:4,"
@@ -1215,6 +1919,15 @@ def main(argv=None) -> int:
         ap.error("--affinity ab is a --router A/B mode")
     if args.replicas <= 0:
         args.replicas = 3 if args.chaos else 2
+
+    if args.traffic:
+        rec = run_traffic_bench(args)
+        print(json.dumps(rec))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        return 0 if rec["ok"] else 1
 
     if args.router and args.affinity == "ab":
         rec = run_affinity_bench(args)

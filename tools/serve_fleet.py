@@ -21,6 +21,18 @@ with replica supervision (ISSUE 10).
         --spawn 'python examples/gpt2/serve.py --workdir w0 --port {port}' \
         --spawn-base-port 8100
 
+    # Telemetry-driven autoscaling (ISSUE 13): --spawn[0] is the
+    # replica template; the fleet resizes between --min-replicas and
+    # --max-replicas against the probe-fed signals (queue depth, KV
+    # occupancy, brownout level, and per-replica /metrics TTFT p95
+    # when --target-ttft-p95 is set). Scale-up green-gates the fresh
+    # replica (AOT warmup finishes before it joins); scale-down is
+    # always drain-first. A supervisor incident pauses all scaling
+    # (the crash-loop guard).
+    python tools/serve_fleet.py --port 9000 --autoscale \
+        --spawn 'python examples/gpt2/serve.py --workdir w0 --port {port}' \
+        --min-replicas 1 --max-replicas 4 --target-queue 4
+
     # Canary rollout: route 25% of traffic to the canary set and bank
     # a run_diff comparison of the two sets at exit (or on demand at
     # GET /canary):
@@ -110,6 +122,28 @@ def main(argv=None) -> int:
     ap.add_argument("--eject-cooldown", type=float, default=3.0,
                     help="circuit breaker: seconds ejected before the "
                          "half-open probe")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="ISSUE 13: run the telemetry-driven "
+                         "autoscaler — --spawn[0] is the replica "
+                         "template; the fleet resizes between "
+                         "--min-replicas and --max-replicas against "
+                         "the target signals, scale-down drain-first")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--target-queue", type=float, default=4.0,
+                    help="autoscaler: mean queued requests per "
+                         "eligible replica before scaling up")
+    ap.add_argument("--target-kv", type=float, default=0.85,
+                    help="autoscaler: mean KV occupancy before "
+                         "scaling up")
+    ap.add_argument("--target-ttft-p95", type=float, default=0.0,
+                    help="autoscaler: worst-replica TTFT p95 seconds "
+                         "before scaling up (0 disables the signal)")
+    ap.add_argument("--scale-hold", type=float, default=5.0,
+                    help="autoscaler: min seconds between actions")
+    ap.add_argument("--scale-down-idle", type=float, default=30.0,
+                    help="autoscaler: sustained-idle seconds before a "
+                         "drain-first scale-down")
     ap.add_argument("--no-affinity", action="store_true",
                     help="disable prefix-affinity dispatch (ISSUE 12; "
                          "on by default — the router prefers the "
@@ -125,6 +159,9 @@ def main(argv=None) -> int:
                  "required")
     if args.diff_out and not args.canary:
         ap.error("--diff-out needs a --canary set to compare against")
+    if args.autoscale and not args.spawn:
+        ap.error("--autoscale needs a --spawn command to use as the "
+                 "replica template")
 
     from tensorflow_examples_tpu.serving.router import (
         Router,
@@ -133,6 +170,8 @@ def main(argv=None) -> int:
         _get_json,
     )
     from tensorflow_examples_tpu.serving.supervisor import (
+        Autoscaler,
+        AutoscalerConfig,
         ProcessReplica,
         Supervisor,
     )
@@ -197,6 +236,42 @@ def main(argv=None) -> int:
             warm_timeout_s=args.spawn_warm_timeout,
             max_restarts=args.max_restarts,
         ).start()
+    autoscaler = None
+    if args.autoscale:
+        # The spawn template: --spawn[0]'s command at the next free
+        # port in the spawn range. ProcessReplica.start returns as
+        # soon as the process exists; the autoscaler's green gate then
+        # waits for the replica's own AOT warmup to finish (/health ok)
+        # before it ever joins the router.
+        next_port = [args.spawn_base_port + len(args.spawn)]
+
+        def spawn_replica(idx):
+            port = next_port[0]
+            next_port[0] += 1
+            return ProcessReplica(args.spawn[0], port=port).start()
+
+        autoscaler = Autoscaler(
+            router,
+            supervisor,
+            spawn_replica,
+            cfg=AutoscalerConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                target_queue_depth=args.target_queue,
+                target_kv_occupancy=args.target_kv,
+                target_ttft_p95_s=args.target_ttft_p95,
+                hold_s=args.scale_hold,
+                scale_down_idle_s=args.scale_down_idle,
+                warm_timeout_s=args.spawn_warm_timeout,
+            ),
+        ).start()
+        print(
+            f"autoscaler on: {args.min_replicas}..{args.max_replicas} "
+            f"replicas, targets queue<{args.target_queue} "
+            f"kv<{args.target_kv} ttft_p95<"
+            f"{args.target_ttft_p95 or 'off'}",
+            file=sys.stderr,
+        )
     frontend = RouterFrontend(router, port=args.port).start()
     # Role topology (ISSUE 12): heterogeneous prefill/decode fleets are
     # first-class — say what the probe sweep actually found, so a
@@ -237,11 +312,17 @@ def main(argv=None) -> int:
                 last_stats = time.monotonic()
     finally:
         frontend.close()
+        if autoscaler is not None:
+            autoscaler.close()
         if supervisor is not None:
             supervisor.close()
         router.close()
         for rep in spawned:
             rep.close()
+        if autoscaler is not None:
+            # Replicas the autoscaler spawned after startup.
+            for url, handle in list(autoscaler.supervisor.handles.items()):
+                handle.close()
         if args.diff_out:
             import run_diff
 

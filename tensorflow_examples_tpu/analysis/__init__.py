@@ -1,0 +1,60 @@
+"""graftlint: repo-native static analysis (ISSUE 14).
+
+The serving stack is a fleet — 40+ ``threading`` sites across the
+batcher/router/supervisor/autoscaler tier, a dozen jitted/AOT-warmed
+programs with donated buffers, and a schema-versioned telemetry
+contract — and until this package its invariants were guarded only by
+convention and by goldens that catch breakage *after* it ships. The
+original TensorFlow design argument (arxiv 1605.08695) is that a
+statically analyzable program representation makes whole-program
+checking tractable; these passes apply that discipline to the repo's
+own contracts:
+
+* :mod:`analysis.locks` — lock-discipline pass over the
+  ``# guard: <lock>`` attribute annotations (reads/writes of annotated
+  shared state must sit under a matching ``with`` block).
+* :mod:`analysis.jaxhaz` — JAX hazard pass: traced-value branching and
+  implicit host syncs inside jit-reachable functions, host syncs on
+  marked hot paths, and use-after-donate of buffers passed to
+  ``donate_argnums`` programs.
+* :mod:`analysis.drift` — schema/counter drift pass: the
+  ``SERVING_KEYS_V4..V10`` contract in ``telemetry/schema.py`` vs what
+  the batcher/router/paged pool actually stamp vs what the docs
+  document, plus registered counter/gauge names vs the docs.
+* :mod:`analysis.lockorder` — the runtime complement: an opt-in
+  lock-order cycle detector the chaos/router/overload tier-1 tests arm
+  (dynamic acquisition ordering is where static analysis can't reach).
+
+``tools/graftlint.py`` is the CLI; ``tests/test_lint.py`` pins every
+pass with known-bad/known-good fixtures and runs ``--all`` over the
+package with the committed suppression baseline
+(``tools/graftlint_baseline.json``) in tier-1. See
+``docs/static_analysis.md``.
+"""
+
+from tensorflow_examples_tpu.analysis.common import (  # noqa: F401
+    Baseline,
+    Finding,
+    apply_baseline,
+    iter_python_files,
+)
+
+PASSES = ("locks", "jax", "schema")
+
+
+def run_pass(name: str, paths, repo_root):
+    """Run one named pass over ``paths`` (list of file paths); returns
+    a list of :class:`Finding`."""
+    if name == "locks":
+        from tensorflow_examples_tpu.analysis import locks
+
+        return locks.run(paths, repo_root)
+    if name == "jax":
+        from tensorflow_examples_tpu.analysis import jaxhaz
+
+        return jaxhaz.run(paths, repo_root)
+    if name == "schema":
+        from tensorflow_examples_tpu.analysis import drift
+
+        return drift.run(paths, repo_root)
+    raise ValueError(f"unknown pass {name!r}; one of {PASSES}")

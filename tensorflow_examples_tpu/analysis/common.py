@@ -1,0 +1,218 @@
+"""Shared graftlint plumbing: findings, comments, suppression baseline.
+
+A :class:`Finding` carries both a display location (``path:line``) and
+a **stable key** deliberately free of line numbers —
+``pass:path:scope:detail`` — so the committed suppression baseline
+(``tools/graftlint_baseline.json``) survives unrelated edits above a
+finding. The baseline maps keys to *accepted counts*: a key is
+suppressed while its current occurrence count stays at or below the
+accepted one, and the excess occurrences surface as findings — adding
+a second unguarded read of an attribute in the same function is a new
+finding even though the first was accepted.
+
+Inline escape hatch: any source line whose comment contains
+``graftlint: ignore`` is skipped by every pass (use sparingly, with
+the justification in the surrounding comment; the baseline is the
+audited mechanism).
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import io
+import json
+import os
+import tokenize
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    pass_name: str      # "locks" | "jax" | "schema"
+    path: str           # repo-relative, forward slashes
+    line: int           # 1-indexed display line
+    scope: str          # Class.method / function / "-" (module level)
+    detail: str         # stable discriminator within the scope
+    message: str        # human-facing explanation
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity the baseline is keyed by."""
+        return f"{self.pass_name}:{self.path}:{self.scope}:{self.detail}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.pass_name}] "
+            f"{self.scope}: {self.message}"
+        )
+
+
+# ------------------------------------------------------------ source IO
+
+
+def rel_path(path: str, repo_root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(repo_root))
+    return rel.replace(os.sep, "/")
+
+
+def iter_python_files(paths) -> list[str]:
+    """Expand files/directories into a sorted list of ``.py`` files."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                out.extend(
+                    os.path.join(root, f)
+                    for f in sorted(files) if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(dict.fromkeys(out))
+
+
+class SourceFile:
+    """One parsed file: AST + per-line comments + scope resolution."""
+
+    def __init__(self, path: str, repo_root: str, text: str | None = None):
+        self.path = path
+        self.rel = rel_path(path, repo_root)
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(text).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:  # torn source: AST parsed, comments best-effort
+            pass
+        # Parent links + enclosing-scope names for stable keys.
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted Class.method / function name enclosing ``node``
+        ("-" at module level)."""
+        parts: list[str] = []
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                parts.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(parts)) or "-"
+
+    def ignored(self, lineno: int) -> bool:
+        """True when the line (or the def/class line of a decorated
+        statement) carries a ``graftlint: ignore`` comment."""
+        c = self.comments.get(lineno, "")
+        return "graftlint: ignore" in c
+
+    def comment(self, lineno: int) -> str:
+        return self.comments.get(lineno, "")
+
+
+def load_source(path: str, repo_root: str) -> SourceFile | None:
+    try:
+        return SourceFile(path, repo_root)
+    except (OSError, SyntaxError):
+        return None
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — display-only fallback
+        return f"<{type(node).__name__}>"
+
+
+# ------------------------------------------------------------- baseline
+
+
+class Baseline:
+    """Committed suppression baseline: finding key -> accepted count."""
+
+    VERSION = 1
+
+    def __init__(self, counts: dict[str, int] | None = None):
+        self.counts: dict[str, int] = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.isfile(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or doc.get("version") != cls.VERSION:
+            raise ValueError(
+                f"{path}: not a graftlint baseline (expected "
+                f'{{"version": {cls.VERSION}, "findings": {{...}}}})'
+            )
+        findings = doc.get("findings")
+        if not isinstance(findings, dict) or not all(
+            isinstance(k, str) and isinstance(v, int) and v > 0
+            for k, v in findings.items()
+        ):
+            raise ValueError(
+                f"{path}: baseline findings must map keys to positive "
+                "counts"
+            )
+        return cls(findings)
+
+    def save(self, path: str) -> None:
+        doc = {"version": self.VERSION, "findings": dict(sorted(
+            self.counts.items()
+        ))}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    @classmethod
+    def from_findings(cls, findings) -> "Baseline":
+        counts: dict[str, int] = collections.Counter(
+            f.key for f in findings
+        )
+        return cls(dict(counts))
+
+
+def apply_baseline(findings, baseline: Baseline):
+    """Split findings into (reported, suppressed, stale_keys).
+
+    Per key, the first ``accepted`` occurrences are suppressed and the
+    rest reported. ``stale_keys`` are baseline entries whose finding no
+    longer occurs (or occurs fewer times) — candidates for removal, so
+    the baseline only ever shrinks toward the truth.
+    """
+    by_key: dict[str, list] = collections.defaultdict(list)
+    for f in findings:
+        by_key[f.key].append(f)
+    reported, suppressed = [], []
+    for key, group in by_key.items():
+        accepted = baseline.counts.get(key, 0)
+        group = sorted(group, key=lambda f: f.line)
+        suppressed.extend(group[:accepted])
+        reported.extend(group[accepted:])
+    stale = sorted(
+        key for key, accepted in baseline.counts.items()
+        if len(by_key.get(key, ())) < accepted
+    )
+    reported.sort(key=lambda f: (f.path, f.line, f.detail))
+    return reported, suppressed, stale

@@ -1,0 +1,332 @@
+"""Schema/counter drift pass (graftlint pass 3, ISSUE 14 tentpole).
+
+The serving stats contract has three copies that historically drifted
+only in review: the ``SERVING_KEYS_V4..V10`` tuples in
+``telemetry/schema.py`` (the validator), the keys
+``serving/batcher.py`` / ``serving/router.py`` / ``serving/paged_kv.py``
+actually stamp into the ``serving`` object, and what
+``docs/serving.md`` / ``docs/observability.md`` document. This pass
+cross-checks all three on every run:
+
+* **unknown-serving-key** — a stamper writes a key no schema version
+  declares (a new field shipped without a schema bump: the exact
+  mistake the mislabeling rule in ``validate_line`` exists to catch
+  downstream, caught at authoring time instead);
+* **unstamped-schema-key** — a declared schema key no stamper writes
+  (dead contract: consumers guard for a field nothing produces);
+* **undocumented-schema-key** — a declared schema key the serving/
+  observability docs never mention;
+* **undocumented-counter** — a ``serving/`` / ``router/`` /
+  ``autoscaler/`` counter or gauge registered in the serving tier that
+  no doc mentions (the ops runbooks are the operator's only index).
+
+Dynamic stamps are expanded where the pieces are statically knowable:
+an f-string key whose formatted values are names bound by an enclosing
+``for`` over a constant tuple (or a module-level constant tuple like
+``SLO_CLASSES``) expands to its cartesian product — which is how the
+batcher's per-class ``f"{name}_p95_{cls}"`` stamps are credited
+against ``SERVING_KEYS_V10``. F-strings with unresolvable parts (e.g.
+``f"serving/shed_{req.slo}_total"``) are skipped, not guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+
+from tensorflow_examples_tpu.analysis import common
+
+# The three contract surfaces, repo-relative.
+SCHEMA_FILE = "tensorflow_examples_tpu/telemetry/schema.py"
+STAMP_FILES = (
+    "tensorflow_examples_tpu/serving/batcher.py",
+    "tensorflow_examples_tpu/serving/router.py",
+    "tensorflow_examples_tpu/serving/paged_kv.py",
+)
+DOC_FILES = ("docs/serving.md", "docs/observability.md")
+
+# Counter/gauge namespaces whose names must appear in the docs.
+COUNTER_PREFIXES = ("serving/", "router/", "autoscaler/")
+COUNTER_SCAN_DIR = "tensorflow_examples_tpu/serving"
+
+# Schema tuples that together declare every legal serving-object key.
+_SCHEMA_TUPLES = (
+    "SERVING_KEYS", "SERVING_KEYS_V6", "SERVING_KEYS_V7",
+    "SERVING_KEYS_V8", "SERVING_KEYS_V9", "SERVING_KEYS_V10",
+)
+
+
+def _load(repo_root: str, rel: str) -> common.SourceFile | None:
+    return common.load_source(os.path.join(repo_root, rel), repo_root)
+
+
+# ------------------------------------------------------- schema tuples
+
+
+def schema_keys(src: common.SourceFile) -> dict[str, set[str]]:
+    """{tuple name: keys} from the schema module's module-level
+    constant tuples."""
+    out: dict[str, set[str]] = {}
+    for node in src.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in _SCHEMA_TUPLES:
+                try:
+                    vals = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(vals, (tuple, list)):
+                    out[t.id] = {v for v in vals if isinstance(v, str)}
+    return out
+
+
+# ----------------------------------------------------- f-string expand
+
+
+def _module_const_tuples(src: common.SourceFile) -> dict[str, tuple]:
+    out: dict[str, tuple] = {}
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    try:
+                        v = ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        continue
+                    if isinstance(v, (tuple, list)) and all(
+                        isinstance(i, str) for i in v
+                    ):
+                        out[t.id] = tuple(v)
+    return out
+
+
+def _resolve_domain(it: ast.AST,
+                    consts: dict[str, tuple]) -> tuple | None:
+    """A for/comprehension iterable as a tuple of strings: a named
+    module constant or an all-string literal; None when dynamic."""
+    if isinstance(it, ast.Name) and it.id in consts:
+        return consts[it.id]
+    try:
+        lit = ast.literal_eval(it)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(lit, (tuple, list)) and all(
+        isinstance(i, str) for i in lit
+    ):
+        return tuple(lit)
+    return None
+
+
+def _loop_domains(src: common.SourceFile, node: ast.AST,
+                  consts: dict[str, tuple]) -> dict[str, tuple]:
+    """{name: candidate string values} from enclosing ``for`` targets
+    whose iterables are constant tuples or named module constants."""
+    domains: dict[str, tuple] = {}
+    cur = src.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.comprehension)):
+            values = _resolve_domain(cur.iter, consts)
+            if values is not None and isinstance(cur.target, ast.Name):
+                domains.setdefault(cur.target.id, values)
+        # comprehensions: generators live on the parent expression
+        for gen in getattr(cur, "generators", []) or []:
+            sub = _loop_domains_from_comp(gen, consts)
+            for k, v in sub.items():
+                domains.setdefault(k, v)
+        cur = src.parent(cur)
+    return domains
+
+
+def _loop_domains_from_comp(gen: ast.comprehension,
+                            consts: dict[str, tuple]) -> dict[str, tuple]:
+    values = _resolve_domain(gen.iter, consts)
+    if values is not None and isinstance(gen.target, ast.Name):
+        return {gen.target.id: values}
+    return {}
+
+
+def expand_key(src: common.SourceFile, node: ast.AST,
+               consts: dict[str, tuple]) -> list[str] | None:
+    """Constant -> [key]; expandable f-string -> cartesian expansion;
+    anything else -> None (dynamic, skipped)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    domains = _loop_domains(src, node, consts)
+    parts: list[tuple[str, ...]] = []
+    for piece in node.values:
+        if isinstance(piece, ast.Constant):
+            parts.append((str(piece.value),))
+        elif isinstance(piece, ast.FormattedValue) and isinstance(
+            piece.value, ast.Name
+        ) and piece.value.id in domains:
+            parts.append(tuple(domains[piece.value.id]))
+        else:
+            return None
+    return ["".join(combo) for combo in itertools.product(*parts)]
+
+
+# ---------------------------------------------------------- stamp scan
+
+
+def stamped_keys(src: common.SourceFile) -> dict[str, int]:
+    """{serving-object key: first lineno} stamped in this file:
+    ``serving["k"] = ...`` subscript stores on a name ``serving``, the
+    dict literal assigned to ``serving``, and the dict literal a
+    ``paged_stats`` function returns."""
+    consts = _module_const_tuples(src)
+    out: dict[str, int] = {}
+
+    def note(keys: list[str] | None, lineno: int) -> None:
+        for k in keys or ():
+            out.setdefault(k, lineno)
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Store
+        ) and isinstance(node.value, ast.Name) \
+                and node.value.id == "serving":
+            note(expand_key(src, node.slice, consts), node.lineno)
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Dict
+        ):
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if "serving" in targets:
+                for k in node.value.keys:
+                    note(expand_key(src, k, consts) if k else None,
+                         node.lineno)
+        elif isinstance(node, ast.FunctionDef) and node.name in (
+            "paged_stats",
+        ):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and isinstance(
+                    sub.value, ast.Dict
+                ):
+                    for k in sub.value.keys:
+                        note(
+                            expand_key(src, k, consts) if k else None,
+                            sub.lineno,
+                        )
+    return out
+
+
+# -------------------------------------------------------- counter scan
+
+
+def registered_instruments(src: common.SourceFile) -> dict[str, int]:
+    """{instrument name: first lineno} for counter()/gauge()/histogram()
+    registrations with resolvable names in the scanned prefixes."""
+    consts = _module_const_tuples(src)
+    out: dict[str, int] = {}
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in ("counter", "gauge", "histogram")
+                and node.args):
+            continue
+        for name in expand_key(src, node.args[0], consts) or ():
+            if name.startswith(COUNTER_PREFIXES):
+                out.setdefault(name, node.lineno)
+    return out
+
+
+# ---------------------------------------------------------------- main
+
+
+def run(paths, repo_root) -> list[common.Finding]:
+    """The drift pass is whole-repo by construction: ``paths`` gates
+    which findings are *reported* (a file outside the requested set
+    stays quiet) but the contract is always read from the canonical
+    schema/stamper/doc locations."""
+    requested = {
+        common.rel_path(p, repo_root)
+        for p in common.iter_python_files(paths)
+    }
+    findings: list[common.Finding] = []
+    schema_src = _load(repo_root, SCHEMA_FILE)
+    if schema_src is None:
+        return findings
+    tuples = schema_keys(schema_src)
+    declared: dict[str, str] = {}
+    for tup in _SCHEMA_TUPLES:
+        for key in tuples.get(tup, ()):
+            declared.setdefault(key, tup)
+
+    docs_text = ""
+    for rel in DOC_FILES:
+        p = os.path.join(repo_root, rel)
+        if os.path.isfile(p):
+            with open(p, encoding="utf-8") as f:
+                docs_text += f.read()
+
+    stamps: dict[str, tuple[str, int]] = {}  # key -> (file, line)
+    for rel in STAMP_FILES:
+        src = _load(repo_root, rel)
+        if src is None:
+            continue
+        for key, line in stamped_keys(src).items():
+            stamps.setdefault(key, (rel, line))
+            if key not in declared and not src.ignored(line):
+                if rel in requested:
+                    findings.append(common.Finding(
+                        pass_name="schema", path=rel, line=line,
+                        scope="stats_line",
+                        detail=f"unknown-serving-key:{key}",
+                        message=(
+                            f"serving key {key!r} is stamped but no "
+                            "SERVING_KEYS_V4..V10 tuple in "
+                            "telemetry/schema.py declares it — bump "
+                            "the schema before shipping the field"
+                        ),
+                    ))
+
+    schema_rel = SCHEMA_FILE
+    report_schema = schema_rel in requested
+    for key, tup in sorted(declared.items()):
+        if key not in stamps and report_schema:
+            findings.append(common.Finding(
+                pass_name="schema", path=schema_rel, line=1,
+                scope=tup, detail=f"unstamped-schema-key:{key}",
+                message=(
+                    f"schema key {key!r} ({tup}) is declared but no "
+                    "stamper (batcher/router/paged pool) writes it"
+                ),
+            ))
+        # Backticked form only: schema keys that are ordinary English
+        # words ("slots", "draining") appear all over the docs prose —
+        # a bare substring test could never flag them. The catalog
+        # documents keys as `key` rows.
+        if f"`{key}`" not in docs_text and report_schema:
+            findings.append(common.Finding(
+                pass_name="schema", path=schema_rel, line=1,
+                scope=tup, detail=f"undocumented-schema-key:{key}",
+                message=(
+                    f"schema key {key!r} ({tup}) appears in neither "
+                    "docs/serving.md nor docs/observability.md"
+                ),
+            ))
+
+    scan_dir = os.path.join(repo_root, COUNTER_SCAN_DIR)
+    for path in common.iter_python_files([scan_dir]):
+        src = common.load_source(path, repo_root)
+        if src is None or src.rel not in requested:
+            continue
+        for name, line in sorted(registered_instruments(src).items()):
+            if name not in docs_text and not src.ignored(line):
+                findings.append(common.Finding(
+                    pass_name="schema", path=src.rel, line=line,
+                    scope="-", detail=f"undocumented-counter:{name}",
+                    message=(
+                        f"instrument {name!r} is registered but "
+                        "documented in neither docs/serving.md nor "
+                        "docs/observability.md (add it to the counter "
+                        "catalog)"
+                    ),
+                ))
+    findings.sort(key=lambda f: (f.path, f.line, f.detail))
+    return findings

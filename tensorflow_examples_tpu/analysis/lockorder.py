@@ -1,0 +1,335 @@
+"""Runtime lock-order cycle detector (graftlint pass 4, ISSUE 14).
+
+The static lock pass checks that annotated state is touched under its
+lock; it cannot see the *order* two threads take two locks in — the
+classic deadlock shape (thread A holds L1 wanting L2, thread B holds
+L2 wanting L1) only exists dynamically. This module is the runtime
+complement: while armed, every ``threading.Lock()`` / ``RLock()``
+created by repo code is wrapped so each acquisition records
+*held-before* edges (every lock currently held by the acquiring thread
+-> the lock being acquired) into a global graph, and a new edge that
+closes a cycle is recorded as a violation **at the moment the ordering
+is established** — no actual deadlock (and no lucky interleaving) is
+needed, because the edges accumulate across threads and across time.
+
+Scope and noise control:
+
+* Only locks allocated from files under ``tensorflow_examples_tpu``
+  are wrapped (the creating frame is inspected once, at allocation);
+  stdlib internals — ``queue.Queue``'s mutex, ``threading.Event``'s
+  condition — keep raw locks, so the graph stays the repo's own.
+* Edges are recorded at acquisition *attempt* (before blocking): the
+  detector reports the ordering hazard even when the test run happens
+  not to interleave into the deadlock.
+* RLock re-entry by the owning thread records no self-edge.
+
+Arming is test-scoped: the chaos/router/overload tier-1 tests arm it
+via the autouse conftest fixture (see ``tests/conftest.py``), which
+asserts ``violations == []`` at teardown. ``armed()`` is the
+context-manager form for direct use::
+
+    with lockorder.armed() as mon:
+        ... exercise the threaded code ...
+    assert not mon.violations
+
+Locks created while armed keep working after disarm (recording becomes
+a no-op), so objects that outlive the window are safe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+
+_PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+# The single active monitor (None = disarmed). Wrapped locks hold a
+# reference to the monitor that existed at their creation; they check
+# its `enabled` flag per acquisition, so disarm is O(1) and permanent.
+_active: "LockOrderMonitor | None" = None
+_arm_lock = _real_lock()
+
+
+class LockOrderMonitor:
+    """Held-before graph + cycle detection over tracked locks."""
+
+    def __init__(self):
+        self.enabled = True
+        self.violations: list[str] = []
+        self._graph: dict[int, set[int]] = {}   # lock id -> successors
+        self._sites: dict[int, str] = {}        # lock id -> creation site
+        self._edges: set[tuple[int, int]] = set()
+        # The graph is keyed by id(); a freed lock's id is recycled by
+        # CPython, which would alias a NEW lock onto a dead lock's
+        # recorded edges and manufacture (or mask) cycles between locks
+        # that never coexisted. Pin every registered wrapper for the
+        # armed window so ids stay unique. Bounded by locks created
+        # while armed — test scope.
+        self._refs: dict[int, object] = {}
+        self._mu = _real_lock()
+        # Per-thread held stacks keyed by thread ident (NOT
+        # threading.local): a plain threading.Lock may legally be
+        # released by a different thread than its acquirer (hand-off /
+        # semaphore style), and that release must be able to pop the
+        # ACQUIRER's stack entry — a thread-local stranded it forever,
+        # turning every later acquire by the acquirer into a phantom
+        # held-before edge. All stack/owner access is under _mu.
+        self._stacks: dict[int, list[int]] = {}
+        self._owners: dict[int, int] = {}  # lock id -> acquiring thread
+
+    # ------------------------------------------------------- thread state
+
+    def _stack_locked(self, ident: int) -> list[int]:
+        return self._stacks.setdefault(ident, [])
+
+    # ---------------------------------------------------------- recording
+
+    def register(self, lock_id: int, site: str, lock: object) -> None:
+        with self._mu:
+            self._sites[lock_id] = site
+            self._refs[lock_id] = lock
+
+    def note_acquire(self, lock_id: int, *, reentrant: bool) -> None:
+        if not self.enabled:
+            return
+        me = threading.get_ident()
+        with self._mu:
+            stack = self._stack_locked(me)
+            if reentrant and lock_id in stack:
+                return  # RLock re-entry: no ordering established
+            for h in stack:
+                if h == lock_id:
+                    continue
+                edge = (h, lock_id)
+                if edge in self._edges:
+                    continue
+                self._edges.add(edge)
+                self._graph.setdefault(h, set()).add(lock_id)
+                cycle = self._find_path(lock_id, h)
+                if cycle is not None:
+                    self._record_violation([h] + cycle)
+            stack.append(lock_id)
+
+    def note_acquired(self, lock_id: int) -> None:
+        """Inner acquire SUCCEEDED: the calling thread owns the lock.
+        Ownership must not be recorded at attempt time — a blocked
+        waiter would clobber the real holder's entry, and a legal
+        cross-thread release would then pop the waiter's stack,
+        stranding the holder's entry into phantom edges."""
+        if not self.enabled:
+            return
+        with self._mu:
+            self._owners[lock_id] = threading.get_ident()
+
+    def note_acquired_failed(self, lock_id: int) -> None:
+        """A non-blocking acquire that returned False: undo the held
+        push (the edge stays — the ordering intent was real)."""
+        with self._mu:
+            stack = self._stack_locked(threading.get_ident())
+            if stack and stack[-1] == lock_id:
+                stack.pop()
+            elif lock_id in stack:
+                stack.remove(lock_id)
+
+    def note_release(self, lock_id: int) -> None:
+        if not self.enabled:
+            return
+        me = threading.get_ident()
+        with self._mu:
+            stack = self._stack_locked(me)
+            if lock_id not in stack:
+                # Cross-thread release: pop the ACQUIRER's entry.
+                owner = self._owners.get(lock_id)
+                stack = self._stacks.get(owner, []) if owner is not None \
+                    else []
+            if lock_id in stack:
+                # remove the most recent occurrence (RLock depth
+                # handled by the wrapper, which only notes the
+                # outermost pair)
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i] == lock_id:
+                        del stack[i]
+                        break
+            self._owners.pop(lock_id, None)
+
+    # ------------------------------------------------------ cycle search
+
+    def _find_path(self, start: int, goal: int) -> list[int] | None:
+        """DFS path start -> goal in the held-before graph (caller
+        holds self._mu). A path means the fresh edge goal->start closed
+        a cycle."""
+        seen = {start}
+        stack: list[tuple[int, list[int]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            for nxt in self._graph.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _record_violation(self, cycle_ids: list[int]) -> None:
+        names = " -> ".join(
+            self._sites.get(i, f"lock@{i:#x}") for i in cycle_ids
+        )
+        msg = (
+            f"lock-order cycle: {names} (thread "
+            f"{threading.current_thread().name!r} closed the cycle)"
+        )
+        self.violations.append(msg)
+
+    def edge_count(self) -> int:
+        with self._mu:
+            return len(self._edges)
+
+
+class _TrackedLock:
+    """A threading.Lock/RLock stand-in that reports to the monitor."""
+
+    def __init__(self, monitor: LockOrderMonitor, site: str,
+                 reentrant: bool):
+        self._inner = _real_rlock() if reentrant else _real_lock()
+        self._monitor = monitor
+        self._reentrant = reentrant
+        # RLock re-entry depth. Moved only while the lock is HELD by
+        # the moving thread (increment after a successful acquire,
+        # decrement before the inner release), so a plain int is
+        # race-free; it keeps an inner release from erasing the
+        # held-stack entry while the lock is still held — which would
+        # hide every ordering edge recorded after a re-entry.
+        self._depth = 0
+        monitor.register(id(self), site, self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        mon = self._monitor
+        mon.note_acquire(id(self), reentrant=self._reentrant)
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            mon.note_acquired_failed(id(self))
+        else:
+            mon.note_acquired(id(self))
+            if self._reentrant:
+                self._depth += 1
+        return ok
+
+    def release(self):
+        # ALL monitor bookkeeping happens BEFORE freeing the inner
+        # lock, while ownership is still exclusive: after the release
+        # the next owner's note_acquired races anything we do here
+        # (note_release's owners.pop would erase the NEW holder's
+        # ownership record, stranding its stack entry into phantom
+        # edges). The cost: an erroneous release of an un-owned lock
+        # pops bookkeeping before the inner lock raises — acceptable,
+        # because that RuntimeError already fails the armed test
+        # loudly, while the race above corrupts CORRECT programs.
+        if self._reentrant:
+            depth = self._depth = self._depth - 1
+            if depth > 0:
+                try:
+                    self._inner.release()
+                except RuntimeError:  # not owned: undo the bookkeeping
+                    self._depth = depth + 1
+                    raise
+                return  # still held by this thread: keep the stack entry
+        self._monitor.note_release(id(self))
+        self._inner.release()
+
+    def __getattr__(self, name):
+        # Delegate everything else (locked(), _at_fork_reinit, ...) to
+        # the inner lock so hasattr/getattr probing observes exactly
+        # the real type's surface — Py<3.14's C RLock has no locked(),
+        # and a test must not pass or fail differently only because
+        # the detector is armed.
+        if name == "_inner":  # guard pre-__init__ lookups
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        kind = "RLock" if self._reentrant else "Lock"
+        return f"<Tracked{kind} {self._monitor._sites.get(id(self))}>"
+
+
+def _creation_site(depth: int = 2) -> str | None:
+    """``relpath:lineno`` of the allocating frame when it lives in the
+    package; None for stdlib/third-party allocations (left raw)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return None
+    filename = frame.f_code.co_filename
+    if not filename.startswith(_PACKAGE_DIR):
+        return None
+    rel = os.path.relpath(filename, os.path.dirname(_PACKAGE_DIR))
+    return f"{rel}:{frame.f_lineno}"
+
+
+def _patched_lock():
+    mon = _active
+    if mon is None or not mon.enabled:
+        return _real_lock()
+    site = _creation_site()
+    if site is None:
+        return _real_lock()
+    return _TrackedLock(mon, site, reentrant=False)
+
+
+def _patched_rlock():
+    mon = _active
+    if mon is None or not mon.enabled:
+        return _real_rlock()
+    site = _creation_site()
+    if site is None:
+        return _real_rlock()
+    return _TrackedLock(mon, site, reentrant=True)
+
+
+def arm() -> LockOrderMonitor:
+    """Start tracking: patch ``threading.Lock``/``RLock`` so
+    package-allocated locks are wrapped. Returns the monitor. Nested
+    arming is an error (one global graph at a time keeps the report
+    attributable to one test)."""
+    global _active
+    with _arm_lock:
+        if _active is not None and _active.enabled:
+            raise RuntimeError("lock-order detector is already armed")
+        mon = LockOrderMonitor()
+        _active = mon
+        threading.Lock = _patched_lock
+        threading.RLock = _patched_rlock
+        return mon
+
+
+def disarm() -> None:
+    """Stop tracking and restore ``threading``. Locks created while
+    armed keep working; their recording turns into a no-op."""
+    global _active
+    with _arm_lock:
+        if _active is not None:
+            _active.enabled = False
+        _active = None
+        threading.Lock = _real_lock
+        threading.RLock = _real_rlock
+
+
+@contextlib.contextmanager
+def armed():
+    mon = arm()
+    try:
+        yield mon
+    finally:
+        disarm()

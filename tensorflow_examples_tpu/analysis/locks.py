@@ -1,0 +1,259 @@
+"""Lock-discipline pass (graftlint pass 1, ISSUE 14 tentpole).
+
+Convention: shared mutable state in a threaded class is annotated at
+its defining assignment with a trailing guard comment::
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._free = []          # guard: self._lock
+            self.hits = 0            # guard: self._lock
+
+    _DEPTH = 0                       # guard: _SWITCH_LOCK  (module global)
+
+The pass then walks the whole FILE and reports every read or write of
+an annotated attribute (matched by attribute name) — or annotated
+module global (matched by name) — that is not lexically inside a
+``with`` statement whose context expression matches the guard. Guard
+matching is by the guard expression's final component (``self._lock``
+matches ``with self._lock:`` in the defining class and ``with
+self._lock:`` in a *different* class that owns the instances — the
+router's ``ReplicaState`` fields are guarded by the Router's lock, so
+the annotation there reads ``# guard: Router._lock``).
+
+Exemptions, in the order they are checked:
+
+* the defining class's ``__init__`` (construction precedes sharing);
+* functions whose name ends in ``_locked`` (the repo's caller-holds-
+  the-lock suffix convention, e.g. ``paged_kv._alloc_block_locked``);
+* lines carrying a ``graftlint: ignore`` comment (intentional
+  lock-free reads with the rationale in the comment, e.g. an atomic
+  int load published as "last-write-wins");
+* everything else lands in the committed suppression baseline or is a
+  finding.
+
+This is a lexical dominance check, not a dataflow analysis: a method
+that is only ever *called* with the lock held still flags (baseline it
+or rename it ``*_locked``). That is deliberate — the annotation makes
+the locking contract explicit at the definition, and the baseline
+makes every accepted exception explicit and counted.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tensorflow_examples_tpu.analysis import common
+
+_GUARD_RE = re.compile(r"#\s*guard:\s*([A-Za-z_][\w.]*)")
+
+
+def _guard_in_comment(comment: str) -> str | None:
+    m = _GUARD_RE.search(comment)
+    return m.group(1) if m else None
+
+
+def _last_component(expr_text: str) -> str:
+    return expr_text.rsplit(".", 1)[-1]
+
+
+def _with_item_text(item: ast.withitem) -> str:
+    return common.unparse(item.context_expr)
+
+
+class _Annotations:
+    """Guarded names collected from one file. Two classes in one file
+    may annotate the SAME attribute name under different guards (the
+    router's ``ReplicaState.completed`` vs ``_SetStats.completed``), so
+    each name keeps every annotation: an access is clean when ANY of
+    the name's guards encloses it, and exempt inside any annotating
+    class's ``__init__``."""
+
+    def __init__(self):
+        # attr name -> [(guard text, defining class name, def lineno)]
+        self.attrs: dict[str, list[tuple[str, str, int]]] = {}
+        # module-global name -> (guard text, defining lineno)
+        self.globals: dict[str, tuple[str, int]] = {}
+
+
+def _collect_annotations(src: common.SourceFile) -> _Annotations:
+    ann = _Annotations()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        guard = _guard_in_comment(src.comment(node.lineno))
+        if guard is None:
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                scope = src.scope_of(node)
+                cls = scope.split(".")[0] if scope != "-" else ""
+                ann.attrs.setdefault(t.attr, []).append(
+                    (guard, cls, node.lineno)
+                )
+            elif isinstance(t, ast.Name) and src.scope_of(node) == "-":
+                ann.globals[t.id] = (guard, node.lineno)
+    return ann
+
+
+def _enclosing_withs(src: common.SourceFile, node: ast.AST) -> list[str]:
+    """Context-expression texts of every ``with`` lexically enclosing
+    ``node`` within its own function (the whole statement stack,
+    innermost last). The walk STOPS at a ``def`` boundary: a ``with``
+    outside a nested function does not hold when that function later
+    runs — a deferred callback defined under the lock still touches
+    the state unguarded. Lambdas do NOT stop the walk: the repo's
+    lambdas are in-place sort/max keys that execute synchronously
+    under the enclosing block (``sorted(..., key=lambda kv:
+    self._chain_depth[...])`` in ``paged_kv.prefix_digest``)."""
+    out: list[str] = []
+    cur = src.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            out.extend(_with_item_text(i) for i in cur.items)
+        cur = src.parent(cur)
+    return out
+
+
+def _guard_matches(guard: str, with_texts: list[str]) -> bool:
+    tail = _last_component(guard)
+    for text in with_texts:
+        # `with self._lock:` / `with pool._lock:` / `with q.mutex:` —
+        # exact text or same final component. `with cond:` where the
+        # guard is `self._cond` also matches on the component name.
+        base = text.split(" as ")[0].strip()
+        # strip a trailing call: `with self._lock():` styles
+        if base.endswith("()"):
+            base = base[:-2]
+        if base == guard or _last_component(base) == tail:
+            return True
+    return False
+
+
+def _enclosing_function(src: common.SourceFile, node: ast.AST):
+    cur = src.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = src.parent(cur)
+    return None
+
+
+# Mutating container methods: calling one on an annotated name is a
+# write to the shared state, not a read — the read/write split is part
+# of the stable baseline key, and a maintainer triages the two kinds
+# differently (a lock-free snapshot *read* may be acceptable; a
+# lock-free *mutation* almost never is).
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "add", "discard", "update",
+    "setdefault", "sort", "reverse", "rotate", "move_to_end",
+})
+
+
+def _access_kind(src: common.SourceFile, node: ast.AST) -> str:
+    if isinstance(node, (ast.Attribute, ast.Name, ast.Subscript)):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return "write"
+        parent = src.parent(node)
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            return "write"
+        # `self._results[seq] = v` / `del self._free[0]` /
+        # `self.d[k] += 1` / `self.d[k][0] = v`: the annotated node is
+        # the Load-context *value* of a Subscript chain whose outermost
+        # link carries the Store/Del — the container is being mutated.
+        cur, p = node, parent
+        while isinstance(p, ast.Subscript) and p.value is cur:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                return "write"
+            gp = src.parent(p)
+            if isinstance(gp, ast.AugAssign) and gp.target is p:
+                return "write"
+            cur, p = p, gp
+        # `self._free.append(x)`: a known mutator method called on the
+        # annotated container.
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.value is node
+            and parent.attr in _MUTATORS
+        ):
+            gp = src.parent(parent)
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                return "write"
+    return "read"
+
+
+def check_file(src: common.SourceFile) -> list[common.Finding]:
+    ann = _collect_annotations(src)
+    if not ann.attrs and not ann.globals:
+        return []
+    findings: list[common.Finding] = []
+
+    def flag(node, name: str, guards: list[str],
+             owners: list[str]) -> None:
+        if src.ignored(node.lineno):
+            return
+        fn = _enclosing_function(src, node)
+        if fn is not None and fn.name.endswith("_locked"):
+            return  # caller-holds-the-lock suffix convention
+        scope = src.scope_of(node)
+        for owner in owners:
+            if owner and (
+                scope == f"{owner}.__init__"
+                or scope.startswith(f"{owner}.__init__.")
+            ):
+                return  # construction precedes sharing
+        withs = _enclosing_withs(src, node)
+        if any(_guard_matches(g, withs) for g in guards):
+            return
+        kind = _access_kind(src, node)
+        shown = "/".join(dict.fromkeys(guards))
+        findings.append(common.Finding(
+            pass_name="locks",
+            path=src.rel,
+            line=node.lineno,
+            scope=scope,
+            detail=f"{name}:{kind}",
+            message=(
+                f"{kind} of {name!r} (guarded by {shown}) outside a "
+                f"`with {shown}:` block"
+            ),
+        ))
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Attribute) and node.attr in ann.attrs:
+            entries = ann.attrs[node.attr]
+            if any(node.lineno == d for _, _, d in entries):
+                continue  # the annotated definition itself
+            flag(
+                node, node.attr,
+                [g for g, _, _ in entries],
+                [c for _, c, _ in entries],
+            )
+        elif isinstance(node, ast.Name) and node.id in ann.globals:
+            guard, def_line = ann.globals[node.id]
+            if node.lineno == def_line or src.scope_of(node) == "-":
+                continue  # definition / other module-level constants
+            # `global X` declarations are not accesses.
+            flag(node, node.id, [guard], [""])
+    return findings
+
+
+def run(paths, repo_root) -> list[common.Finding]:
+    findings: list[common.Finding] = []
+    for path in common.iter_python_files(paths):
+        src = common.load_source(path, repo_root)
+        if src is not None:
+            findings.extend(check_file(src))
+    return findings

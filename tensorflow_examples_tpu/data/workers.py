@@ -68,8 +68,8 @@ atexit.register(shutdown_all)
 # restored to the prior value when the last one closes, so pool-free
 # phases of the process run at the interpreter default again.
 _SWITCH_LOCK = threading.Lock()
-_SWITCH_DEPTH = 0
-_SAVED_SWITCH_INTERVAL: float | None = None
+_SWITCH_DEPTH = 0  # guard: _SWITCH_LOCK
+_SAVED_SWITCH_INTERVAL: float | None = None  # guard: _SWITCH_LOCK
 
 # Worker gauges are shared across pools (a rollback briefly overlaps the
 # old pipeline's pool with its replacement), so they move by DELTAS
@@ -138,8 +138,8 @@ class WorkerPool:
         _enter_fast_switch()  # restored when the last pool closes
         self._in: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._cond = threading.Condition()
-        self._results: dict[int, tuple[bool, object]] = {}
-        self._closed = False
+        self._results: dict[int, tuple[bool, object]] = {}  # guard: self._cond
+        self._closed = False  # guard: self._cond
         self._threads = [
             threading.Thread(
                 target=self._work, name=f"{name}-{i}", daemon=True
@@ -162,7 +162,9 @@ class WorkerPool:
 
     def submit(self, seq: int, item) -> None:
         """Queue one item; blocks when ``depth`` items are in flight."""
-        if self._closed:
+        if self._closed:  # graftlint: ignore — best-effort early check;
+            # a submit racing close() is caught by result()'s closed
+            # re-check, and the queue drain makes the item inert.
             raise RuntimeError(f"WorkerPool {self.name!r} is closed")
         self._in.put((seq, item))
 
@@ -244,9 +246,16 @@ class WorkerPool:
         """Poison-pill shutdown: discard queued work, stop every worker,
         wake any blocked ``result()`` caller. Idempotent; safe to call
         from finalizers, ``atexit``, and preemption paths."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._cond:
+            if self._closed:
+                return
+            # Flag + wake under the condition (ISSUE 14 lock-pass
+            # finding): the old unlocked write left a result() waiter
+            # to discover the close only on its next 0.1s poll tick —
+            # and only notified AFTER the joins below, up to
+            # num_workers * timeout later.
+            self._closed = True
+            self._cond.notify_all()
         # Discard pending submissions so pills reach the workers even
         # when the queue is full of un-started work.
         try:
@@ -265,14 +274,12 @@ class WorkerPool:
                     t.name,
                     timeout,
                 )
-        with self._cond:
-            self._cond.notify_all()
         _adjust_gauge(self._reg(), "data/input_workers", -self.num_workers)
         _exit_fast_switch()
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        return self._closed  # graftlint: ignore — monotonic bool snapshot
 
     def __enter__(self) -> "WorkerPool":
         return self

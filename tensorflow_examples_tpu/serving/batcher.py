@@ -49,6 +49,22 @@ wall/committed per token; ``serving/accepted_per_step`` and the
 ``serving/spec_*`` counters carry the acceptance story onto the
 schema-v8 stats line.
 
+Threading contract (checked by graftlint, ISSUE 14 — see
+docs/static_analysis.md): the batcher deliberately owns NO lock, so it
+carries no ``# guard:`` annotations. Every structure crossed by the
+frontend submit threads and the loop thread synchronizes itself — the
+per-class ``queue.Queue``s and the ``_arrival`` Event internally, the
+per-request shed/spec tallies through the LOCKED metrics registry
+(``telemetry/registry.py``, annotated there; this is why the
+lock pass surfaces no tally aggregation race here), and the brownout
+controller via its annotated ``_ttft`` sample lock plus documented
+atomic ``level`` reads (``serving/overload.py``). Everything else
+(``_active``/``_prefilling``/``_staged``/``_draining``) is
+single-writer on the loop thread with GIL-atomic len()/int/bool
+snapshot reads from close()/stats_line(), as noted field-by-field
+below. The runtime lock-order detector and the thread-leak guard
+(tests/conftest.py) cover the dynamic side in the overload tier.
+
 SLO classes (ISSUE 13): every request carries an ``slo`` class —
 ``interactive`` (default) or ``batch`` — and the batcher keeps one
 bounded queue per class. Interactive is served first at every decision

@@ -1486,6 +1486,8 @@ class InferenceEngine:
                 "serving/prefix_reused_tokens"
             ).inc(ctx)
 
+    # graftlint: hot-path — one bulk np.asarray per step is the budget;
+    # any additional host sync lands straight in TPOT (ISSUE 14).
     def decode(self, entries: Sequence[tuple[int, int, int, float, int]]):
         """One continuous-decode step. ``entries`` is the active set:
         (slot, input_token, seed, temperature, top_k) per request —
@@ -1567,6 +1569,8 @@ class InferenceEngine:
         self.registry.counter("serving/decode_tokens").inc(len(slots))
         return {slot: int(out[slot]) for slot in slots}
 
+    # graftlint: hot-path — same budget as decode(): the one bulk
+    # np.asarray(out) below is the step's accepted device->host sync.
     def verify(self, entries):
         """One SPECULATIVE decode step (ISSUE 11): score each active
         request's launch token plus its draft tokens in one compiled

@@ -117,7 +117,9 @@ class OverloadController:
         self._last_change = clock() - self.hold_s
         self._clear_since: float | None = None
         self._ttft_lock = threading.Lock()
-        self._ttft: collections.deque = collections.deque(
+        # Fed by frontend threads (note_ttft at every TTFT record),
+        # read by the batcher loop's tick.
+        self._ttft: collections.deque = collections.deque(  # guard: self._ttft_lock
             maxlen=_TTFT_WINDOW
         )
         registry.gauge("serving/brownout_level").set(0)

@@ -151,31 +151,38 @@ class PagedKVPool:
         self._registry = registry
         self._sharding = sharding
         self._alloc_arrays()
-        self.lengths = np.zeros((num_slots,), np.int32)
-        self.block_tables = np.full(
+        # Slot/block bookkeeping below is written by the batcher loop
+        # and read by frontend threads (occupancy, paged_stats, the
+        # /health digest) — all under self._lock; graftlint's lock pass
+        # checks the annotations (ISSUE 14). ``lengths``/
+        # ``block_tables`` are also READ by the engine from the loop
+        # thread (same thread as every writer), which per-file analysis
+        # does not see — documented in docs/static_analysis.md.
+        self.lengths = np.zeros((num_slots,), np.int32)  # guard: self._lock
+        self.block_tables = np.full(  # guard: self._lock
             (num_slots, self.max_blocks_per_slot), NULL_BLOCK, np.int32
         )
-        self._slot_blocks = np.zeros((num_slots,), np.int32)
-        self._free_slots = list(range(num_slots - 1, -1, -1))
-        self._free_blocks = list(range(self.num_blocks - 1, 0, -1))
-        self._refcount = np.zeros((self.num_blocks,), np.int32)
+        self._slot_blocks = np.zeros((num_slots,), np.int32)  # guard: self._lock
+        self._free_slots = list(range(num_slots - 1, -1, -1))  # guard: self._lock
+        self._free_blocks = list(range(self.num_blocks - 1, 0, -1))  # guard: self._lock
+        self._refcount = np.zeros((self.num_blocks,), np.int32)  # guard: self._lock
         # Prefix cache: (parent physical id | -1, tokens tuple) -> id;
         # reverse map for eviction; LRU order over refcount-0 cached
         # blocks ("evictable": published but unreferenced).
-        self._cache: dict[tuple, int] = {}
-        self._cache_key: dict[int, tuple] = {}
+        self._cache: dict[tuple, int] = {}  # guard: self._lock
+        self._cache_key: dict[int, tuple] = {}  # guard: self._lock
         # Content chain digests (ISSUE 12): per published block, the
         # replica- and restart-stable scheduler.chain_key of its whole
         # token prefix (+ its chain depth). The /health prefix digest
         # and the router's affinity score are built from these — never
         # from physical ids, which are meaningless across replicas.
-        self._chain_hash: dict[int, str] = {}
-        self._chain_depth: dict[int, int] = {}
-        self._evictable: OrderedDict[int, None] = OrderedDict()
-        self.prefix_hits = 0
-        self.prefix_misses = 0
+        self._chain_hash: dict[int, str] = {}  # guard: self._lock
+        self._chain_depth: dict[int, int] = {}  # guard: self._lock
+        self._evictable: OrderedDict[int, None] = OrderedDict()  # guard: self._lock
+        self.prefix_hits = 0  # guard: self._lock
+        self.prefix_misses = 0  # guard: self._lock
         self._lock = threading.Lock()
-        self._publish()
+        self._publish_locked()  # pre-sharing: no reader exists yet
 
     # ------------------------------------------------------ device state
 
@@ -215,7 +222,7 @@ class PagedKVPool:
         self._alloc_arrays()
         with self._lock:
             self._drop_cache_locked()
-            self._publish()
+            self._publish_locked()
 
     def _drop_cache_locked(self) -> None:
         for bid in list(self._evictable):
@@ -235,7 +242,7 @@ class PagedKVPool:
             else registry_mod.default_registry()
         )
 
-    def _publish(self) -> None:
+    def _publish_locked(self) -> None:
         reg = self._reg()
         active = self.num_slots - len(self._free_slots)
         usable = self.num_blocks - 1
@@ -259,7 +266,7 @@ class PagedKVPool:
             self.lengths[slot] = 0
             self.block_tables[slot, :] = NULL_BLOCK
             self._slot_blocks[slot] = 0
-            self._publish()
+            self._publish_locked()
             return slot
 
     def free(self, slot: int) -> None:
@@ -272,7 +279,7 @@ class PagedKVPool:
             self._slot_blocks[slot] = 0
             self.lengths[slot] = 0
             self._free_slots.append(slot)
-            self._publish()
+            self._publish_locked()
 
     def reset(self) -> None:
         """Release every slot and every block (post-warmup; the device
@@ -291,7 +298,7 @@ class PagedKVPool:
             self._refcount[:] = 0
             self.prefix_hits = 0
             self.prefix_misses = 0
-            self._publish()
+            self._publish_locked()
 
     @property
     def active_slots(self) -> int:
@@ -364,7 +371,7 @@ class PagedKVPool:
                 raise
             for bid in got:
                 self._refcount[bid] = 1
-            self._publish()
+            self._publish_locked()
             return got
 
     def assign(self, slot: int, blocks: list[int]) -> None:
@@ -380,7 +387,7 @@ class PagedKVPool:
             self.block_tables[slot, :] = NULL_BLOCK
             self.block_tables[slot, :len(blocks)] = blocks
             self._slot_blocks[slot] = len(blocks)
-            self._publish()
+            self._publish_locked()
 
     def ensure_position(self, slot: int, position: int) -> None:
         """Grow the slot's table to cover ``position`` (one block per
@@ -409,7 +416,7 @@ class PagedKVPool:
                 self._refcount[bid] = 1
                 self.block_tables[slot, have + i] = bid
             self._slot_blocks[slot] = need
-            self._publish()
+            self._publish_locked()
 
     def covered_positions(self, slot: int) -> int:
         """Token rows the slot's allocated blocks can hold — the cap on
@@ -452,7 +459,7 @@ class PagedKVPool:
             else:
                 self.prefix_misses += 1
                 self._reg().counter("serving/prefix_misses").inc()
-            self._publish()
+            self._publish_locked()
             return blocks, len(blocks) * bs
 
     def release_prefix(self, blocks: list[int]) -> None:
@@ -461,7 +468,7 @@ class PagedKVPool:
         with self._lock:
             for bid in blocks:
                 self._release_block_locked(bid)
-            self._publish()
+            self._publish_locked()
 
     def claim_prompt_blocks(self, slot: int, prompt) -> tuple[int, list]:
         """Claim and install ``slot``'s whole prompt table — longest
@@ -512,7 +519,7 @@ class PagedKVPool:
                 self._chain_hash[bid] = parent_hash
                 self._chain_depth[bid] = i + 1
                 parent = bid
-            self._publish()
+            self._publish_locked()
 
     def _chains_locked(self) -> int:
         """Distinct chain HEADS — root blocks (parent -1) of the

@@ -203,23 +203,37 @@ class ReplicaState:
     """One replica as the router sees it: probe-sourced load numbers +
     router-side rollout state."""
 
+    # Mutable fields are written by the probe loop, the dispatcher, and
+    # the rollout/supervision verbs — three thread families — so every
+    # write (and every multi-field read that must not tear) happens
+    # under the owning Router's lock. The `# guard:` annotations below
+    # cover the state-machine/bookkeeping fields and make that contract
+    # machine-checked (graftlint lock pass, ISSUE 14); the accepted
+    # lock-free reads inside eligible() (called from the autoscaler/
+    # chaos threads, where one stale decision is harmless) live in the
+    # committed baseline. The probe-sourced load numbers (queue_depth,
+    # kv_occupancy, active_requests, role, prefix digest, ...) are
+    # deliberately UNANNOTATED: they are last-write-wins snapshots the
+    # probe rewrites every sweep — the lint does not check them, and
+    # cross-thread readers (load_score() from the supervisor tier)
+    # accept staleness by design.
     def __init__(self, url: str, set_name: str = "base"):
         self.url = url.rstrip("/")
         self.set_name = set_name
-        self.drained = False          # router-side: operator rollout
-        self.draining_remote = False  # replica-side: its own SIGTERM
-        self.quarantined = False      # supervisor-side: being restarted
-        self.failures = 0             # consecutive probe failures
-        self.probed = False
+        self.drained = False          # guard: Router._lock (operator rollout)
+        self.draining_remote = False  # guard: Router._lock (replica SIGTERM)
+        self.quarantined = False      # guard: Router._lock (being restarted)
+        self.failures = 0             # guard: Router._lock (consecutive probe failures)
+        self.probed = False           # guard: Router._lock
         self.last_probe_unix = 0.0
         self.queue_depth = 0.0
         self.kv_occupancy = 0.0
         self.active_requests = 0.0
         self.slots = 0
         self.post_warmup_recompiles = 0
-        self.dispatched = 0
-        self.completed = 0
-        self.errors = 0
+        self.dispatched = 0           # guard: Router._lock
+        self.completed = 0            # guard: Router._lock
+        self.errors = 0               # guard: Router._lock
         # Cache-aware scheduling state (ISSUE 12), probe-sourced: the
         # replica's role (mixed serves everything — the pre-ISSUE-12
         # behavior), its prefix-cache block size, and the content chain
@@ -240,14 +254,15 @@ class ReplicaState:
         # "half_open" (cooldown expired — exactly ONE trial in flight
         # at a time; success readmits, failure re-ejects). Transitions
         # happen under the Router's lock.
-        self.breaker = "closed"
-        self.consec_errors = 0        # consecutive dispatch failures
-        self.open_until = 0.0         # monotonic: open -> half_open
-        self.half_open_trial = False  # a half-open trial is in flight
+        self.breaker = "closed"       # guard: Router._lock
+        self.consec_errors = 0        # guard: Router._lock (consecutive dispatch failures)
+        self.open_until = 0.0         # guard: Router._lock (monotonic: open -> half_open)
+        self.half_open_trial = False  # guard: Router._lock (trial in flight)
 
-    def breaker_poll(self, now: float) -> None:
+    def breaker_poll_locked(self, now: float) -> None:
         """Open -> half-open once the cooldown expires (caller holds
-        the router lock)."""
+        the router lock — the ``_locked`` suffix is the repo's
+        caller-holds-the-lock convention, checked by graftlint)."""
         if self.breaker == "open" and now >= self.open_until:
             self.breaker = "half_open"
             self.half_open_trial = False
@@ -282,7 +297,8 @@ class ReplicaState:
         makes killing a role-holder an ordinary failover."""
         return role is None or self.role in (role, "mixed")
 
-    def snapshot(self) -> dict:
+    def snapshot_locked(self) -> dict:
+        # Caller holds Router._lock (graftlint lock-pass convention).
         return {
             "url": self.url,
             "set": self.set_name,
@@ -315,13 +331,13 @@ class _SetStats:
 
     def __init__(self):
         self.lock = threading.Lock()
-        self.requests = 0
-        self.completed = 0
-        self.errors = 0
-        self.ttft: list[float] = []
-        self.tpot: list[float] = []
-        self.e2e: list[float] = []
-        self.tokens = 0
+        self.requests = 0             # guard: self.lock
+        self.completed = 0            # guard: self.lock
+        self.errors = 0               # guard: self.lock
+        self.ttft: list[float] = []   # guard: self.lock
+        self.tpot: list[float] = []   # guard: self.lock
+        self.e2e: list[float] = []    # guard: self.lock
+        self.tokens = 0               # guard: self.lock
         self.t0 = time.monotonic()
 
     def record(self, status: int, reply: dict) -> None:
@@ -427,10 +443,11 @@ class Router:
                 # probe loop (ISSUE 10 satellite).
                 with self._lock:
                     r.failures += 1
-                if r.failures == self.cfg.unhealthy_after:
+                    failures = r.failures
+                if failures == self.cfg.unhealthy_after:
                     log.warning(
                         "replica %s unreachable or malformed after %d "
-                        "probes — rotating out", r.url, r.failures,
+                        "probes — rotating out", r.url, failures,
                     )
                 continue
             # Any HTTP answer means the process is alive; a 503 with
@@ -472,7 +489,7 @@ class Router:
                 # breaker's cooldown has expired, a green /health is
                 # the trial — the replica rejoins dispatch without
                 # risking a live request on it.
-                r.breaker_poll(time.monotonic())
+                r.breaker_poll_locked(time.monotonic())
                 if (
                     status == 200
                     and r.breaker == "half_open"
@@ -485,10 +502,12 @@ class Router:
                         "replica %s readmitted (half-open /health probe "
                         "green)", r.url,
                     )
-        self.registry.gauge("router/replicas_eligible").set(
-            sum(r.eligible(self.cfg.unhealthy_after)
-                for r in self.replicas)
-        )
+        with self._lock:
+            eligible = sum(
+                r.eligible(self.cfg.unhealthy_after)
+                for r in self.replicas
+            )
+        self.registry.gauge("router/replicas_eligible").set(eligible)
 
     def _probe_loop(self) -> None:
         while not self._stop.is_set():
@@ -563,11 +582,15 @@ class Router:
 
     def drain(self, url: str) -> bool:
         """Stop dispatching to ``url`` (in-flight requests finish on
-        the replica; nothing is cancelled). The rollout verb."""
+        the replica; nothing is cancelled). The rollout verb. The flag
+        flips under the lock (ISSUE 14 lock-pass finding: an unlocked
+        write here raced pick()'s locked eligibility read — quarantine/
+        readmit always locked, drain/undrain had drifted)."""
         r = self._find(url)
         if r is None:
             return False
-        r.drained = True
+        with self._lock:
+            r.drained = True
         log.info("replica %s drained (router-side)", r.url)
         return True
 
@@ -575,8 +598,9 @@ class Router:
         r = self._find(url)
         if r is None:
             return False
-        r.drained = False
-        r.failures = 0
+        with self._lock:
+            r.drained = False
+            r.failures = 0
         return True
 
     # ------------------------------------------------------ supervision
@@ -633,7 +657,7 @@ class Router:
             now = time.monotonic()
             pool = []
             for r in self.replicas:
-                r.breaker_poll(now)
+                r.breaker_poll_locked(now)
                 if (
                     r.eligible(self.cfg.unhealthy_after, now)
                     and r not in exclude
@@ -697,7 +721,7 @@ class Router:
         hard_down = False
         with self._lock:
             for r in self.replicas:
-                r.breaker_poll(now)
+                r.breaker_poll_locked(now)
                 if r.eligible(self.cfg.unhealthy_after, now):
                     return False
                 if (
@@ -1167,66 +1191,71 @@ class Router:
             k: v for k, v in self.registry.gauge_values().items()
             if k.startswith("router/")
         }
-        probed = [r for r in self.replicas if r.probed]
-        occ = [r.kv_occupancy for r in probed]
-        serving = {
-            "active_requests": int(
-                sum(r.active_requests for r in probed)
-            ),
-            "queue_depth": int(sum(r.queue_depth for r in probed)),
-            "slots": int(sum(r.slots for r in probed)),
-            "kv_occupancy": (sum(occ) / len(occ)) if occ else 0.0,
-            "post_warmup_recompiles": int(
-                sum(r.post_warmup_recompiles for r in probed)
-            ),
-            "draining": 0,
-            "replicas": len(self.replicas),
-            "router_dispatched": int(
-                counters.get("router/dispatched_total", 0)
-            ),
-            "router_retries": int(
-                counters.get("router/retries_total", 0)
-            ),
-            "router_no_replica": int(
-                counters.get("router/no_replica_total", 0)
-            ),
-            # --- v7 (ISSUE 10): fault-tolerance counters ---
-            "router_ejections": int(
-                counters.get("router/ejections_total", 0)
-            ),
-            "router_readmits": int(
-                counters.get("router/readmits_total", 0)
-            ),
-            "router_hedges": int(
-                counters.get("router/hedges_total", 0)
-            ),
-            "router_failovers": int(
-                counters.get("router/failovers_total", 0)
-            ),
-            "router_restarts": int(
-                counters.get("router/restarts_total", 0)
-            ),
-            # --- v9 (ISSUE 12): fleet-summed prefix-cache summary ---
-            "prefix_blocks": int(
-                sum(r.prefix_blocks for r in probed)
-            ),
-            "prefix_chains": int(
-                sum(r.prefix_chains for r in probed)
-            ),
-            # --- v10 (ISSUE 13): fleet overload view — the WORST
-            # replica's brownout level (one browning-out replica is an
-            # incident, not an average), summed transitions, and
-            # whether any affinity digest is capped.
-            "brownout_level": int(
-                max((r.brownout_level for r in probed), default=0)
-            ),
-            "brownout_transitions": int(
-                sum(r.brownout_transitions for r in probed)
-            ),
-            "digest_truncated": int(
-                any(r.digest_truncated for r in probed)
-            ),
-        }
+        with self._lock:
+            # One consistent fleet snapshot: the probe loop rewrites
+            # these fields mid-sweep, and a line aggregated across a
+            # torn sweep would pair one replica's new occupancy with
+            # another's stale brownout level (ISSUE 14 lock pass).
+            probed = [r for r in self.replicas if r.probed]
+            occ = [r.kv_occupancy for r in probed]
+            serving = {
+                "active_requests": int(
+                    sum(r.active_requests for r in probed)
+                ),
+                "queue_depth": int(sum(r.queue_depth for r in probed)),
+                "slots": int(sum(r.slots for r in probed)),
+                "kv_occupancy": (sum(occ) / len(occ)) if occ else 0.0,
+                "post_warmup_recompiles": int(
+                    sum(r.post_warmup_recompiles for r in probed)
+                ),
+                "draining": 0,
+                "replicas": len(self.replicas),
+                "router_dispatched": int(
+                    counters.get("router/dispatched_total", 0)
+                ),
+                "router_retries": int(
+                    counters.get("router/retries_total", 0)
+                ),
+                "router_no_replica": int(
+                    counters.get("router/no_replica_total", 0)
+                ),
+                # --- v7 (ISSUE 10): fault-tolerance counters ---
+                "router_ejections": int(
+                    counters.get("router/ejections_total", 0)
+                ),
+                "router_readmits": int(
+                    counters.get("router/readmits_total", 0)
+                ),
+                "router_hedges": int(
+                    counters.get("router/hedges_total", 0)
+                ),
+                "router_failovers": int(
+                    counters.get("router/failovers_total", 0)
+                ),
+                "router_restarts": int(
+                    counters.get("router/restarts_total", 0)
+                ),
+                # --- v9 (ISSUE 12): fleet-summed prefix-cache summary ---
+                "prefix_blocks": int(
+                    sum(r.prefix_blocks for r in probed)
+                ),
+                "prefix_chains": int(
+                    sum(r.prefix_chains for r in probed)
+                ),
+                # --- v10 (ISSUE 13): fleet overload view — the WORST
+                # replica's brownout level (one browning-out replica is an
+                # incident, not an average), summed transitions, and
+                # whether any affinity digest is capped.
+                "brownout_level": int(
+                    max((r.brownout_level for r in probed), default=0)
+                ),
+                "brownout_transitions": int(
+                    sum(r.brownout_transitions for r in probed)
+                ),
+                "digest_truncated": int(
+                    any(r.digest_truncated for r in probed)
+                ),
+            }
         return {
             "schema_version": schema.SERVING_SCHEMA_VERSION,
             "kind": "serving",
@@ -1241,36 +1270,44 @@ class Router:
             "serving": serving,
         }
 
+    def replica_snapshots(self) -> list[dict]:
+        """Per-replica state docs for ``/replicas`` — each snapshot
+        taken under the lock so the probe loop cannot tear it
+        mid-render (ISSUE 14 lock pass)."""
+        with self._lock:
+            return [r.snapshot_locked() for r in self.replicas]
+
     def health_payload(self) -> tuple[int, dict]:
-        eligible = [
-            r for r in self.replicas
-            if r.eligible(self.cfg.unhealthy_after)
-        ]
-        body = {
-            "ok": bool(eligible),
-            "role": "router",
-            "replicas": len(self.replicas),
-            "eligible": len(eligible),
-            "sets": sorted({r.set_name for r in self.replicas}),
-            # Fleet overload view (ISSUE 13): worst replica's brownout
-            # level + fleet-summed transition count, and the fast-fail
-            # outage counter — the operator's "is the fleet browning
-            # out or down" one-liner.
-            "brownout_max": int(max(
-                (r.brownout_level for r in self.replicas), default=0
-            )),
-            "brownout_transitions": int(sum(
-                r.brownout_transitions for r in self.replicas
-            )),
-            "fleet_down_total": int(
-                self.registry.counter_values().get(
-                    "router/fleet_down_total", 0
-                )
-            ),
-            "digest_truncated": bool(any(
-                r.digest_truncated for r in self.replicas
-            )),
-        }
+        with self._lock:
+            eligible = [
+                r for r in self.replicas
+                if r.eligible(self.cfg.unhealthy_after)
+            ]
+            body = {
+                "ok": bool(eligible),
+                "role": "router",
+                "replicas": len(self.replicas),
+                "eligible": len(eligible),
+                "sets": sorted({r.set_name for r in self.replicas}),
+                # Fleet overload view (ISSUE 13): worst replica's
+                # brownout level + fleet-summed transition count, and
+                # the fast-fail outage counter — the operator's "is the
+                # fleet browning out or down" one-liner.
+                "brownout_max": int(max(
+                    (r.brownout_level for r in self.replicas), default=0
+                )),
+                "brownout_transitions": int(sum(
+                    r.brownout_transitions for r in self.replicas
+                )),
+                "digest_truncated": bool(any(
+                    r.digest_truncated for r in self.replicas
+                )),
+            }
+        body["fleet_down_total"] = int(
+            self.registry.counter_values().get(
+                "router/fleet_down_total", 0
+            )
+        )
         return (200 if body["ok"] else 503), body
 
 
@@ -1378,9 +1415,7 @@ class RouterFrontend:
                     elif path == "/replicas":
                         self._send_json(
                             200,
-                            {"replicas": [
-                                r.snapshot() for r in router.replicas
-                            ]},
+                            {"replicas": router.replica_snapshots()},
                         )
                     elif path == "/window":
                         self._send_json(200, router.stats_line())

@@ -8,6 +8,7 @@ initializes — hence here, at conftest import time.
 """
 
 import os
+import time
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -129,3 +130,93 @@ def mesh8():
     from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
 
     return create_mesh(MeshConfig(data=8))
+
+
+# ----------------------------------------------- ISSUE 14: race guards
+#
+# Two autouse guards arm the serving-fleet test modules (the tiers
+# with real thread traffic — chaos, router, overload, serving):
+#
+# * lock-order cycle detector (analysis/lockorder.py): every
+#   package-allocated threading.Lock/RLock is wrapped while the test
+#   runs; acquisitions build a held-before graph and a cycle is a
+#   failure AT ORDERING-ESTABLISHMENT time — no actual deadlock (or
+#   lucky interleaving) needed. This is the runtime complement of
+#   graftlint's static lock pass (docs/static_analysis.md).
+# * thread-leak guard: a serving/router/chaos/overload test that
+#   leaves a batcher/probe/supervisor/autoscaler/worker loop thread
+#   behind fails loudly instead of slowing every later test.
+
+_LOCKORDER_MODULES = (
+    "test_chaos.py",
+    "test_router.py",
+    "test_overload.py",
+)
+_THREAD_GUARD_MODULES = _LOCKORDER_MODULES + ("test_serving.py",)
+
+# Loop/pool threads repo code owns; anything with these names still
+# alive after a test (plus a grace period for joins in teardown
+# paths) is an orphan. Transient per-request threads (router-dispatch/
+# router-hedge, http.server handler threads) are excluded: an
+# abandoned hedge loser may legally outlive its request by design.
+_OWNED_THREAD_NAMES = (
+    "serving-batcher",
+    "serving-frontend",
+    "router-probe",
+    "router-frontend",
+    "replica-supervisor",
+    "fleet-autoscaler",
+    "telemetry-metrics-server",
+    "train-watchdog",
+    "input_worker",
+)
+
+
+def _owned(thread) -> bool:
+    name = thread.name or ""
+    return any(name.startswith(p) for p in _OWNED_THREAD_NAMES)
+
+
+@pytest.fixture(autouse=True)
+def _serving_thread_leak_guard(request):
+    if request.node.fspath.basename not in _THREAD_GUARD_MODULES:
+        yield
+        return
+    import threading as _threading
+
+    before = set(_threading.enumerate())
+    yield
+    deadline = time.monotonic() + 5.0
+    leaked = []
+    while True:
+        leaked = [
+            t for t in _threading.enumerate()
+            if t not in before and t.is_alive() and _owned(t)
+        ]
+        if not leaked or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    assert not leaked, (
+        "test leaked serving loop thread(s): "
+        f"{[t.name for t in leaked]} — close() the batcher/router/"
+        "supervisor/pool it started (ISSUE 14 thread-leak guard)"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_guard(request):
+    if request.node.fspath.basename not in _LOCKORDER_MODULES:
+        yield
+        return
+    from tensorflow_examples_tpu.analysis import lockorder
+
+    mon = lockorder.arm()
+    try:
+        yield
+    finally:
+        lockorder.disarm()
+    assert not mon.violations, (
+        "lock-order cycle(s) established during this test (deadlock "
+        "hazard even if this run did not interleave into it):\n  "
+        + "\n  ".join(mon.violations)
+    )

@@ -1,0 +1,49 @@
+"""Known-bad JAX-hazard fixture: one pinned true positive per hazard
+family (traced branch, traced host sync, hot-path sync,
+use-after-donate — incl. the _run_compiled funnel). Never imported;
+graftlint parses it as source."""
+
+import jax
+import numpy as np
+
+
+def _step(params, x, flag):
+    if flag > 0:  # BAD: python branch on a traced value
+        x = x + 1
+    y = float(x)  # BAD: host sync on a traced value
+    return x * y
+
+
+step = jax.jit(_step)
+
+
+def _donor(params, kv):
+    return kv
+
+
+run = jax.jit(_donor, donate_argnums=(1,))
+
+
+def caller(params, kv):
+    out = run(params, kv)
+    tail = kv[0]  # BAD: read after kv was donated to `run`
+    return out, tail
+
+
+class Engine:
+    def _run_compiled(self, kind, fn, *args):
+        return fn(*args)
+
+    def stepper(self, tokens):
+        state = make_state()
+        out = self._run_compiled("step", run, self.params, state)
+        return out, state  # BAD: state was donated through the funnel
+
+
+def make_state():
+    return object()
+
+
+# graftlint: hot-path
+def decode_host(batch):
+    return np.asarray(batch)  # BAD: host sync on the marked hot path

@@ -1,0 +1,45 @@
+"""Known-good JAX-hazard fixture: the repo idioms the pass must NOT
+flag — static-marker del, partial-bound bucket ladders, None/string
+dispatch, same-statement donate-and-reassign, host int() on the hot
+path. Must produce ZERO findings."""
+
+import functools
+
+import jax
+import numpy as np
+
+
+def _impl(bucket, params, tokens, length):
+    del bucket  # static: encoded in tokens.shape
+    if length is None:  # host-side None dispatch: clean
+        return tokens
+    if isinstance(tokens, tuple):  # host-side structure dispatch: clean
+        tokens = tokens[0]
+    if len(tokens) == 4:  # len() of a pytree: host-side shape, clean
+        pass
+    return tokens
+
+
+fns = {
+    b: jax.jit(functools.partial(_impl, b), donate_argnums=(0,))
+    for b in (8, 16)
+}
+
+
+class Engine:
+    def _run_compiled(self, kind, fn, *args):
+        return fn(*args)
+
+    def stepper(self, tokens, n):
+        # Donate-and-reassign in ONE statement (the engine's pool
+        # idiom): the donated buffer is a target of the very call.
+        self.params, out = self._run_compiled(
+            "step", fns[8], self.params, tokens, n
+        )
+        return out
+
+
+# graftlint: hot-path
+def decode_host(entries):
+    slots = [int(e) for e in entries]  # host int(): not a device sync
+    return slots

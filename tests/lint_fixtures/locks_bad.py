@@ -1,0 +1,37 @@
+"""Known-bad lock-discipline fixture: every finding here is pinned
+exactly by tests/test_lint.py (file NOT collected by pytest — no
+test_ prefix — and never imported; graftlint parses it as source)."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = [1, 2, 3]  # guard: self._lock
+        self.hits = 0  # guard: self._lock
+
+    def take(self):
+        with self._lock:
+            if self._free:
+                self.hits += 1
+                return self._free.pop()
+        return None
+
+    def peek(self):
+        return len(self._free)  # BAD: annotated read outside the lock
+
+    def put(self, x):
+        self._free.append(x)  # BAD: annotated mutation outside the lock
+
+    def reset_hits(self):
+        self.hits = 0  # BAD: annotated write outside the lock
+
+
+_DEPTH = 0  # guard: _STATE_LOCK
+_STATE_LOCK = threading.Lock()
+
+
+def bump():
+    global _DEPTH
+    _DEPTH += 1  # BAD: module-global write outside _STATE_LOCK

@@ -1,0 +1,57 @@
+"""Known-good lock-discipline fixture: every exemption the pass
+documents, in one file — must produce ZERO findings."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # Construction precedes sharing: writes in the defining
+        # class's __init__ are exempt.
+        self._free = [1, 2, 3]  # guard: self._lock
+        self.hits = 0  # guard: self._lock
+        self._free = list(self._free)
+
+    def take(self):
+        with self._lock:
+            if self._free:
+                self.hits += 1
+                return self._free.pop()
+        return None
+
+    def _compact_locked(self):
+        # Caller-holds-the-lock suffix convention.
+        self._free = sorted(self._free)
+
+    def approx_depth(self):
+        return len(self._free)  # graftlint: ignore — racy read is fine here
+
+
+class Owner:
+    """Cross-class guard: Pool-shaped state guarded by the OWNER's
+    lock (the router's ReplicaState pattern) — matching is by the
+    guard's final component."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = State()
+
+    def poke(self):
+        with self._lock:
+            self.state.flag = True
+
+
+class State:
+    def __init__(self):
+        self.flag = False  # guard: Owner._lock
+
+
+_DEPTH = 0  # guard: _STATE_LOCK
+_STATE_LOCK = threading.Lock()
+
+
+def bump():
+    global _DEPTH
+    with _STATE_LOCK:
+        _DEPTH += 1

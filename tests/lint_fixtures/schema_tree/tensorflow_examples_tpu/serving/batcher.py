@@ -1,0 +1,16 @@
+"""Mini stamper for the drift-pass golden: stamps one declared key,
+one ROGUE key no schema tuple declares, the f-string-expanded
+per-class keys, and registers one documented + one undocumented
+counter."""
+
+CLASSES = ("a", "b")
+
+
+def stats_line(reg):
+    reg.counter("serving/documented_total").inc()
+    reg.counter("serving/undocumented_total").inc()
+    serving = {"active_requests": 1}
+    serving["rogue_key"] = 2
+    for cls in CLASSES:
+        serving[f"lat_{cls}"] = 0.0
+    return serving

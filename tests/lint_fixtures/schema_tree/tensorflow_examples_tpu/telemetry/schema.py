@@ -1,0 +1,9 @@
+"""Mini schema module for the drift-pass golden (tests/test_lint.py).
+
+``ghost_key`` is declared but nothing stamps it (unstamped + it is
+also absent from the docs); the per-class ``lat_a``/``lat_b`` keys pin
+the f-string cartesian expansion against the batcher's loop stamps.
+"""
+
+SERVING_KEYS = ("active_requests", "lat_a", "lat_b")
+SERVING_KEYS_V6 = ("ghost_key",)

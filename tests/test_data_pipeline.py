@@ -281,6 +281,66 @@ class TestWorkerPool:
         assert p95 is not None
         assert reg.counter("data/worker_items").value == 8
 
+    def test_close_flips_closed_under_the_condition(self):
+        """Regression for the ISSUE 14 graftlint lock-pass finding:
+        ``close()`` set ``_closed`` OUTSIDE ``self._cond`` and only
+        notified after joining every worker — a ``result()`` waiter
+        discovered the shutdown on its next 0.1s poll tick (or up to
+        ``num_workers * join_timeout`` later), not when it happened.
+        The flag now flips and notifies under the condition: pinned by
+        holding the condition from another thread and asserting
+        close() blocks until release."""
+        pool = workers_mod.WorkerPool(lambda x: x, 1)
+        acquired = threading.Event()
+        release = threading.Event()
+
+        def hold():
+            with pool._cond:
+                acquired.set()
+                release.wait(5)
+
+        closed = threading.Event()
+
+        def close():
+            pool.close()
+            closed.set()
+
+        t1 = threading.Thread(target=hold, daemon=True)
+        t1.start()
+        assert acquired.wait(2)
+        t2 = threading.Thread(target=close, daemon=True)
+        t2.start()
+        time.sleep(0.1)
+        assert not closed.is_set(), (
+            "close() ran past the condition while a waiter held it — "
+            "the closed flag is not condition-guarded"
+        )
+        release.set()
+        assert closed.wait(5)
+        t1.join(2)
+        t2.join(2)
+
+    def test_close_wakes_blocked_result_waiter(self):
+        """A result() caller blocked on a seq that will never arrive
+        must be released by close() with the closed-pool RuntimeError
+        (not strand until some later poll/join)."""
+        pool = workers_mod.WorkerPool(lambda x: x, 1)
+        outcome = []
+
+        def wait_forever():
+            try:
+                pool.result(999)  # never submitted
+            except RuntimeError as e:
+                outcome.append(e)
+
+        t = threading.Thread(target=wait_forever, daemon=True)
+        t.start()
+        time.sleep(0.05)  # let it enter the cond wait
+        pool.close()
+        t.join(3)
+        assert not t.is_alive(), "result() waiter never released"
+        assert outcome and "closed" in str(outcome[0])
+
 
 # ------------------------------------------- parallel ImageNet pipeline
 

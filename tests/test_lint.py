@@ -1,0 +1,962 @@
+"""graftlint — the repo's own static analysis suite (ISSUE 14).
+
+Three layers of coverage:
+
+* **Fixture goldens** — known-bad files under ``tests/lint_fixtures/``
+  produce EXACTLY the pinned finding list per pass; known-good files
+  (every documented exemption/idiom in one place) produce zero. The
+  fixtures are the pass's contract: loosen a check and the bad pin
+  fails, tighten it wrongly and the good pin fails.
+* **Plumbing** — suppression-baseline round-trip (accepted counts,
+  excess surfacing, stale-entry detection), the CLI's 0/1/2 exit-code
+  contract, and bench_gate's baseline-growth WARN.
+* **The tier-1 gate itself** — ``graftlint --all`` over the whole
+  package with the committed baseline must exit 0: any new unguarded
+  access, JAX hazard, or schema drift in the tree is a CI failure
+  here, not a review comment. The runtime lock-order detector
+  (armed per-test by conftest for the chaos/router/overload modules)
+  gets its own unit pins: a cycle is recorded at
+  ordering-establishment time with no deadlock needed.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tensorflow_examples_tpu.analysis import (
+    common,
+    drift,
+    jaxhaz,
+    lockorder,
+    locks,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+PACKAGE = os.path.join(REPO, "tensorflow_examples_tpu")
+BASELINE = os.path.join(REPO, "tools", "graftlint_baseline.json")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_gate  # noqa: E402
+import graftlint  # noqa: E402
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _details(findings):
+    return sorted((f.line, f.detail) for f in findings)
+
+
+# ------------------------------------------------------ fixture goldens
+
+
+class TestLockPassFixtures:
+    def test_known_bad_exact_findings(self):
+        got = _details(locks.run([_fixture("locks_bad.py")], REPO))
+        assert got == [
+            (22, "_free:read"),
+            (25, "_free:write"),  # .append() mutates the container
+            (28, "hits:write"),
+            (37, "_DEPTH:write"),
+        ]
+
+    def test_known_good_is_clean(self):
+        assert locks.run([_fixture("locks_good.py")], REPO) == []
+
+    def test_finding_keys_are_line_number_free(self):
+        (f, *_rest) = sorted(
+            locks.run([_fixture("locks_bad.py")], REPO),
+            key=lambda f: f.line,
+        )
+        assert str(f.line) not in f.key
+        assert f.key.startswith("locks:")
+        assert f.scope in f.key and f.detail in f.key
+
+
+class TestJaxPassFixtures:
+    def test_known_bad_exact_findings(self):
+        got = _details(jaxhaz.run([_fixture("jax_bad.py")], REPO))
+        assert got == [
+            (11, "traced-branch:flag"),
+            (13, "traced-sync:float()"),
+            (29, "use-after-donate:kv"),
+            (40, "use-after-donate:state"),
+            (49, "host-sync:np.asarray"),
+        ]
+
+    def test_known_good_is_clean(self):
+        # Pins the static-marker del, partial-bound buckets, None/
+        # isinstance/len dispatch, donate-and-reassign-in-one-statement
+        # (the engine's pool idiom), and host int() on the hot path.
+        assert jaxhaz.run([_fixture("jax_good.py")], REPO) == []
+
+
+class TestSchemaPassFixtures:
+    def test_mini_tree_exact_findings(self):
+        root = _fixture("schema_tree")
+        got = sorted(
+            f.detail
+            for f in drift.run(
+                [os.path.join(root, "tensorflow_examples_tpu")], root
+            )
+        )
+        assert got == [
+            "undocumented-counter:serving/undocumented_total",
+            "undocumented-schema-key:ghost_key",
+            "unknown-serving-key:rogue_key",
+            "unstamped-schema-key:ghost_key",
+        ]
+
+
+# ------------------------------------------------------------- baseline
+
+
+class TestBaseline:
+    def _findings(self, path="locks_bad.py"):
+        return locks.run([_fixture(path)], REPO)
+
+    def test_round_trip_suppresses_everything(self, tmp_path):
+        findings = self._findings()
+        bl_path = str(tmp_path / "bl.json")
+        common.Baseline.from_findings(findings).save(bl_path)
+        loaded = common.Baseline.load(bl_path)
+        assert loaded.total() == len(findings)
+        reported, suppressed, stale = common.apply_baseline(
+            findings, loaded
+        )
+        assert reported == [] and stale == []
+        assert len(suppressed) == len(findings)
+
+    def test_excess_occurrences_surface_beyond_accepted_count(self):
+        findings = self._findings()
+        dup = findings[0]
+        bl = common.Baseline({dup.key: 1})
+        reported, suppressed, _ = common.apply_baseline(
+            findings + [dup], bl
+        )
+        # one accepted occurrence suppressed; the duplicate reports
+        assert dup.key in [f.key for f in reported]
+        assert len(suppressed) == 1
+
+    def test_removed_finding_reports_stale_entry(self, tmp_path):
+        findings = self._findings()
+        bl = common.Baseline.from_findings(findings)
+        bl.counts["locks:gone/file.py:X.y:z:read"] = 1
+        reported, _, stale = common.apply_baseline(findings, bl)
+        assert reported == []
+        assert stale == ["locks:gone/file.py:X.y:z:read"]
+
+    def test_malformed_baseline_is_a_loud_error(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"version": 99, "findings": {}}')
+        with pytest.raises(ValueError, match="not a graftlint baseline"):
+            common.Baseline.load(str(p))
+        p.write_text('{"version": 1, "findings": {"k": -2}}')
+        with pytest.raises(ValueError, match="positive"):
+            common.Baseline.load(str(p))
+
+
+# ------------------------------------------------------------------ CLI
+
+
+class TestCLI:
+    def test_clean_tree_exits_0(self):
+        rc = graftlint.main(
+            ["--pass", "locks", "--no-baseline", _fixture("locks_good.py")]
+        )
+        assert rc == 0
+
+    def test_findings_exit_1(self, capsys):
+        rc = graftlint.main(
+            ["--pass", "locks", "--no-baseline", _fixture("locks_bad.py")]
+        )
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "[locks]" in out and "locks_bad.py:22" in out
+
+    def test_missing_path_exits_2(self):
+        assert graftlint.main(["--no-baseline", "/no/such/path.py"]) == 2
+
+    def test_conflicting_flags_exit_2(self):
+        assert graftlint.main(
+            ["--all", "--pass", "locks", _fixture("locks_good.py")]
+        ) == 2
+        assert graftlint.main(
+            ["--no-baseline", "--update-baseline",
+             _fixture("locks_good.py")]
+        ) == 2
+
+    def test_update_baseline_then_clean_then_stale(self, tmp_path,
+                                                   capsys):
+        bl = str(tmp_path / "bl.json")
+        mod = tmp_path / "mod.py"
+        mod.write_text(open(_fixture("locks_bad.py")).read())
+        root = ["--repo-root", str(tmp_path)]
+        assert graftlint.main(
+            ["--pass", "locks", "--baseline", bl,
+             "--update-baseline", *root, str(mod)]
+        ) == 0
+        doc = json.loads(open(bl).read())
+        assert doc["version"] == 1 and sum(
+            doc["findings"].values()
+        ) == 4
+        # Same tree + committed baseline -> clean.
+        assert graftlint.main(
+            ["--pass", "locks", "--baseline", bl, *root, str(mod)]
+        ) == 0
+        # The SAME file no longer produces the accepted findings ->
+        # the stale entries are named (exit stays 0: stale never
+        # fails, it nudges the baseline to shrink toward the truth).
+        mod.write_text(open(_fixture("locks_good.py")).read())
+        capsys.readouterr()
+        assert graftlint.main(
+            ["--pass", "locks", "--baseline", bl, *root, str(mod)]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "[stale-baseline]" in out
+        assert "remove the entry, or lower its count" in out
+
+
+# ------------------------------------------- bench_gate baseline metric
+
+
+class TestBenchGateLintBaseline:
+    def _write(self, tmp_path, n):
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps(
+            {"version": 1, "findings": {f"k{i}": 1 for i in range(n)}}
+        ))
+        return str(bl)
+
+    def test_growth_warns(self, tmp_path, capsys):
+        bl = self._write(tmp_path, 5)
+        count = tmp_path / "bl.count"
+        count.write_text("3\n")
+        rc = bench_gate.report_lint_baseline(bl, str(count))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[WARN]" in out and "GREW" in out and "5" in out
+
+    def test_match_and_shrink_do_not_warn(self, tmp_path, capsys):
+        bl = self._write(tmp_path, 3)
+        count = tmp_path / "bl.count"
+        count.write_text("3\n")
+        assert bench_gate.report_lint_baseline(bl, str(count)) == 0
+        assert "[WARN]" not in capsys.readouterr().out
+        count.write_text("7\n")
+        assert bench_gate.report_lint_baseline(bl, str(count)) == 0
+        out = capsys.readouterr().out
+        assert "[WARN]" not in out and "shrank" in out
+
+    def test_committed_count_matches_committed_baseline(self):
+        """The repo's own tracked count must equal the committed
+        baseline total — growing one without the other is the exact
+        drift the WARN exists to catch, so CI pins them equal."""
+        total = bench_gate._lint_baseline_total(BASELINE)
+        count_path = os.path.join(
+            REPO, "tools", "graftlint_baseline.count"
+        )
+        with open(count_path) as f:
+            tracked = int(f.read().strip())
+        assert total == tracked, (
+            f"tools/graftlint_baseline.json totals {total} but "
+            f"graftlint_baseline.count says {tracked} — review the "
+            "baseline change and update both together"
+        )
+
+
+# ------------------------------------------------- lock-order detector
+
+
+class TestLockOrderDetector:
+    def _pair(self, mon):
+        a = lockorder._TrackedLock(mon, "lockA", reentrant=False)
+        b = lockorder._TrackedLock(mon, "lockB", reentrant=False)
+        return a, b
+
+    def test_ab_ba_cycle_recorded_without_deadlock(self):
+        """The classic hazard: thread 1 takes A then B, thread 2 takes
+        B then A — SEQUENTIALLY, so no deadlock ever happens, but the
+        ordering cycle must still be recorded the moment the second
+        edge lands."""
+        mon = lockorder.LockOrderMonitor()
+        a, b = self._pair(mon)
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join(5)
+        assert mon.violations == []  # one order alone is fine
+        th = threading.Thread(target=t2)
+        th.start()
+        th.join(5)
+        assert len(mon.violations) == 1
+        assert "lockA" in mon.violations[0]
+        assert "lockB" in mon.violations[0]
+
+    def test_consistent_order_is_clean(self):
+        mon = lockorder.LockOrderMonitor()
+        a, b = self._pair(mon)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert mon.violations == []
+
+    def test_rlock_reentry_is_not_a_cycle(self):
+        mon = lockorder.LockOrderMonitor()
+        r = lockorder._TrackedLock(mon, "r", reentrant=True)
+        with r:
+            with r:  # re-entry by the owner: no self-edge
+                pass
+        assert mon.violations == []
+
+    def test_three_lock_cycle_detected(self):
+        mon = lockorder.LockOrderMonitor()
+        a, b = self._pair(mon)
+        c = lockorder._TrackedLock(mon, "lockC", reentrant=False)
+        for first, second in ((a, b), (b, c), (c, a)):
+            def run(x=first, y=second):
+                with x:
+                    with y:
+                        pass
+            th = threading.Thread(target=run)
+            th.start()
+            th.join(5)
+        assert len(mon.violations) == 1  # closed on the c->a edge
+
+    def test_arm_wraps_package_locks_only(self):
+        mon = lockorder.arm()
+        try:
+            from tensorflow_examples_tpu.telemetry.registry import (
+                MetricsRegistry,
+            )
+
+            reg = MetricsRegistry()  # allocates its lock in the package
+            assert isinstance(reg._lock, lockorder._TrackedLock)
+            raw = threading.Lock()  # allocated HERE (tests/): raw
+            assert not isinstance(raw, lockorder._TrackedLock)
+            with pytest.raises(RuntimeError, match="already armed"):
+                lockorder.arm()
+        finally:
+            lockorder.disarm()
+        assert threading.Lock is lockorder._real_lock
+        # Locks created while armed keep working after disarm.
+        reg.counter("x").inc()
+        assert reg.counter("x").value == 1
+
+    def test_nonblocking_acquire_failure_unwinds_held_stack(self):
+        mon = lockorder.LockOrderMonitor()
+        a, _ = self._pair(mon)
+        assert a.acquire()
+        got = []
+
+        def contender():
+            got.append(a.acquire(blocking=False))
+
+        th = threading.Thread(target=contender)
+        th.start()
+        th.join(5)
+        assert got == [False]
+        a.release()
+        # The failed acquire must not have left `a` on the contender
+        # thread's held stack — a later acquisition from THIS thread
+        # establishes no bogus edge and no violation.
+        with a:
+            pass
+        assert mon.violations == []
+
+
+# --------------------------------------------------- the tier-1 gate
+
+
+class TestWholePackageGate:
+    def test_graftlint_all_is_clean_with_committed_baseline(self,
+                                                            capsys):
+        """THE gate: every pass over the whole package, findings
+        pinned to zero outside the committed suppression baseline.
+        A new unguarded access to annotated state, a traced branch or
+        host sync in jitted code, a use-after-donate, an undocumented
+        counter, or a schema key stamped without a bump fails HERE."""
+        rc = graftlint.run(
+            [PACKAGE],
+            list(graftlint.analysis.PASSES),
+            repo_root=REPO,
+            baseline_path=BASELINE,
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, f"graftlint found new issues:\n{out}"
+        assert "0 finding(s)" in out
+
+    def test_committed_baseline_has_no_stale_entries(self, capsys):
+        """The baseline may only shrink toward the truth: an entry
+        whose finding no longer occurs must be removed, not carried."""
+        graftlint.run(
+            [PACKAGE],
+            list(graftlint.analysis.PASSES),
+            repo_root=REPO,
+            baseline_path=BASELINE,
+        )
+        out = capsys.readouterr().out
+        assert "[stale-baseline]" not in out
+
+
+# ----------------------------------------- review-fix regression pins
+
+
+class TestReviewFixes:
+    """Pins for the analysis-pass bugs caught in this PR's review:
+    each test fails against the pre-fix implementation."""
+
+    def _jax(self, src, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        return jaxhaz.run([str(p)], str(tmp_path))
+
+    def test_double_donate_flags(self, tmp_path):
+        """Passing an already-donated buffer to a SECOND donating call
+        is the canonical deleted-Array bug — the donating-call-read
+        exemption must only cover the call that performs the
+        donation."""
+        findings = self._jax(
+            "import jax\n"
+            "def _f(kv):\n"
+            "    return kv\n"
+            "F = jax.jit(_f, donate_argnums=(0,))\n"
+            "def caller(kv):\n"
+            "    out = F(kv)\n"
+            "    out2 = F(kv)\n"
+            "    return out, out2\n",
+            tmp_path,
+        )
+        assert [f.detail for f in findings] == ["use-after-donate:kv"]
+        assert findings[0].line == 7
+
+    def test_donating_calls_own_args_do_not_flag(self, tmp_path):
+        """Sibling args of the donating call evaluate before the
+        donation: F(kv, n) must not flag n or kv at the call itself."""
+        assert self._jax(
+            "import jax\n"
+            "def _f(kv, n):\n"
+            "    return kv\n"
+            "F = jax.jit(_f, donate_argnums=(0,))\n"
+            "def caller(kv, n):\n"
+            "    out = F(kv, n)\n"
+            "    return out, n\n",
+            tmp_path,
+        ) == []
+
+    def test_static_argnums_respected_in_assignment_form(self,
+                                                         tmp_path):
+        """`F = jax.jit(step, static_argnums=(1,))` — branching on the
+        statically-marked parameter is host dispatch, not a traced
+        branch (was a false positive: only static_argnames was read
+        in the assignment form)."""
+        assert self._jax(
+            "import jax\n"
+            "def step(x, use_cache):\n"
+            "    if use_cache:\n"
+            "        x = x + 1\n"
+            "    return x\n"
+            "F = jax.jit(step, static_argnums=(1,))\n",
+            tmp_path,
+        ) == []
+
+    def test_nested_def_params_shadow_outer_traced_set(self, tmp_path):
+        """A nested def's parameter shadows the outer traced name; its
+        body is its own scope and must not be checked against the
+        outer function's traced set (ast.walk does not prune)."""
+        assert self._jax(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    def helper(x):\n"
+            "        if x:\n"
+            "            return 1\n"
+            "        return 0\n"
+            "    return x\n",
+            tmp_path,
+        ) == []
+
+    def test_rlock_reentry_keeps_ordering_edges(self):
+        """An inner RLock release must NOT erase the held-stack entry
+        while the lock is still held — ordering edges established
+        after a re-entry (r -> b here, then b -> r elsewhere) are
+        exactly the cycles the detector exists for."""
+        mon = lockorder.LockOrderMonitor()
+        r = lockorder._TrackedLock(mon, "r", reentrant=True)
+        b = lockorder._TrackedLock(mon, "b", reentrant=False)
+
+        def t1():
+            with r:
+                with r:
+                    pass
+                with b:  # r is STILL held: r -> b must be recorded
+                    pass
+
+        def t2():
+            with b:
+                with r:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join(5)
+        assert mon.edge_count() == 1  # the r -> b edge survived re-entry
+        th = threading.Thread(target=t2)
+        th.start()
+        th.join(5)
+        assert len(mon.violations) == 1
+
+    def test_lockorder_monitor_pins_lock_ids(self):
+        """The held-before graph is keyed by id(); CPython recycles a
+        freed lock's id almost immediately, which aliased a NEW lock
+        onto a dead lock's edges and manufactured cycles between locks
+        that never coexisted. The monitor must pin every registered
+        wrapper for the armed window so ids stay unique."""
+        mon = lockorder.LockOrderMonitor()
+        ids = set()
+        for _ in range(50):
+            a = lockorder._TrackedLock(mon, "a", reentrant=False)
+            b = lockorder._TrackedLock(mon, "b", reentrant=False)
+            with a:
+                with b:
+                    pass
+            ids.add(id(a))
+            ids.add(id(b))
+            del a, b  # without the monitor's ref these ids recycle
+        assert len(ids) == 100
+        assert mon.violations == []
+
+    def test_update_baseline_subset_preserves_out_of_scope(
+        self, tmp_path, capsys
+    ):
+        """A targeted `--pass locks path/a.py --update-baseline` must
+        MERGE into the baseline: accepted findings of other passes and
+        other files are out of scope and must survive the rewrite
+        (truncating them broke the next full `--all` gate run)."""
+        bad = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0  # guard: self._lock\n"
+            "    def bump(self):\n"
+            "        self.n += 1\n"
+        )
+        a, b = tmp_path / "a.py", tmp_path / "b.py"
+        a.write_text(bad)
+        b.write_text(bad)
+        bl = tmp_path / "baseline.json"
+        common.Baseline({
+            "locks:b.py:C.bump:n:write": 1,      # other file
+            "jax:a.py:f:host-sync:item": 1,       # other pass, same file
+        }).save(str(bl))
+        rc = graftlint.run(
+            [str(a)], ["locks"], repo_root=str(tmp_path),
+            baseline_path=str(bl), update_baseline=True,
+        )
+        capsys.readouterr()
+        assert rc == 0
+        updated = common.Baseline.load(str(bl)).counts
+        assert updated == {
+            "locks:a.py:C.bump:n:write": 1,       # refreshed in scope
+            "locks:b.py:C.bump:n:write": 1,       # preserved
+            "jax:a.py:f:host-sync:item": 1,       # preserved
+        }
+
+    def test_container_mutations_are_writes(self, tmp_path):
+        """`self._results[k] = v` and `self._free.append(x)` mutate the
+        annotated container — classifying them 'read' (the Attribute's
+        ctx is Load; the Store sits on the Subscript) gave the finding
+        a wrong kind AND a wrong stable baseline key, inviting a
+        genuine unguarded mutation to be triaged as an acceptable
+        snapshot read."""
+        p = tmp_path / "mod.py"
+        p.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._results = {}  # guard: self._lock\n"
+            "        self._free = []     # guard: self._lock\n"
+            "    def put(self, k, v):\n"
+            "        self._results[k] = v\n"
+            "    def bump(self, k):\n"
+            "        self._results[k] += 1\n"
+            "    def push(self, x):\n"
+            "        self._free.append(x)\n"
+            "    def peek(self):\n"
+            "        return self._results\n"
+        )
+        findings = locks.run([str(p)], str(tmp_path))
+        assert _details(findings) == [
+            (8, "_results:write"),
+            (10, "_results:write"),
+            (12, "_free:write"),
+            (14, "_results:read"),
+        ]
+
+    def test_scoped_run_does_not_call_out_of_scope_entries_stale(
+        self, tmp_path, capsys
+    ):
+        """`--pass locks some/dir` can say nothing about a jax entry in
+        another file: printing it as '[stale-baseline] ... remove it'
+        walks operators into deleting live suppressions (and breaking
+        the next --all gate run)."""
+        a = tmp_path / "a.py"
+        a.write_text("x = 1\n")
+        bl = tmp_path / "baseline.json"
+        common.Baseline({"jax:b.py:f:host-sync:item": 1}).save(str(bl))
+        rc = graftlint.run(
+            [str(a)], ["locks"], repo_root=str(tmp_path),
+            baseline_path=str(bl),
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[stale-baseline]" not in out
+        # The full-scope run still reports it stale.
+        rc = graftlint.run(
+            [str(tmp_path)], ["locks", "jax"],
+            repo_root=str(tmp_path), baseline_path=str(bl),
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[stale-baseline] jax:b.py:f:host-sync:item" in out
+
+    def test_root_static_decl_survives_being_a_callee(self, tmp_path):
+        """A jit root reached first as another root's callee (empty
+        static set) must keep its OWN declared static_argnums — the
+        intersection clobbered it and flagged a host-dispatch branch
+        as a traced branch."""
+        assert self._jax(
+            "import jax\n"
+            "def _a(x, use_cache):\n"
+            "    if use_cache:\n"
+            "        x = x + 1\n"
+            "    return x\n"
+            "def _b(x):\n"
+            "    return _a(x, True)\n"
+            "A = jax.jit(_a, static_argnums=(1,))\n"
+            "B = jax.jit(_b)\n",
+            tmp_path,
+        ) == []
+
+    def test_nested_def_param_is_not_use_after_donate(self, tmp_path):
+        """A nested def's parameter shadows the donated outer name —
+        its body is a fresh scope, exactly like the branch/sync checks
+        (which prune nested defs via _walk_shallow)."""
+        assert self._jax(
+            "import jax\n"
+            "def _f(kv):\n"
+            "    return kv\n"
+            "F = jax.jit(_f, donate_argnums=(0,))\n"
+            "def caller(kv):\n"
+            "    out = F(kv)\n"
+            "    def helper(kv):\n"
+            "        return kv + 1\n"
+            "    return out, helper\n",
+            tmp_path,
+        ) == []
+
+    def test_explicit_non_py_file_is_a_usage_error(self, tmp_path,
+                                                   capsys):
+        """iter_python_files drops non-.py files; an explicitly named
+        one must exit 2, not report 'clean' over zero files."""
+        script = tmp_path / "script"
+        script.write_text("x = 1\n")
+        assert graftlint.main(
+            ["--all", "--no-baseline", str(script)]
+        ) == 2
+        capsys.readouterr()
+
+    def test_tracked_lock_locked_matches_real_lock_surface(self):
+        """The wrapper must not change the attribute surface relative
+        to the real lock types — even hasattr/getattr probing must not
+        differ only because the detector is armed (Py<3.14's C RLock
+        has no locked())."""
+        mon = lockorder.LockOrderMonitor()
+        a = lockorder._TrackedLock(mon, "a", reentrant=False)
+        assert a.locked() is False
+        with a:
+            assert a.locked() is True
+        r = lockorder._TrackedLock(mon, "r", reentrant=True)
+        assert hasattr(r, "locked") == hasattr(
+            lockorder._real_rlock(), "locked"
+        )
+        if hasattr(r, "locked"):
+            assert r.locked() is False  # Py >= 3.14: parity
+
+    def test_rlock_depth_decrement_precedes_inner_release(self):
+        """The re-entry depth must move while ownership is still
+        exclusive — decrementing AFTER the inner release races the
+        next owner's increment (lost update = stranded held-stack
+        entry = false held-before edges in unrelated tests)."""
+        mon = lockorder.LockOrderMonitor()
+        r = lockorder._TrackedLock(mon, "r", reentrant=True)
+        depths_at_inner_release = []
+
+        class Stub:
+            def acquire(self, blocking=True, timeout=-1):
+                return True
+
+            def release(self):
+                depths_at_inner_release.append(r._depth)
+
+        r._inner = Stub()
+        r.acquire()
+        r.acquire()
+        r.release()
+        r.release()
+        assert depths_at_inner_release == [1, 0]
+
+    def test_annassign_donate_and_reassign_is_clean(self, tmp_path):
+        """`kv: Array = F(kv)` donates and reassigns in ONE statement,
+        exactly like the plain-Assign idiom — AnnAssign was missing
+        from the statement-ancestor tuple, so the target was never
+        exempted."""
+        assert self._jax(
+            "import jax\n"
+            "def _f(kv):\n"
+            "    return kv\n"
+            "F = jax.jit(_f, donate_argnums=(0,))\n"
+            "def caller(kv):\n"
+            "    kv: object = F(kv)\n"
+            "    return kv\n",
+            tmp_path,
+        ) == []
+
+    def test_drift_empty_request_set_reports_nothing(self, tmp_path):
+        """A path set with zero .py files can say nothing — the old
+        `or not requested` fallback flipped to whole-repo reporting,
+        emitting findings the CLI's scoped baseline then refused to
+        suppress."""
+        tree = os.path.join(FIXTURES, "schema_tree")
+        empty = tmp_path / "emptydir"
+        empty.mkdir()
+        assert drift.run([str(empty)], tree) == []
+        # Sanity: the same tree WITH its files requested still finds.
+        assert drift.run([tree], tree) != []
+
+    def test_with_lock_call_style_matches_guard(self, tmp_path):
+        """`with self._lock():` (a lock-returning accessor) matches a
+        `# guard: self._lock` annotation — the comment documented the
+        strip but the code never performed it."""
+        p = tmp_path / "mod.py"
+        p.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._big = threading.Lock()\n"
+            "        self.hits = 0  # guard: self._big\n"
+            "    def _big_(self):\n"
+            "        return self._big\n"
+            "    def bump(self):\n"
+            "        with self._big():\n"
+            "            self.hits += 1\n"
+        )
+        assert locks.run([str(p)], str(tmp_path)) == []
+
+    def test_read_in_reassigning_statement_is_flagged(self, tmp_path):
+        """`kv = kv + 1` after a donation reads the deleted array (the
+        RHS evaluates before the rebind) — clearing the dead name at
+        statement START masked exactly this crash."""
+        findings = self._jax(
+            "import jax\n"
+            "def _f(kv):\n"
+            "    return kv\n"
+            "F = jax.jit(_f, donate_argnums=(0,))\n"
+            "def caller(kv):\n"
+            "    out = F(kv)\n"
+            "    kv = kv + 1\n"
+            "    return out, kv\n",
+            tmp_path,
+        )
+        assert [f.detail for f in findings] == ["use-after-donate:kv"]
+        assert findings[0].line == 7
+
+    def test_cross_thread_release_pops_acquirer_stack(self):
+        """threading.Lock may legally be released by a different
+        thread (hand-off style); a thread-local held stack stranded
+        the acquirer's entry forever, so every later acquire by that
+        thread recorded a phantom held-before edge."""
+        mon = lockorder.LockOrderMonitor()
+        lk = lockorder._TrackedLock(mon, "L", reentrant=False)
+        x = lockorder._TrackedLock(mon, "X", reentrant=False)
+        lk.acquire()  # this thread acquires...
+        th = threading.Thread(target=lk.release)  # ...another releases
+        th.start()
+        th.join(5)
+        with x:
+            pass  # L must NOT be considered held here: no L -> X edge
+        assert mon.edge_count() == 0
+
+        def other():
+            with x:
+                with lk:
+                    pass
+
+        th = threading.Thread(target=other)
+        th.start()
+        th.join(5)
+        assert mon.violations == []
+
+    def test_donate_argnames_registers_donor(self, tmp_path):
+        """`donate_argnames=("kv",)` donates exactly like its argnums
+        spelling — parsing it with the int-tuple helper yielded () and
+        silently skipped the use-after-donate check entirely."""
+        findings = self._jax(
+            "import jax\n"
+            "def _f(params, kv):\n"
+            "    return kv\n"
+            'F = jax.jit(_f, donate_argnames=("kv",))\n'
+            "def caller(params, kv):\n"
+            "    out = F(params, kv)\n"
+            "    return out, kv\n",
+            tmp_path,
+        )
+        assert [f.detail for f in findings] == ["use-after-donate:kv"]
+
+    def test_ownership_recorded_at_success_not_attempt(self):
+        """A blocked waiter must not clobber the holder's ownership:
+        a cross-thread release would then pop the WAITER's stack and
+        strand the holder's entry into phantom held-before edges."""
+        mon = lockorder.LockOrderMonitor()
+        lk = lockorder._TrackedLock(mon, "L", reentrant=False)
+        x = lockorder._TrackedLock(mon, "X", reentrant=False)
+        lk.acquire()  # this thread holds L
+        attempting = threading.Event()
+
+        def waiter():
+            attempting.set()
+            lk.acquire()  # blocks — must NOT take ownership yet
+            lk.release()
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        assert attempting.wait(5)
+        time.sleep(0.2)  # let the waiter block inside the inner acquire
+        rel = threading.Thread(target=lk.release)  # cross-thread release
+        rel.start()
+        rel.join(5)
+        th.join(5)
+        with x:
+            pass  # this thread's L entry was popped: no phantom edge
+        assert mon.edge_count() == 0
+        assert mon.violations == []
+
+    def test_nested_def_under_with_is_not_guarded(self, tmp_path):
+        """A callback defined under `with self._lock:` runs LATER,
+        without the lock — the enclosing-with walk must stop at the
+        def boundary instead of crediting the outer block. An inline
+        lambda (sort key) executes synchronously under the block and
+        stays clean."""
+        p = tmp_path / "mod.py"
+        p.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.hits = 0  # guard: self._lock\n"
+            "    def sched(self, register):\n"
+            "        with self._lock:\n"
+            "            def cb():\n"
+            "                self.hits += 1\n"
+            "            register(cb)\n"
+            "    def bump(self, items):\n"
+            "        with self._lock:\n"
+            "            return sorted(items, key=lambda k: self.hits)\n"
+        )
+        findings = locks.run([str(p)], str(tmp_path))
+        assert _details(findings) == [(9, "hits:write")]
+
+    def test_decorated_donating_def_registers_donor(self, tmp_path):
+        """An @partial(jax.jit, donate_argnums=...)-decorated def is
+        called by its own name — it donates exactly like an assigned
+        jitted callable, but donors were only ever collected from
+        Assign statements."""
+        findings = self._jax(
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def step(kv):\n"
+            "    return kv\n"
+            "def caller(kv):\n"
+            "    out = step(kv)\n"
+            "    return out, kv\n",
+            tmp_path,
+        )
+        assert [f.detail for f in findings] == ["use-after-donate:kv"]
+
+    def test_common_word_schema_key_requires_backticked_doc(self):
+        """Schema keys that are ordinary words ('slots') appear all
+        over the docs prose — only the backticked catalog form counts
+        as documentation, or the drift check can never fire."""
+        tree = os.path.join(FIXTURES, "schema_tree")
+        src = common.load_source(
+            os.path.join(
+                tree, "tensorflow_examples_tpu", "telemetry",
+                "schema.py"
+            ),
+            tree,
+        )
+        keys = drift.schema_keys(src)
+        assert keys, "fixture schema must declare keys"
+        docs = open(os.path.join(tree, "docs", "serving.md")).read()
+        # the fixture documents its known-good keys backticked
+        assert any(f"`{k}`" in docs for ks in keys.values() for k in ks)
+
+    def test_release_bookkeeping_precedes_inner_release(self):
+        """note_release must run while ownership is still exclusive —
+        after the inner release, the next owner's note_acquired races
+        it and the unconditional owners.pop erases the NEW holder's
+        ownership record."""
+        mon = lockorder.LockOrderMonitor()
+        lk = lockorder._TrackedLock(mon, "L", reentrant=False)
+        owners_at_inner_release = []
+
+        class Stub:
+            def acquire(self, blocking=True, timeout=-1):
+                return True
+
+            def release(self):
+                with mon._mu:
+                    owners_at_inner_release.append(dict(mon._owners))
+
+        lk._inner = Stub()
+        lk.acquire()
+        lk.release()
+        assert owners_at_inner_release == [{}]
+
+    def test_hot_path_marker_found_above_decorators(self, tmp_path):
+        """The marker block sits above the whole decorated function —
+        the scan must not stop at the decorator line and silently
+        exempt decorated hot paths."""
+        findings = self._jax(
+            "import numpy as np\n"
+            "def deco(f):\n"
+            "    return f\n"
+            "# graftlint: hot-path\n"
+            "@deco\n"
+            "def decode(batch):\n"
+            "    return np.asarray(batch)\n",
+            tmp_path,
+        )
+        assert [f.detail for f in findings] == ["host-sync:np.asarray"]

@@ -156,7 +156,7 @@ class TestPick:
             assert r.pick() is b
 
     def test_replica_state_snapshot_shape(self):
-        s = ReplicaState("http://x:9/").snapshot()
+        s = ReplicaState("http://x:9/").snapshot_locked()
         assert s["url"] == "http://x:9" and s["set"] == "base"
 
 
@@ -692,9 +692,120 @@ class TestRouterAffinityProbe:
             rep = router.replicas[0]
             assert rep.role == "mixed"  # ServeConfig default
             assert rep.prefix_digest == frozenset()
-            snap = rep.snapshot()
+            snap = rep.snapshot_locked()
             assert snap["role"] == "mixed"
             assert snap["prefix_blocks"] == 0
         finally:
             router.close()
             _close(replicas)
+
+
+class TestFleetLockDiscipline:
+    """Regression tests for the ISSUE 14 graftlint lock-pass findings:
+    ``drain``/``undrain`` mutated ``ReplicaState.drained``/``failures``
+    WITHOUT the router lock (while ``quarantine``/``readmit`` and
+    ``pick()`` took it — a drain racing a pick could dispatch to a
+    just-drained replica), and ``health_payload``/``stats_line``
+    aggregated the fleet view with no lock at all, so a probe sweep
+    mid-render could tear it (one replica's fresh occupancy summed
+    with another's stale brownout level). Both now serialize on
+    ``Router._lock`` — pinned here by holding the lock from another
+    thread and asserting the verb blocks until release."""
+
+    def _assert_serializes(self, router, call):
+        locked = threading.Event()
+        release = threading.Event()
+        holder_done = threading.Event()
+
+        def hold():
+            with router._lock:
+                locked.set()
+                release.wait(5)
+            holder_done.set()
+
+        done = threading.Event()
+
+        def run():
+            call()
+            done.set()
+
+        t1 = threading.Thread(target=hold, daemon=True)
+        t1.start()
+        assert locked.wait(2)
+        t2 = threading.Thread(target=run, daemon=True)
+        t2.start()
+        # The verb must be waiting on the fleet lock, not mutating
+        # lock-free past it (the pre-fix behavior).
+        time.sleep(0.1)
+        assert not done.is_set(), (
+            f"{call.__name__} completed while Router._lock was held — "
+            "it is not serializing with pick()/the probe sweep"
+        )
+        release.set()
+        assert done.wait(2), f"{call.__name__} never finished post-release"
+        t1.join(2)
+        t2.join(2)
+
+    def test_drain_takes_the_fleet_lock(self):
+        router = Router(["http://127.0.0.1:9/"])
+        self._assert_serializes(
+            router, lambda: router.drain("http://127.0.0.1:9/")
+        )
+        assert router.replicas[0].drained
+
+    def test_undrain_takes_the_fleet_lock(self):
+        router = Router(["http://127.0.0.1:9/"])
+        router.drain("http://127.0.0.1:9/")
+        self._assert_serializes(
+            router, lambda: router.undrain("http://127.0.0.1:9/")
+        )
+        assert not router.replicas[0].drained
+
+    def test_fleet_views_take_the_fleet_lock(self):
+        router = Router(["http://127.0.0.1:9/"])
+        self._assert_serializes(router, lambda: router.health_payload())
+        self._assert_serializes(router, lambda: router.stats_line())
+        self._assert_serializes(
+            router, lambda: router.replica_snapshots()
+        )
+
+    def test_drained_replica_never_picked_after_drain_returns(self):
+        """Functional shape of the race: once drain() returns, no
+        concurrent pick() may return the drained replica — hammered
+        from several threads while the drain flips."""
+        urls = ["http://127.0.0.1:9/", "http://127.0.0.1:10/"]
+        router = Router(urls)
+        for r in router.replicas:
+            r.probed = True
+        stop = threading.Event()
+        drained_at = []
+        bad = []
+
+        def picker():
+            while not stop.is_set():
+                t_start = time.monotonic()
+                r = router.pick()
+                # Only a pick that STARTED after drain() returned is a
+                # violation — the lock serializes it behind the drain,
+                # so it must see drained=True.
+                if (
+                    r is not None and drained_at
+                    and t_start > drained_at[0]
+                    and r.url == urls[0].rstrip("/")
+                ):
+                    bad.append(r.url)
+
+        threads = [
+            threading.Thread(target=picker, daemon=True)
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        router.drain(urls[0])
+        drained_at.append(time.monotonic())
+        time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(2)
+        assert not bad, f"picked drained replica after drain(): {bad}"

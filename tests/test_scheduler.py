@@ -324,7 +324,7 @@ class TestAffinityPick:
 
     def test_snapshot_carries_scheduling_fields(self):
         r = self._router()
-        snap = r.replicas[0].snapshot()
+        snap = r.replicas[0].snapshot_locked()
         assert snap["role"] == "mixed"
         assert snap["prefix_blocks"] == 0
         assert snap["prefix_chains"] == 0

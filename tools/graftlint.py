@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""graftlint: the repo's own static analysis suite (ISSUE 14).
+
+Runs the AST passes in ``tensorflow_examples_tpu/analysis/`` over the
+package (or any file/dir list) and gates the findings against the
+committed suppression baseline::
+
+    python tools/graftlint.py --all tensorflow_examples_tpu/
+    python tools/graftlint.py --pass locks tensorflow_examples_tpu/serving/
+    python tools/graftlint.py --all --update-baseline tensorflow_examples_tpu/
+
+Exit codes: **0** clean (no findings outside the baseline), **1**
+findings, **2** bad arguments/unusable input. The tier-1 test
+(``tests/test_lint.py``) runs ``--all`` over the whole package and
+pins exit 0, so any new unguarded access, JAX hazard, or schema drift
+is a CI failure — not a review comment.
+
+The baseline (default ``tools/graftlint_baseline.json``) maps stable
+finding keys to accepted counts; ``--update-baseline`` rewrites it
+from the current findings (review the diff — the baseline growing is
+a tracked metric: ``tools/bench_gate.py`` WARNs when it does).
+Passes: ``locks`` (lock discipline over ``# guard:`` annotations),
+``jax`` (traced branching / host syncs / use-after-donate),
+``schema`` (SERVING_KEYS vs stampers vs docs, counter catalog).
+See docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tensorflow_examples_tpu import analysis  # noqa: E402
+from tensorflow_examples_tpu.analysis import common  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "graftlint_baseline.json")
+DEFAULT_TARGET = os.path.join(REPO, "tensorflow_examples_tpu")
+
+
+def run(paths, passes, *, repo_root=REPO, baseline_path=None,
+        update_baseline=False, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+        # iter_python_files silently drops non-.py files; an
+        # explicitly named one must not read as a clean exit 0.
+        if os.path.isfile(p) and not p.endswith(".py"):
+            print(f"graftlint: not a .py file: {p}", file=sys.stderr)
+            return 2
+    try:
+        baseline = (
+            common.Baseline.load(baseline_path)
+            if baseline_path else common.Baseline()
+        )
+    except (ValueError, OSError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    findings = []
+    for name in passes:
+        findings.extend(analysis.run_pass(name, paths, repo_root))
+
+    # Scope test for baseline keys: only keys the current invocation
+    # could have produced (selected passes over the selected paths).
+    # Both --update-baseline and stale-entry detection must honor it —
+    # a scoped run can say nothing about the rest of the baseline.
+    roots = [common.rel_path(p, repo_root) for p in paths]
+
+    def _in_scope(key: str) -> bool:
+        pass_name, _, rest = key.partition(":")
+        path = rest.partition(":")[0]
+        if pass_name not in passes:
+            return False
+        return any(
+            r == "." or path == r
+            or path.startswith(r.rstrip("/") + "/")
+            for r in roots
+        )
+
+    if update_baseline:
+        # main() rejects --no-baseline + --update-baseline before
+        # calling run(), so baseline_path is always set here.
+        # MERGE, don't rewrite: a targeted `--pass locks path/`
+        # baseline update must not silently drop the accepted findings
+        # of every other pass and file.
+        kept = {
+            k: v for k, v in baseline.counts.items() if not _in_scope(k)
+        }
+        merged = dict(kept)
+        merged.update(common.Baseline.from_findings(findings).counts)
+        common.Baseline(merged).save(baseline_path)
+        print(
+            f"graftlint: baseline rewritten with {len(findings)} "
+            f"finding(s) ({len(kept)} out-of-scope entr"
+            f"{'y' if len(kept) == 1 else 'ies'} preserved) "
+            f"-> {baseline_path}",
+            file=out,
+        )
+        return 0
+    reported, suppressed, stale = common.apply_baseline(
+        findings, baseline
+    )
+    # An out-of-scope entry is invisible to this run, not stale —
+    # reporting it (with "remove it" advice) on a scoped run would
+    # walk operators into deleting live suppressions.
+    stale = [k for k in stale if _in_scope(k)]
+    for f in reported:
+        print(f.render(), file=out)
+    for key in stale:
+        print(
+            f"[stale-baseline] {key}: finding occurs fewer times "
+            "than the accepted count — remove the entry, or lower "
+            "its count to the occurrences that remain",
+            file=out,
+        )
+    print(
+        f"graftlint: {len(reported)} finding(s), {len(suppressed)} "
+        f"baselined, {len(stale)} stale baseline entr"
+        f"{'y' if len(stale) == 1 else 'ies'} "
+        f"(passes: {', '.join(passes)}; baseline total "
+        f"{baseline.total()})",
+        file=out,
+    )
+    return 1 if reported else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[1],
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the package)",
+    )
+    ap.add_argument(
+        "--all", action="store_true",
+        help="run every pass (locks, jax, schema)",
+    )
+    ap.add_argument(
+        "--pass", dest="passes", action="append",
+        choices=list(analysis.PASSES), metavar="PASS",
+        help=f"run one pass (repeatable): {', '.join(analysis.PASSES)}",
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="suppression baseline JSON (default "
+        "tools/graftlint_baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report everything)",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite --baseline from the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--repo-root", default=REPO,
+        help="root for relative paths in findings/contract files "
+        "(default: the repo)",
+    )
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    if args.all and args.passes:
+        print(
+            "graftlint: --all and --pass are mutually exclusive",
+            file=sys.stderr,
+        )
+        return 2
+    passes = list(analysis.PASSES) if args.all or not args.passes \
+        else args.passes
+    paths = args.paths or [DEFAULT_TARGET]
+    baseline_path = None if args.no_baseline else args.baseline
+    if args.no_baseline and args.update_baseline:
+        print(
+            "graftlint: --no-baseline and --update-baseline conflict",
+            file=sys.stderr,
+        )
+        return 2
+    return run(
+        paths, passes, repo_root=args.repo_root,
+        baseline_path=baseline_path,
+        update_baseline=args.update_baseline,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,8 +61,19 @@ flags.DEFINE_integer(
     "bank the memory paging saves — exhaustion sheds load loudly (503)")
 flags.DEFINE_string(
     "kv_dtype", "",
-    "KV cache storage dtype: '' (cache dtype) or 'int8' (per-block "
-    "scales; bounded-divergence mode — requires --kv_block_size)")
+    "KV cache storage dtype: '' (cache dtype), 'int8', or 'fp8' "
+    "(per-block scales; bounded-divergence modes — require "
+    "--kv_block_size; fp8 needs backend float8 support)")
+flags.DEFINE_string(
+    "weight_dtype", "",
+    "weight-only quantization (docs/serving.md quantization section): "
+    "'' serves the checkpoint's dtype; 'int8'/'fp8' quantize every "
+    "matmul weight at load time via the precision registry — HBM "
+    "param bytes drop ~4x, dequant happens inside the compiled "
+    "matmuls, streams are bounded-divergence vs f32 (serve_bench "
+    "--weight-dtype banks the gate record). Composes with "
+    "workdir/sharding.json: quantized payloads shard by the weight's "
+    "rule, scales inherit their weight's spec.")
 flags.DEFINE_boolean(
     "prefix_cache", True,
     "reuse immutable full prompt blocks across requests (paged only)")
@@ -247,6 +258,7 @@ def main(argv):
             kv_block_size=FLAGS.kv_block_size,
             kv_blocks=FLAGS.kv_blocks,
             kv_dtype=FLAGS.kv_dtype,
+            weight_dtype=FLAGS.weight_dtype,
             prefix_cache=FLAGS.prefix_cache,
             spec_decode_k=FLAGS.spec_decode_k,
             draft_ngram=FLAGS.draft_ngram,

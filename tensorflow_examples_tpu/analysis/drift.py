@@ -34,6 +34,7 @@ from __future__ import annotations
 import ast
 import itertools
 import os
+import re
 
 from tensorflow_examples_tpu.analysis import common
 
@@ -46,15 +47,19 @@ STAMP_FILES = (
 )
 DOC_FILES = ("docs/serving.md", "docs/observability.md")
 
-# Counter/gauge namespaces whose names must appear in the docs.
-COUNTER_PREFIXES = ("serving/", "router/", "autoscaler/")
 COUNTER_SCAN_DIR = "tensorflow_examples_tpu/serving"
 
-# Schema tuples that together declare every legal serving-object key.
-_SCHEMA_TUPLES = (
-    "SERVING_KEYS", "SERVING_KEYS_V6", "SERVING_KEYS_V7",
-    "SERVING_KEYS_V8", "SERVING_KEYS_V9", "SERVING_KEYS_V10",
-)
+# Counter/gauge namespace fallback when the schema module predates
+# INSTRUMENT_PREFIXES (the pass normally LEARNS the list from there —
+# ISSUE 15 satellite: a new namespace is a schema-module edit, never a
+# pass-side edit).
+_FALLBACK_PREFIXES = ("serving/", "router/", "autoscaler/")
+
+# The serving-key tuple naming convention the pass discovers in the
+# schema module: SERVING_KEYS (the v4 required set) plus every
+# SERVING_KEYS_V<N> bump. A new schema version's tuple is learned
+# automatically — no hand-maintained pass-side list to drift.
+_TUPLE_NAME = re.compile(r"^SERVING_KEYS(_V\d+)?$")
 
 
 def _load(repo_root: str, rel: str) -> common.SourceFile | None:
@@ -66,13 +71,14 @@ def _load(repo_root: str, rel: str) -> common.SourceFile | None:
 
 def schema_keys(src: common.SourceFile) -> dict[str, set[str]]:
     """{tuple name: keys} from the schema module's module-level
-    constant tuples."""
+    constant tuples, discovered by the SERVING_KEYS* naming
+    convention."""
     out: dict[str, set[str]] = {}
     for node in src.tree.body:
         if not isinstance(node, ast.Assign):
             continue
         for t in node.targets:
-            if isinstance(t, ast.Name) and t.id in _SCHEMA_TUPLES:
+            if isinstance(t, ast.Name) and _TUPLE_NAME.match(t.id):
                 try:
                     vals = ast.literal_eval(node.value)
                 except (ValueError, SyntaxError):
@@ -80,6 +86,32 @@ def schema_keys(src: common.SourceFile) -> dict[str, set[str]]:
                 if isinstance(vals, (tuple, list)):
                     out[t.id] = {v for v in vals if isinstance(v, str)}
     return out
+
+
+def _tuple_order(name: str) -> tuple[int, str]:
+    m = _TUPLE_NAME.match(name)
+    version = int(m.group(1)[2:]) if m and m.group(1) else 4
+    return (version, name)
+
+
+def instrument_prefixes(src: common.SourceFile) -> tuple[str, ...]:
+    """The scanned counter/gauge namespaces, learned from the schema
+    module's INSTRUMENT_PREFIXES constant (fallback: the pre-ISSUE-15
+    trio)."""
+    for node in src.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "INSTRUMENT_PREFIXES":
+                try:
+                    vals = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(vals, (tuple, list)) and all(
+                    isinstance(v, str) for v in vals
+                ):
+                    return tuple(vals)
+    return _FALLBACK_PREFIXES
 
 
 # ----------------------------------------------------- f-string expand
@@ -218,7 +250,10 @@ def stamped_keys(src: common.SourceFile) -> dict[str, int]:
 # -------------------------------------------------------- counter scan
 
 
-def registered_instruments(src: common.SourceFile) -> dict[str, int]:
+def registered_instruments(
+    src: common.SourceFile,
+    prefixes: tuple[str, ...] = _FALLBACK_PREFIXES,
+) -> dict[str, int]:
     """{instrument name: first lineno} for counter()/gauge()/histogram()
     registrations with resolvable names in the scanned prefixes."""
     consts = _module_const_tuples(src)
@@ -230,7 +265,7 @@ def registered_instruments(src: common.SourceFile) -> dict[str, int]:
                 and node.args):
             continue
         for name in expand_key(src, node.args[0], consts) or ():
-            if name.startswith(COUNTER_PREFIXES):
+            if name.startswith(tuple(prefixes)):
                 out.setdefault(name, node.lineno)
     return out
 
@@ -253,9 +288,10 @@ def run(paths, repo_root) -> list[common.Finding]:
         return findings
     tuples = schema_keys(schema_src)
     declared: dict[str, str] = {}
-    for tup in _SCHEMA_TUPLES:
-        for key in tuples.get(tup, ()):
+    for tup in sorted(tuples, key=_tuple_order):
+        for key in tuples[tup]:
             declared.setdefault(key, tup)
+    prefixes = instrument_prefixes(schema_src)
 
     docs_text = ""
     for rel in DOC_FILES:
@@ -279,7 +315,7 @@ def run(paths, repo_root) -> list[common.Finding]:
                         detail=f"unknown-serving-key:{key}",
                         message=(
                             f"serving key {key!r} is stamped but no "
-                            "SERVING_KEYS_V4..V10 tuple in "
+                            "SERVING_KEYS* tuple in "
                             "telemetry/schema.py declares it — bump "
                             "the schema before shipping the field"
                         ),
@@ -316,7 +352,9 @@ def run(paths, repo_root) -> list[common.Finding]:
         src = common.load_source(path, repo_root)
         if src is None or src.rel not in requested:
             continue
-        for name, line in sorted(registered_instruments(src).items()):
+        for name, line in sorted(
+            registered_instruments(src, prefixes).items()
+        ):
             if name not in docs_text and not src.ignored(line):
                 findings.append(common.Finding(
                     pass_name="schema", path=src.rel, line=line,

@@ -1,18 +1,52 @@
-"""Precision policy: bf16 compute, f32 master params.
+"""Precision registry: training policy, KV quantization, and the
+weight-quantization registry behind int8/fp8 end-to-end serving.
 
-The MXU natively consumes bfloat16; keeping activations/matmuls in bf16
-roughly doubles arithmetic throughput and halves HBM traffic versus f32,
-with f32 accumulation inside the MXU. The reference ran f32 (stock TF
-examples); this is one of the places a TPU-first design beats a port.
+Three layers, grown in order:
+
+* **PrecisionPolicy** (ISSUE 0 era) — bf16-compute/f32-params training
+  casts. The MXU natively consumes bfloat16; keeping activations in
+  bf16 roughly doubles arithmetic throughput versus f32 with f32
+  accumulation inside the MXU.
+* **Row quantization** (ISSUE 8) — symmetric per-row int8 (and now
+  fp8) with f32 scales, originally for the paged KV cache: each row
+  carries its own scale so rows append one decode step at a time
+  without requantizing their block.
+* **PrecisionConfig** (ISSUE 15 tentpole) — a serializable per-subtree
+  dtype registry, the ``ShardingConfig``-rules-table shape applied to
+  dtypes: ``[(path-regex, dtype)]``, first match wins.
+  :func:`quantize_tree` applies it to a param tree **at load time** on
+  the host (no device materialization — a model that only fits
+  sharded must never land whole on device 0), replacing each matched
+  ≥2-D floating leaf with a :class:`QuantizedWeight`: int8/fp8 payload
+  plus per-row f32 scales over the last axis. The serving forward
+  dequantizes **in the matmul** (:func:`materialize` /
+  :func:`take_rows` inside the jitted step, where XLA fuses the
+  scale-multiply into the consuming dot), so weights live in HBM at
+  1 byte/element — the fleet-economics lever: HBM per replica bounds
+  replicas per host. ``kv_dtype`` rides on the same config, unifying
+  the cache and weight quantization paths (fp8 KV falls out for free).
+
+Per-row-over-the-last-axis scales are what make the registry compose
+with sharding (ISSUE 7): a ``QuantizedWeight`` flattens into two
+ordinary leaves named ``q``/``scale`` under the weight's own path, so
+the weight's PartitionSpec places ``q`` unchanged and, clipped to the
+scale's rank, places the scale exactly like its weight's leading dims
+(``core/sharding.shardings_for_params`` does the clipping).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
+import json
+import os
+import re
+from typing import Mapping
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class Precision(str, enum.Enum):
@@ -73,6 +107,435 @@ def quantize_int8_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 def dequantize_int8_rows(q: jax.Array, scale: jax.Array,
                          dtype=jnp.float32) -> jax.Array:
     """Inverse of :func:`quantize_int8_rows` (``scale`` broadcasts over
-    the last axis of ``q``)."""
+    the last axis of ``q``). Dtype-generic on the payload side — an
+    fp8 ``q`` dequantizes through the same f32 multiply, so every
+    int8 read path gained fp8 for free (:func:`dequantize_rows` is the
+    honest alias)."""
     return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
             ).astype(dtype)
+
+
+# ------------------------------------------------------- fp8 + generic
+
+# Largest finite float8_e4m3fn value — the fp8 twin of INT8_MAX.
+FP8_MAX = 448.0
+
+QUANT_DTYPES = ("int8", "fp8")
+CAST_DTYPES = ("f32", "bf16")
+_CASTS = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+
+
+def fp8_dtype():
+    """``jnp.float8_e4m3fn`` when this jax build ships it, else None."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+@functools.lru_cache(maxsize=1)
+def fp8_supported() -> bool:
+    """Whether fp8 storage works end to end on this build/backend
+    (dtype exists AND casts round-trip). The registry gates fp8 rules
+    on this — absent support is a loud ValueError at load time, never
+    a silently-f32 tree."""
+    dt = fp8_dtype()
+    if dt is None:
+        return False
+    try:
+        roundtrip = jnp.ones((2,), jnp.float32).astype(dt).astype(
+            jnp.float32
+        )
+        return bool(np.asarray(roundtrip)[0] == 1.0)
+    except Exception:  # pragma: no cover - backend-specific failures
+        return False
+
+
+def _store_dtype(name: str):
+    if name == "int8":
+        return jnp.int8
+    dt = fp8_dtype()
+    if dt is None or not fp8_supported():
+        raise ValueError(
+            "dtype 'fp8' requested but this jax build/backend has no "
+            "working float8_e4m3fn — use 'int8' here"
+        )
+    return dt
+
+
+def quantize_rows(x: jax.Array, dtype) -> tuple[jax.Array, jax.Array]:
+    """Per-row quantization to ``dtype`` (``jnp.int8`` or the fp8
+    dtype): symmetric absmax over the last axis, f32 scales. The int8
+    branch IS :func:`quantize_int8_rows` (the paged pool's contract);
+    fp8 scales rows to the e4m3 range and relies on the cast's own
+    rounding."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.int8:
+        return quantize_int8_rows(x)
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(amax > 0, amax / FP8_MAX, 1.0)
+    return (x / scale[..., None]).astype(dtype), scale
+
+
+dequantize_rows = dequantize_int8_rows
+
+
+def _quantize_rows_host(x: np.ndarray, name: str):
+    """The load-time (host, numpy) twin of :func:`quantize_rows`: no
+    jax dispatch, no device placement — the quantized tree is built
+    before ``shard_params``/``asarray`` decides where leaves live."""
+    x = np.asarray(x, np.float32)
+    amax = np.max(np.abs(x), axis=-1)
+    if name == "int8":
+        scale = np.where(amax > 0, amax / INT8_MAX, 1.0).astype(
+            np.float32
+        )
+        q = np.clip(
+            np.rint(x / scale[..., None]), -INT8_MAX, INT8_MAX
+        ).astype(np.int8)
+        return q, scale
+    scale = np.where(amax > 0, amax / FP8_MAX, 1.0).astype(np.float32)
+    return (x / scale[..., None]).astype(
+        np.dtype(_store_dtype("fp8"))
+    ), scale
+
+
+# ---------------------------------------------------- quantized leaves
+
+
+class QuantLeafKey:
+    """Key-path entry for a :class:`QuantizedWeight`'s children.
+    Carries ``.key`` like a ``DictKey`` so every path renderer keeps
+    producing ``.../kernel/q`` and ``.../kernel/scale``, but its
+    distinct TYPE is what lets ``core/sharding._clip_spec`` recognize
+    a quantization scale *structurally* — a LayerNorm param is also
+    literally named ``scale``, and rank clipping must never apply to
+    one (an over-ranked rule there must still fail loudly)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def __repr__(self):
+        return f".{self.key}"
+
+    def __eq__(self, other):
+        return type(other) is QuantLeafKey and other.key == self.key
+
+    def __hash__(self):
+        return hash(("QuantLeafKey", self.key))
+
+
+@jax.tree_util.register_pytree_with_keys_class
+class QuantizedWeight:
+    """One quantized param leaf: payload ``q`` (int8/fp8, the weight's
+    own shape) + per-row f32 ``scale`` over the last axis
+    (``scale.shape == q.shape[:-1]``).
+
+    Registered as a pytree node whose children carry
+    :class:`QuantLeafKey` keys ``q``/``scale``, so everything that
+    walks param trees by path — sharding rules, byte accounting, jit
+    tracing, ``asarray`` maps — sees two ordinary leaves under the
+    weight's own path (``.../kernel/q``, ``.../kernel/scale``) and
+    the weight's PartitionSpec places the scale via rank clipping
+    (``core/sharding``, keyed on the key's type). Dequantization
+    happens at the consuming matmul (:func:`materialize`), never at
+    rest.
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten_with_keys(self):
+        return (
+            (QuantLeafKey("q"), self.q),
+            (QuantLeafKey("scale"), self.scale),
+        ), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def dequantize(self, dtype=jnp.float32):
+        return dequantize_rows(self.q, self.scale, dtype)
+
+    def __repr__(self):
+        return (
+            f"QuantizedWeight(shape={tuple(self.q.shape)}, "
+            f"store={jnp.dtype(self.q.dtype).name})"
+        )
+
+
+def materialize(w, dtype=jnp.float32):
+    """The dequant-in-matmul access point: a :class:`QuantizedWeight`
+    dequantizes HERE — called inside the jitted forward so XLA fuses
+    the f32 scale-multiply into the consuming dot and the weight is
+    read from HBM at 1 byte/element. Plain leaves pass through
+    untouched (zero-cost when nothing is quantized)."""
+    if isinstance(w, QuantizedWeight):
+        return w.dequantize(dtype)
+    return w
+
+
+def take_rows(w, idx, dtype=jnp.float32):
+    """Row gather for embedding tables: a quantized table gathers the
+    int8 rows + their scales and dequantizes only what was taken (a
+    full-table dequant per lookup would defeat the HBM story)."""
+    if isinstance(w, QuantizedWeight):
+        return dequantize_rows(w.q[idx], w.scale[idx], dtype)
+    return w[idx]
+
+
+# ------------------------------------------------- the dtype registry
+
+# The on-disk format version of a precision.json (NOT telemetry schema).
+PRECISION_JSON_VERSION = 1
+
+_LEGAL_RULE_DTYPES = QUANT_DTYPES + CAST_DTYPES + ("",)
+
+# weight_only(): quantize the tensors matmuls consume — kernels and
+# embedding tables. Everything else (LayerNorm scale/bias, biases —
+# additive paths where error accumulates and bytes are negligible)
+# keeps its dtype.
+WEIGHT_PATTERNS = (r"/kernel$", r"(^|/)embedding$")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    """Serializable per-subtree dtype registry (ISSUE 15): the
+    ``ShardingConfig`` rules-table shape applied to dtypes.
+
+    * ``rules`` — ``[(path-regex, dtype)]``, first match wins; dtype
+      in ``int8``/``fp8`` (per-row quantization of ≥2-D floating
+      leaves), ``f32``/``bf16`` (a plain cast), or ``""`` (leave the
+      subtree untouched — the escape hatch an earlier rule carves out
+      of a later blanket one).
+    * ``default`` — dtype for unmatched leaves (``""`` = untouched).
+    * ``kv_dtype`` — the unified cache side: ``""``/``int8``/``fp8``,
+      consumed by ``ServeConfig``/``PagedKVPool`` so one registry
+      object names both halves of the serving memory story.
+    """
+
+    rules: tuple = ()
+    default: str = ""
+    kv_dtype: str = ""
+
+    def __post_init__(self):
+        for entry in self.rules:
+            if not isinstance(entry, (list, tuple)) or len(entry) != 2:
+                raise ValueError(
+                    f"precision rule {entry!r} must be (pattern, dtype)"
+                )
+        for name in [d for _, d in self.rules] + [self.default]:
+            if name not in _LEGAL_RULE_DTYPES:
+                raise ValueError(
+                    f"precision dtype {name!r} not in "
+                    f"{_LEGAL_RULE_DTYPES}"
+                )
+        if self.kv_dtype not in ("",) + QUANT_DTYPES:
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r} not in "
+                f"{('',) + QUANT_DTYPES}"
+            )
+        object.__setattr__(
+            self,
+            "rules",
+            tuple((str(p), str(d)) for p, d in self.rules),
+        )
+
+    @classmethod
+    def weight_only(cls, dtype: str, *,
+                    kv_dtype: str = "") -> "PrecisionConfig":
+        """The standard serving registry: quantize every matmul weight
+        (kernels + embedding tables) to ``dtype``, leave norms/biases
+        alone. ``dtype=""`` returns the identity config."""
+        if not dtype:
+            return cls(kv_dtype=kv_dtype)
+        if dtype not in QUANT_DTYPES:
+            raise ValueError(
+                f"weight dtype {dtype!r} not in {QUANT_DTYPES}"
+            )
+        return cls(
+            rules=tuple((p, dtype) for p in WEIGHT_PATTERNS),
+            kv_dtype=kv_dtype,
+        )
+
+    def dtype_for(self, path: str) -> str:
+        for pat, d in self.rules:
+            if re.search(pat, path):
+                return d
+        return self.default
+
+    @property
+    def quantizes(self) -> bool:
+        return any(
+            d in QUANT_DTYPES
+            for d in [self.default] + [d for _, d in self.rules]
+        )
+
+    # ---------------------------------------------------- serialization
+
+    def to_json_dict(self) -> dict:
+        return {
+            "rules": [[p, d] for p, d in self.rules],
+            "default": self.default,
+            "kv_dtype": self.kv_dtype,
+        }
+
+    @classmethod
+    def from_json_dict(cls, obj: Mapping) -> "PrecisionConfig":
+        if not isinstance(obj, Mapping):
+            raise ValueError(
+                f"precision config must be a JSON object, got "
+                f"{type(obj).__name__}"
+            )
+        unknown = set(obj) - {"rules", "default", "kv_dtype"}
+        if unknown:
+            raise ValueError(
+                f"unknown precision config keys {sorted(unknown)}"
+            )
+        rules = obj.get("rules", ())
+        if not isinstance(rules, (list, tuple)) or any(
+            not isinstance(e, (list, tuple)) or len(e) != 2
+            for e in rules
+        ):
+            # Every malformation is a ValueError (the documented loud
+            # contract), never a TypeError from the unpack below.
+            raise ValueError(
+                f"precision rules must be [pattern, dtype] pairs, got "
+                f"{rules!r}"
+            )
+        return cls(
+            rules=tuple((str(p), str(d)) for p, d in rules),
+            default=str(obj.get("default", "")),
+            kv_dtype=str(obj.get("kv_dtype", "")),
+        )
+
+    def save(self, path: str) -> None:
+        doc = {
+            "version": PRECISION_JSON_VERSION,
+            "config": self.to_json_dict(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "PrecisionConfig":
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: not a JSON object")
+        if "config" in doc:
+            version = doc.get("version")
+            if version != PRECISION_JSON_VERSION:
+                raise ValueError(
+                    f"{path}: precision.json version {version!r} "
+                    f"(this build reads {PRECISION_JSON_VERSION})"
+                )
+            return cls.from_json_dict(doc["config"])
+        return cls.from_json_dict(doc)
+
+
+def _tree_path_str(path) -> str:
+    """The '/'-joined key-path rendering — THE one from
+    ``core/sharding`` (deferred import: sharding's own lazy precision
+    imports would otherwise race module init), so PrecisionConfig and
+    ShardingConfig rules always match the same rendering of the same
+    tree path."""
+    from tensorflow_examples_tpu.core.sharding import _path_str
+
+    return _path_str(path)
+
+
+def quantize_tree(params, config: PrecisionConfig):
+    """Apply the registry to a param tree AT LOAD TIME, on the host:
+    matched ≥2-D floating leaves become :class:`QuantizedWeight`
+    (int8/fp8 payload + per-row f32 scales), cast rules cast, the rest
+    pass through. Runs in numpy — no device dispatch, so the sharded
+    path still places every byte straight into its mesh layout.
+    1-D floating leaves (biases, norms) are never quantized even under
+    a blanket rule: per-row scales need a row axis, and their bytes
+    are noise."""
+    if config.quantizes and any(
+        d == "fp8"
+        for d in [config.default] + [d for _, d in config.rules]
+    ) and not fp8_supported():
+        raise ValueError(
+            "precision config requests fp8 weights but this jax "
+            "build/backend has no working float8_e4m3fn"
+        )
+
+    def one(path, leaf):
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.floating):
+            return leaf
+        name = config.dtype_for(_tree_path_str(path))
+        if not name:
+            return leaf
+        if name in QUANT_DTYPES:
+            if getattr(leaf, "ndim", 0) < 2:
+                return leaf
+            q, scale = _quantize_rows_host(np.asarray(leaf), name)
+            return QuantizedWeight(q, scale)
+        return np.asarray(leaf).astype(np.dtype(_CASTS[name]))
+
+    return jax.tree_util.tree_map_with_path(
+        one, params, is_leaf=lambda x: isinstance(x, QuantizedWeight)
+    )
+
+
+def tree_precision_stats(params) -> dict:
+    """Numeric facts about a (possibly quantized) param tree — the
+    ``precision/*`` gauges and the schema-v11 serving keys:
+    ``param_bytes`` (as stored), ``param_bytes_f32`` (what the same
+    logical tree would cost at 4 bytes/element), ``quantized_params``
+    (QuantizedWeight leaf count) and ``weight_bits`` (payload bits of
+    the quantized leaves; the floating itemsize when none are)."""
+    stored = f32 = 0
+    quantized = 0
+    bits = None
+    for leaf in jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedWeight)
+    ):
+        if isinstance(leaf, QuantizedWeight):
+            quantized += 1
+            size = int(np.prod(leaf.q.shape, dtype=np.int64))
+            stored += size * jnp.dtype(leaf.q.dtype).itemsize
+            stored += int(
+                np.prod(leaf.scale.shape, dtype=np.int64)
+            ) * 4
+            f32 += size * 4
+            bits = jnp.dtype(leaf.q.dtype).itemsize * 8
+            continue
+        shape = tuple(getattr(leaf, "shape", ()))
+        itemsize = int(
+            getattr(getattr(leaf, "dtype", None), "itemsize", 0) or 0
+        )
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        stored += size * itemsize
+        if itemsize and jnp.issubdtype(leaf.dtype, jnp.floating):
+            f32 += size * 4
+            if bits is None:
+                bits = itemsize * 8
+        else:
+            f32 += size * itemsize
+    return {
+        "param_bytes": stored,
+        "param_bytes_f32": f32,
+        "quantized_params": quantized,
+        "weight_bits": bits if bits is not None else 32,
+    }

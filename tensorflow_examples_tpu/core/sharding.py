@@ -56,6 +56,44 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+def _rule_path(path) -> str:
+    """The path a RULES table matches against. A QuantizedWeight
+    child (``core/precision``) resolves under its WEIGHT's path — the
+    ``q``/``scale`` tail is stripped — so anchored patterns like
+    ``"/kernel$"`` keep matching after quantization extends the leaf
+    paths; otherwise an anchored table would silently replicate every
+    quantized weight (the legal no-match fallback)."""
+    if path:
+        from tensorflow_examples_tpu.core.precision import QuantLeafKey
+
+        if type(path[-1]) is QuantLeafKey:
+            path = path[:-1]
+    return _path_str(path)
+
+
+def _clip_spec(spec: P, path, leaf) -> P:
+    """Clip an over-ranked spec to the leaf's rank — ONLY for the
+    ``scale`` child of a ``core/precision.QuantizedWeight`` (keyed on
+    the key-path entry's TYPE, not its name: LayerNorm params are
+    also literally named ``scale`` and must keep the loud rank
+    failure). The scale lives under its weight's own path with one
+    fewer dim (the scaled-over last axis), so the weight's rule
+    places it by its LEADING dims — "scales sharded like their
+    weights" without a second rules table. Every other leaf keeps an
+    over-ranked spec untouched, so a mis-written rule still fails at
+    placement instead of silently clipping to a different layout."""
+    shape = getattr(leaf, "shape", None)
+    if shape is None or len(spec) <= len(shape) or not path:
+        return spec
+    from tensorflow_examples_tpu.core.precision import QuantLeafKey
+
+    if not (
+        type(path[-1]) is QuantLeafKey and path[-1].key == "scale"
+    ):
+        return spec
+    return P(*tuple(spec)[: len(shape)])
+
+
 def _filter_spec(spec: P, mesh: Mesh) -> P:
     """Drop mesh axes of size 1 from a spec (cheaper layouts, same math)."""
 
@@ -77,7 +115,10 @@ def shardings_for_params(
     rules = rules or REPLICATED
 
     def one(path, leaf):
-        spec = _filter_spec(rules.spec_for(_path_str(path)), mesh)
+        spec = _filter_spec(
+            _clip_spec(rules.spec_for(_rule_path(path)), path, leaf),
+            mesh,
+        )
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(one, params)

@@ -140,6 +140,10 @@ class Request:
     classify_top_n: int = 5
     pages: dict | None = None        # resume: the handed-off KV pages
     first_token: int | None = None   # resume: the prefill's sampled token
+    skip_tokens: int = 0             # prefill: leading prompt tokens the
+    #                                  importer already caches (router
+    #                                  digest exchange, ISSUE 15) — the
+    #                                  export ships only the rest
     slo: str = "interactive"     # interactive | batch (ISSUE 13):
     #                              interactive is served first
     #                              everywhere; batch absorbs shedding
@@ -923,7 +927,9 @@ class ContinuousBatcher:
             # slot's KV pages, not a decode stream — export, free, and
             # hand the payload (plus the first sampled token) back for
             # the router to ship to a decode replica.
-            pages = self.engine.export_kv_pages(slot, req.prompt)
+            pages = self.engine.export_kv_pages(
+                slot, req.prompt, skip_tokens=req.skip_tokens
+            )
             self.engine.pool.free(slot)
             item.slot = None
             self._resolve(
@@ -1145,6 +1151,17 @@ class ContinuousBatcher:
         paged = getattr(self.engine.pool, "paged_stats", None)
         if callable(paged):
             serving.update(paged())
+        # Schema-v11 precision keys (ISSUE 15): what precision this
+        # replica is actually serving at and what it costs vs f32 —
+        # stamped only when the engine holds quantized weights (an
+        # unquantized line carries none, like every earlier bump).
+        pstats = getattr(self.engine, "precision_stats", None)
+        pstats = pstats() if callable(pstats) else None
+        if pstats:
+            serving["weight_bits"] = pstats["weight_bits"]
+            serving["param_bytes"] = pstats["param_bytes"]
+            serving["param_bytes_f32"] = pstats["param_bytes_f32"]
+            serving["quantized_params"] = pstats["quantized_params"]
         return {
             "schema_version": schema.SERVING_SCHEMA_VERSION,
             "kind": "serving",

@@ -58,6 +58,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tensorflow_examples_tpu.core import precision as precision_mod
+from tensorflow_examples_tpu.core.precision import materialize as _w
+from tensorflow_examples_tpu.core.precision import take_rows as _rows
 from tensorflow_examples_tpu.models.transformer import TransformerConfig
 from tensorflow_examples_tpu.ops.attention import NEG_INF, attention_reference
 from tensorflow_examples_tpu.serving import kv_cache as kv_mod
@@ -80,6 +83,19 @@ class ServeConfig:
     #                              kernel, ops/paged_decode.py; requires
     #                              the paged pool)
     cache_dtype: str = ""        # "" -> follow the params dtype
+    # ---- weight quantization (core/precision.py registry; ISSUE 15) ----
+    weight_dtype: str = ""       # "" (serve the tree as restored) |
+    #                              "int8" | "fp8": weight-only
+    #                              quantization at LOAD time via
+    #                              PrecisionConfig.weight_only —
+    #                              kernels/embeddings stored at
+    #                              1 byte/elt with per-row f32 scales,
+    #                              dequantized inside the compiled
+    #                              matmuls. Bounded-divergence mode
+    #                              (first token exact in practice,
+    #                              streams may diverge within the
+    #                              serve_quant gate); fp8 requires
+    #                              backend float8_e4m3fn support.
     compile_warmup: int = 1      # expected compiles per sentinel-wrapped fn
     # ---- speculative decoding (serving/speculative.py; ISSUE 11) ----
     spec_decode_k: int = 0       # drafts verified per decode step; 0 off.
@@ -149,7 +165,13 @@ class ServeConfig:
 #
 # Pure functions over the Transformer param tree. f32-by-default like the
 # flax model (params dtype is the compute dtype); LayerNorm/softmax math
-# mirrors flax defaults (eps 1e-5, gelu approximate).
+# mirrors flax defaults (eps 1e-5, gelu approximate). Every matmul weight
+# is read through ``core/precision.materialize`` (``_w``) and embedding
+# tables through ``take_rows`` (``_rows``): under a PrecisionConfig the
+# leaf is a QuantizedWeight dequantized HERE, inside the jitted step —
+# XLA fuses the scale-multiply into the consuming dot, so HBM holds the
+# weights at 1 byte/element (ISSUE 15). Unquantized trees pass through
+# unchanged (the helpers are identity on plain arrays).
 
 
 def _layer_norm(x, p, eps=1e-5):
@@ -159,21 +181,21 @@ def _layer_norm(x, p, eps=1e-5):
 
 
 def _block_mlp(x, p):
-    h = jnp.dot(x, p["mlp_fc"]["kernel"]) + p["mlp_fc"]["bias"]
+    h = jnp.dot(x, _w(p["mlp_fc"]["kernel"])) + p["mlp_fc"]["bias"]
     h = jax.nn.gelu(h, approximate=True)
-    return jnp.dot(h, p["mlp_proj"]["kernel"]) + p["mlp_proj"]["bias"]
+    return jnp.dot(h, _w(p["mlp_proj"]["kernel"])) + p["mlp_proj"]["bias"]
 
 
 def _qkv(x, p):
     """[..., d] -> q, k, v each [..., H, hd]."""
-    y = jnp.einsum("...d,dthc->...thc", x, p["qkv"]["kernel"])
+    y = jnp.einsum("...d,dthc->...thc", x, _w(p["qkv"]["kernel"]))
     y = y + p["qkv"]["bias"]
     return y[..., 0, :, :], y[..., 1, :, :], y[..., 2, :, :]
 
 
 def _attn_out(att, p):
     """[..., H, hd] attention output -> [..., d] residual contribution."""
-    return jnp.einsum("...hc,hcd->...d", att, p["proj"]["kernel"]) + p[
+    return jnp.einsum("...hc,hcd->...d", att, _w(p["proj"]["kernel"])) + p[
         "proj"
     ]["bias"]
 
@@ -204,7 +226,9 @@ def forward_full(cfg: TransformerConfig, params, tokens, *, impl="xla"):
     token)."""
     wte = params["wte"]["embedding"]
     positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
-    x = wte[tokens] + params["wpe"]["embedding"][positions][None]
+    x = _rows(wte, tokens) + _rows(
+        params["wpe"]["embedding"], positions
+    )[None]
     ks, vs = [], []
     for layer in range(cfg.num_layers):
         p = params[f"h_{layer}"]
@@ -215,7 +239,7 @@ def forward_full(cfg: TransformerConfig, params, tokens, *, impl="xla"):
         x = x + _attn_out(_prefill_attend(q, k, v, impl=impl), p["attn"])
         x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
     x = _layer_norm(x, params["ln_f"])
-    return jnp.dot(x, wte.T), jnp.stack(ks), jnp.stack(vs)
+    return jnp.dot(x, _w(wte).T), jnp.stack(ks), jnp.stack(vs)
 
 
 def _decode_forward(cfg: TransformerConfig, params, k_cache, v_cache,
@@ -229,7 +253,7 @@ def _decode_forward(cfg: TransformerConfig, params, k_cache, v_cache,
     future prefill fully overwrites, and their output is discarded.
     """
     wte = params["wte"]["embedding"]
-    x = wte[tokens] + params["wpe"]["embedding"][positions]
+    x = _rows(wte, tokens) + _rows(params["wpe"]["embedding"], positions)
     idx = jnp.arange(tokens.shape[0])
     lengths = positions + 1  # populated length including the new token
     for layer in range(cfg.num_layers):
@@ -251,7 +275,7 @@ def _decode_forward(cfg: TransformerConfig, params, k_cache, v_cache,
         x = x + _attn_out(att, p["attn"])
         x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
     x = _layer_norm(x, params["ln_f"])
-    return k_cache, v_cache, jnp.dot(x, wte.T)
+    return k_cache, v_cache, jnp.dot(x, _w(wte).T)
 
 
 def _verify_forward(cfg: TransformerConfig, params, k_cache, v_cache,
@@ -270,9 +294,9 @@ def _verify_forward(cfg: TransformerConfig, params, k_cache, v_cache,
     wte = params["wte"]["embedding"]
     s_n, t_n = tokens.shape
     pos_grid = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32)
-    x = wte[tokens] + params["wpe"]["embedding"][
-        jnp.minimum(pos_grid, cfg.max_len - 1)
-    ]
+    x = _rows(wte, tokens) + _rows(
+        params["wpe"]["embedding"], jnp.minimum(pos_grid, cfg.max_len - 1)
+    )
     idx = jnp.arange(s_n)
     for layer in range(cfg.num_layers):
         p = params[f"h_{layer}"]
@@ -293,7 +317,7 @@ def _verify_forward(cfg: TransformerConfig, params, k_cache, v_cache,
         x = x + _attn_out(att, p["attn"])
         x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
     x = _layer_norm(x, params["ln_f"])
-    return k_cache, v_cache, jnp.dot(x, wte.T)
+    return k_cache, v_cache, jnp.dot(x, _w(wte).T)
 
 
 # ---------------------------------------------------------- paged forward
@@ -310,7 +334,7 @@ def _paged_write_prompt(kv, ks, vs, block_ids, *, block_size):
     """Scatter a prefill's freshly computed K/V ([L, bucket, H, hd])
     into the blocks named by ``block_ids`` [bucket // BS] (pad entries
     point at the null block; their garbage is never read)."""
-    from tensorflow_examples_tpu.core.precision import quantize_int8_rows
+    from tensorflow_examples_tpu.core.precision import quantize_rows
 
     num_layers, bucket, h, hd = ks.shape
     nb = bucket // block_size
@@ -322,9 +346,11 @@ def _paged_write_prompt(kv, ks, vs, block_ids, *, block_size):
 
     kb, vb = to_blocks(ks), to_blocks(vs)
     if len(kv) == 4:
+        # Quantized pool: the store dtype (int8 or fp8) rides on the
+        # pool arrays themselves — one write path serves both.
         k, v, ksc, vsc = kv
-        qk, sk = quantize_int8_rows(kb)
-        qv, sv = quantize_int8_rows(vb)
+        qk, sk = quantize_rows(kb, k.dtype)
+        qv, sv = quantize_rows(vb, v.dtype)
         return (
             k.at[:, block_ids].set(qk),
             v.at[:, block_ids].set(qv),
@@ -342,12 +368,12 @@ def _paged_write_rows(kv, layer, write_blocks, offsets, k, v):
     """One decode step's per-slot rows ([S, H, hd]) into block
     ``write_blocks[s]`` at row ``offsets[s]``. Parked slots write into
     the null block (their table entry is 0) — discarded by masking."""
-    from tensorflow_examples_tpu.core.precision import quantize_int8_rows
+    from tensorflow_examples_tpu.core.precision import quantize_rows
 
     if len(kv) == 4:
         kk, vv, ksc, vsc = kv
-        qk, sk = quantize_int8_rows(k)
-        qv, sv = quantize_int8_rows(v)
+        qk, sk = quantize_rows(k, kk.dtype)
+        qv, sv = quantize_rows(v, vv.dtype)
         return (
             kk.at[layer, write_blocks, :, offsets, :].set(qk),
             vv.at[layer, write_blocks, :, offsets, :].set(qv),
@@ -390,7 +416,7 @@ def _paged_decode_forward(cfg: TransformerConfig, params, kv, tokens,
     straight through the table (int8 pools dequantize in-kernel); the
     XLA gather path stays as the selectable reference oracle."""
     wte = params["wte"]["embedding"]
-    x = wte[tokens] + params["wpe"]["embedding"][positions]
+    x = _rows(wte, tokens) + _rows(params["wpe"]["embedding"], positions)
     lengths = positions + 1
     write_blocks = jnp.take_along_axis(
         tables, (positions // block_size)[:, None], axis=1
@@ -427,7 +453,7 @@ def _paged_decode_forward(cfg: TransformerConfig, params, kv, tokens,
         x = x + _attn_out(att, p["attn"])
         x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
     x = _layer_norm(x, params["ln_f"])
-    return kv, jnp.dot(x, wte.T)
+    return kv, jnp.dot(x, _w(wte).T)
 
 
 def _paged_verify_forward(cfg: TransformerConfig, params, kv, tokens,
@@ -442,9 +468,9 @@ def _paged_verify_forward(cfg: TransformerConfig, params, kv, tokens,
     s_n, t_n = tokens.shape
     nb = tables.shape[1]
     pos_grid = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32)
-    x = wte[tokens] + params["wpe"]["embedding"][
-        jnp.minimum(pos_grid, cfg.max_len - 1)
-    ]
+    x = _rows(wte, tokens) + _rows(
+        params["wpe"]["embedding"], jnp.minimum(pos_grid, cfg.max_len - 1)
+    )
     blk = jnp.minimum(pos_grid // block_size, nb - 1)
     write_blocks = jnp.where(
         pos_grid < nb * block_size,
@@ -468,7 +494,7 @@ def _paged_verify_forward(cfg: TransformerConfig, params, kv, tokens,
         x = x + _attn_out(att, p["attn"])
         x = x + _block_mlp(_layer_norm(x, p["ln_2"]), p)
     x = _layer_norm(x, params["ln_f"])
-    return kv, jnp.dot(x, wte.T)
+    return kv, jnp.dot(x, _w(wte).T)
 
 
 def _extend_forward(cfg: TransformerConfig, params, kv, ctx_table,
@@ -492,9 +518,9 @@ def _extend_forward(cfg: TransformerConfig, params, kv, ctx_table,
     positions = ctx_len + jnp.arange(tb, dtype=jnp.int32)
     # Pad rows past the true tail may index past max_len; clip — they
     # are causally downstream of every real row and discarded.
-    x = wte[tokens] + params["wpe"]["embedding"][
-        jnp.minimum(positions, cfg.max_len - 1)
-    ][None]
+    x = _rows(wte, tokens) + _rows(
+        params["wpe"]["embedding"], jnp.minimum(positions, cfg.max_len - 1)
+    )[None]
     quantized = len(kv) == 4
     nb = ctx_table.shape[0]
     ctx_cols = nb * block_size
@@ -549,7 +575,7 @@ def _extend_forward(cfg: TransformerConfig, params, kv, ctx_table,
     kv = _paged_write_prompt(
         kv, jnp.stack(ks), jnp.stack(vs), tail_ids, block_size=block_size
     )
-    return kv, jnp.dot(x, wte.T)
+    return kv, jnp.dot(x, _w(wte).T)
 
 
 # -------------------------------------------------------------- sampling
@@ -663,6 +689,7 @@ class InferenceEngine:
         cfg: ServeConfig | None = None,
         registry=None,
         sharding=None,
+        precision=None,
     ):
         if model_cfg.moe_experts:
             raise NotImplementedError(
@@ -691,6 +718,27 @@ class InferenceEngine:
         self._prefill_attn = (
             "flash" if self.cfg.attention == "flash" else "xla"
         )
+        # Weight quantization at LOAD time (ISSUE 15): the precision
+        # registry rewrites the host tree BEFORE any device placement
+        # — quantized leaves are (q, scale) children under the
+        # weight's own path, so the sharding rules below place them
+        # like the weight they came from (scales by rank-clipped
+        # spec). ``precision=`` takes a full PrecisionConfig; the
+        # ``weight_dtype`` knob is sugar for the standard weight-only
+        # registry. The registry's kv_dtype unifies the cache side:
+        # ServeConfig.kv_dtype wins when both are set.
+        self.precision = precision
+        if self.precision is None and self.cfg.weight_dtype:
+            self.precision = precision_mod.PrecisionConfig.weight_only(
+                self.cfg.weight_dtype, kv_dtype=self.cfg.kv_dtype
+            )
+        self.kv_dtype = self.cfg.kv_dtype or (
+            self.precision.kv_dtype if self.precision is not None else ""
+        )
+        if self.precision is not None:
+            # Cast-only registries (bf16/f32 rules, no int8/fp8) apply
+            # too; quantize_tree is the identity for an empty config.
+            params = precision_mod.quantize_tree(params, self.precision)
         # Sharded serving (ISSUE 7): the SAME ShardingConfig training
         # persisted to workdir/sharding.json places the param tree by
         # its rules (instead of replicating) and the KV pool with heads
@@ -728,7 +776,36 @@ class InferenceEngine:
         self.sentinel = CompilationSentinel(
             warmup=self.cfg.compile_warmup, registry=self.registry
         )
-        param_dtype = self.params["wte"]["embedding"].dtype
+        # precision/* instruments (ISSUE 15): the serving tier's own
+        # record of what precision it is actually running — weight
+        # payload bits, stored-vs-f32 param bytes, quantized leaf
+        # count. Scraped via /metrics, stamped (when quantized) as the
+        # schema-v11 serving keys.
+        self._precision_stats = precision_mod.tree_precision_stats(
+            self.params
+        )
+        self.quantized_weights = (
+            self._precision_stats["quantized_params"] > 0
+        )
+        reg = self.registry
+        reg.gauge("precision/weight_bits").set(
+            self._precision_stats["weight_bits"]
+        )
+        reg.gauge("precision/param_bytes").set(
+            self._precision_stats["param_bytes"]
+        )
+        reg.gauge("precision/param_bytes_f32").set(
+            self._precision_stats["param_bytes_f32"]
+        )
+        reg.gauge("precision/quantized_params").set(
+            self._precision_stats["quantized_params"]
+        )
+        wte = self.params["wte"]["embedding"]
+        param_dtype = (
+            jnp.float32
+            if isinstance(wte, precision_mod.QuantizedWeight)
+            else wte.dtype
+        )
         cache_dtype = (
             jnp.dtype(self.cfg.cache_dtype)
             if self.cfg.cache_dtype
@@ -739,6 +816,12 @@ class InferenceEngine:
             raise ValueError(
                 "attention='paged_flash' is the fused paged-decode "
                 "kernel — it requires the paged pool (set kv_block_size)"
+            )
+        if self.cfg.attention == "paged_flash" and self.kv_dtype == "fp8":
+            raise ValueError(
+                "attention='paged_flash' dequantizes int8 in-kernel; "
+                "fp8 KV serves through the XLA gather path "
+                "(attention='xla')"
             )
         if self.cfg.role not in ("mixed", "prefill", "decode"):
             raise ValueError(
@@ -803,13 +886,13 @@ class InferenceEngine:
                 block_size=bs,
                 num_blocks=self.cfg.kv_blocks,
                 dtype=cache_dtype,
-                kv_dtype=self.cfg.kv_dtype,
+                kv_dtype=self.kv_dtype,
                 prefix_cache=self.cfg.prefix_cache,
                 registry=self.registry,
                 sharding=self._kv_sharding(),
             )
         else:
-            if self.cfg.kv_dtype:
+            if self.kv_dtype:
                 raise ValueError(
                     "kv_dtype (quantized KV) requires the paged pool — "
                     "set kv_block_size"
@@ -1146,6 +1229,48 @@ class InferenceEngine:
         number that must be 0 in steady state (CI asserts it)."""
         return self.sentinel.post_warmup_recompiles()
 
+    # ------------------------------------------------ precision accounting
+
+    def precision_stats(self) -> dict | None:
+        """The schema-v11 serving keys (``weight_bits`` /
+        ``param_bytes`` / ``param_bytes_f32`` / ``quantized_params``)
+        when this engine serves quantized weights; None on an
+        unquantized tree — a pre-quant serving line carries none of
+        them, the same optional-on-write rule as every schema bump."""
+        if not self.quantized_weights:
+            return None
+        return dict(self._precision_stats)
+
+    def byte_breakdown(self, *, per_device: bool = False) -> dict:
+        """Serving-side HBM accounting (what ``serve_bench
+        --weight-dtype`` banks as ``hbm_bytes_per_replica`` and the
+        quantized×sharded test states its ≤0.35× claim in):
+        ``params_bytes`` as stored (quantized leaves at 1 byte/elt
+        plus their f32 row scales), ``params_bytes_f32`` (the same
+        logical tree at 4 bytes/elt), and the KV pool's committed
+        bytes. ``per_device=True`` counts each leaf's bytes on ONE
+        device — sharded leaves at 1/N (``telemetry/memory.tree_bytes``
+        semantics) — and then reports ONLY the per-device-meaningful
+        ``params_bytes``/``weight_bits``: the f32 baseline and the
+        pool's used-block accounting are global numbers, and mixing
+        units in one dict would make the natural ratios silently
+        wrong (compare two engines' per-device ``params_bytes``
+        instead, which is what the quantized×sharded test does)."""
+        from tensorflow_examples_tpu.telemetry.memory import tree_bytes
+
+        out = {
+            "params_bytes": tree_bytes(
+                self.params, per_device=per_device
+            ),
+            "weight_bits": self._precision_stats["weight_bits"],
+        }
+        if not per_device:
+            out["params_bytes_f32"] = self._precision_stats[
+                "param_bytes_f32"
+            ]
+            out["kv_cache_bytes"] = int(self.pool.used_bytes())
+        return out
+
     # ------------------------------------------------------ request ops
 
     def _run_compiled(self, kind: str, fn, *args):
@@ -1347,25 +1472,39 @@ class InferenceEngine:
 
     # ----------------------------------- KV page handoff (ISSUE 12 (c))
 
-    def export_kv_pages(self, slot: int, prompt: Sequence[int]) -> dict:
+    def export_kv_pages(self, slot: int, prompt: Sequence[int], *,
+                        skip_tokens: int = 0) -> dict:
         """Serialize the slot's finished prompt KV blocks as the
         prefill->decode handoff payload (``scheduler.encode_pages``
-        wire format, int8 scales included). The prefill-role half of
-        disaggregated serving: the importer's decode continues with
-        numerically identical cache state, so the handed-off stream is
-        token-identical to a mixed replica serving the whole request."""
+        wire format, quantization scales included). The prefill-role
+        half of disaggregated serving: the importer's decode continues
+        with numerically identical cache state, so the handed-off
+        stream is token-identical to a mixed replica serving the whole
+        request.
+
+        ``skip_tokens`` is the streaming DELTA handoff (ISSUE 15
+        satellite): the router's digest exchange says the importer
+        already caches that many leading prompt tokens, so the leading
+        full blocks they cover stay OFF the wire (``start_block``
+        meta). Floored to this replica's block multiple and capped so
+        at least the final (partial) block always ships."""
         if not self.paged:
             raise ValueError(
                 "KV page export requires the paged pool (set "
                 "kv_block_size)"
             )
+        if skip_tokens < 0:
+            raise ValueError(f"skip_tokens={skip_tokens} must be >= 0")
         from tensorflow_examples_tpu.serving import scheduler
 
         n = len(prompt)
         bs = self.cfg.kv_block_size
         nb = -(-n // bs)
+        # Only FULL blocks strictly before the tail are skippable —
+        # the same cap prefix_lookup applies to reusable blocks.
+        skip = min(int(skip_tokens) // bs, (n - 1) // bs)
         idx = jnp.asarray(
-            [int(b) for b in self.pool.block_tables[slot, :nb]]
+            [int(b) for b in self.pool.block_tables[slot, skip:nb]]
         )
         state = self.pool.kv_state()
         arrays = {
@@ -1382,8 +1521,13 @@ class InferenceEngine:
             head_dim=self.model_cfg.head_dim,
             length=n,
             kv_bits=self.pool.kv_bits,
+            start_block=skip,
         )
-        self.registry.counter("serving/kv_pages_exported").inc(nb)
+        self.registry.counter("serving/kv_pages_exported").inc(nb - skip)
+        if skip:
+            self.registry.counter(
+                "serving/kv_pages_delta_skipped"
+            ).inc(skip)
         return scheduler.encode_pages(meta, arrays)
 
     def import_kv_pages(self, slot: int, payload,
@@ -1429,10 +1573,21 @@ class InferenceEngine:
             )
         bs = self.cfg.kv_block_size
         nb = -(-n // bs)
+        # Delta handoff (ISSUE 15 satellite): the payload may start at
+        # start_block > 0 — the exporter left off leading blocks the
+        # router's digest exchange says this replica already caches
+        # (absent on pre-delta payloads: a full export).
+        start = meta.get("start_block", 0)
+        if start >= nb:
+            raise ValueError(
+                f"pages start_block={start} but the prompt spans only "
+                f"{nb} blocks"
+            )
+        nb_pages = nb - start
         shapes = {
-            "k": (meta["num_layers"], nb, meta["num_heads"], bs,
+            "k": (meta["num_layers"], nb_pages, meta["num_heads"], bs,
                   meta["head_dim"]),
-            "v": (meta["num_layers"], nb, meta["num_heads"], bs,
+            "v": (meta["num_layers"], nb_pages, meta["num_heads"], bs,
                   meta["head_dim"]),
         }
         if self.pool.quantized:
@@ -1468,12 +1623,23 @@ class InferenceEngine:
         # content, so repeated handoffs of a shared system prompt hold
         # one copy and pay the device write only for the cold tail.
         ctx, fresh = self.pool.claim_prompt_blocks(slot, prompt)
+        if ctx < start * bs:
+            # The delta payload assumes this replica caches the first
+            # ``start`` blocks, but the local prefix cache covers only
+            # ``ctx`` tokens (evicted since the router's probe, or a
+            # stale/bloom-false-positive digest). Loud 400 — the
+            # router falls back to the full path, never a torn cache.
+            raise ValueError(
+                f"delta pages start at block {start} but this "
+                f"replica's prefix cache covers only {ctx} of "
+                f"{start * bs} skipped tokens — re-send full pages"
+            )
         if fresh:
-            start = nb - len(fresh)
+            col = nb - len(fresh) - start  # payload column of fresh[0]
             idx = jnp.asarray(fresh)
             for i, name in enumerate(names):
                 state[i] = state[i].at[:, idx].set(
-                    jnp.asarray(arrays[name][:, start:])
+                    jnp.asarray(arrays[name][:, col:])
                 )
             self.pool.set_kv_state(tuple(state))
         self.pool.lengths[slot] = n

@@ -156,6 +156,11 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
     }
     if kind == "resume":
         known |= {"pages", "first_token"}
+    elif kind == "prefill":
+        # Delta handoff (ISSUE 15): the router's digest exchange —
+        # leading prompt tokens the resume-side replica already
+        # caches, so the export leaves those pages off the wire.
+        known |= {"skip_tokens"}
     unknown = set(body) - known
     if unknown:
         raise ValueError(f"unknown fields: {sorted(unknown)}")
@@ -205,6 +210,9 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
         classify_top_n=number("top_n", 5, int, 1),
         pages=pages,
         first_token=first_token,
+        skip_tokens=(
+            number("skip_tokens", 0, int, 0) if kind == "prefill" else 0
+        ),
         slo=slo,
     )
 
@@ -353,6 +361,11 @@ class ServingFrontend:
             # ISSUE 13 satellite: say when the digest is capped, so
             # affinity misses on very large caches are diagnosable.
             body["digest_truncated"] = bool(d.get("truncated"))
+            if d.get("bloom"):
+                # ISSUE 15 satellite: past the cap the FULL chain-key
+                # set still routes — as a bloom filter the router
+                # matches against instead of the truncated list.
+                body["prefix_bloom"] = d["bloom"]
         wd = batcher._watchdog
         if wd is not None:
             status = wd.status()

@@ -142,11 +142,25 @@ class PagedKVPool:
                              "allocatable block beyond the null block")
         self.dtype = dtype
         self.kv_dtype = kv_dtype or ""
-        if self.kv_dtype not in ("", "int8"):
+        if self.kv_dtype not in ("", "int8", "fp8"):
             raise ValueError(
-                f"kv_dtype={kv_dtype!r} not in ('', 'int8')"
+                f"kv_dtype={kv_dtype!r} not in ('', 'int8', 'fp8')"
             )
-        self.quantized = self.kv_dtype == "int8"
+        # fp8 KV (ISSUE 15): same blockwise per-row scales, the payload
+        # stored as float8_e4m3fn — the precision registry's row
+        # quantization is dtype-generic, so the whole int8 path (write,
+        # gather-dequant, wire pages) serves fp8 unchanged. Gated
+        # loudly on builds without a working fp8.
+        if self.kv_dtype == "fp8":
+            from tensorflow_examples_tpu.core import precision
+
+            if not precision.fp8_supported():
+                raise ValueError(
+                    "kv_dtype='fp8' requested but this jax "
+                    "build/backend has no working float8_e4m3fn — "
+                    "use kv_dtype='int8'"
+                )
+        self.quantized = self.kv_dtype in ("int8", "fp8")
         self.prefix_cache_enabled = bool(prefix_cache)
         self._registry = registry
         self._sharding = sharding
@@ -178,6 +192,13 @@ class PagedKVPool:
         # from physical ids, which are meaningless across replicas.
         self._chain_hash: dict[int, str] = {}  # guard: self._lock
         self._chain_depth: dict[int, int] = {}  # guard: self._lock
+        # Bloom-digest cache (ISSUE 15): generation counter bumped on
+        # every published-chain change; the encoded filter is built
+        # OUTSIDE the lock from a snapshot and reused until the
+        # generation moves, so a /health probe never holds the
+        # allocation lock for a full blake2b sweep of a huge cache.
+        self._digest_gen = 0  # guard: self._lock
+        self._bloom_cache: tuple | None = None  # guard: self._lock
         self._evictable: OrderedDict[int, None] = OrderedDict()  # guard: self._lock
         self.prefix_hits = 0  # guard: self._lock
         self.prefix_misses = 0  # guard: self._lock
@@ -189,7 +210,14 @@ class PagedKVPool:
     def _alloc_arrays(self) -> None:
         shape = (self.num_layers, self.num_blocks, self.num_heads,
                  self.block_size, self.head_dim)
-        store = jnp.int8 if self.quantized else self.dtype
+        if self.kv_dtype == "fp8":
+            from tensorflow_examples_tpu.core import precision
+
+            store = precision.fp8_dtype()
+        elif self.quantized:
+            store = jnp.int8
+        else:
+            store = self.dtype
         kw = {} if self._sharding is None else {"device": self._sharding}
         self.k = jnp.zeros(shape, store, **kw)
         self.v = jnp.zeros(shape, store, **kw)
@@ -232,6 +260,8 @@ class PagedKVPool:
         self._cache_key.clear()
         self._chain_hash.clear()
         self._chain_depth.clear()
+        self._digest_gen += 1
+        self._bloom_cache = None
 
     # ------------------------------------------------------------- slots
 
@@ -332,6 +362,7 @@ class PagedKVPool:
             del self._cache[key]
             self._chain_hash.pop(bid, None)
             self._chain_depth.pop(bid, None)
+            self._digest_gen += 1
             return bid
         self._reg().counter("serving/kv_exhausted_total").inc()
         log.warning(
@@ -518,6 +549,7 @@ class PagedKVPool:
                 self._cache_key[bid] = key
                 self._chain_hash[bid] = parent_hash
                 self._chain_depth[bid] = i + 1
+                self._digest_gen += 1
                 parent = bid
             self._publish_locked()
 
@@ -547,12 +579,36 @@ class PagedKVPool:
                 key=lambda kv: (self._chain_depth[kv[0]], kv[1]),
             )
             truncated = len(items) > max_keys
-            return {
+            out = {
                 "keys": [h for _, h in items[:max_keys]],
                 "blocks": len(self._cache),
                 "chains": self._chains_locked(),
                 "truncated": truncated,
             }
+            gen = self._digest_gen
+            cached = self._bloom_cache
+        if truncated:
+            # ISSUE 15 satellite: past the cap, ALSO publish a bloom
+            # filter over the ENTIRE chain-key set, so affinity
+            # routing keeps working on very large caches (false
+            # positives only overstate a load-guarded preference).
+            # Built OUTSIDE the lock from the snapshot and cached per
+            # generation — a probe of an unchanged huge cache reuses
+            # the encoded filter instead of re-hashing every key, and
+            # never stalls allocation while hashing.
+            if cached is not None and cached[0] == gen:
+                out["bloom"] = cached[1]
+            else:
+                bloom = scheduler.encode_bloom(h for _, h in items)
+                with self._lock:
+                    # Store only while still current: a slow build
+                    # racing a fresher probe must not clobber the
+                    # newer cached filter with an older-generation one
+                    # (which would force a full re-hash per probe).
+                    if self._digest_gen == gen:
+                        self._bloom_cache = (gen, bloom)
+                out["bloom"] = bloom
+        return out
 
     # -------------------------------------------------- byte accounting
 

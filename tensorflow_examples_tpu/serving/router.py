@@ -485,6 +485,21 @@ class Router:
                     r.prefix_digest = frozenset(
                         k for k in digest if isinstance(k, str)
                     )
+                bloom = body.get("prefix_bloom")
+                if isinstance(bloom, dict):
+                    # ISSUE 15 satellite: a truncated replica ALSO
+                    # publishes a bloom filter over its whole chain-key
+                    # set — prefer it (the key list is capped; the
+                    # filter is not). Malformed payloads fail THIS
+                    # field only, never the sweep.
+                    from tensorflow_examples_tpu.serving import (
+                        scheduler,
+                    )
+
+                    try:
+                        r.prefix_digest = scheduler.decode_bloom(bloom)
+                    except ValueError:
+                        pass  # keep the (truncated) key list
                 # Half-open probe -> readmit (ISSUE 10): once the
                 # breaker's cooldown has expired, a green /health is
                 # the trial — the replica rejoins dispatch without
@@ -988,6 +1003,40 @@ class Router:
                 continue
             return None
 
+    def _decode_cached_tokens(self, prompt, key_cache: dict) -> int:
+        """Digest exchange for the streaming delta handoff (ISSUE 15
+        satellite): how many leading prompt tokens EVERY eligible
+        resume-side replica already caches (per its last probe) — the
+        skip that is safe whichever replica the affinity-routed resume
+        leg lands on. Conservative by construction (the minimum over
+        the tier); the importer still validates its cache actually
+        covers the skip (probe staleness, bloom false positives) and a
+        mismatch 400 falls back to the full path, never a torn cache."""
+        from tensorflow_examples_tpu.serving import scheduler
+
+        now = time.monotonic()
+        with self._lock:
+            candidates = [
+                r for r in self.replicas
+                if r.eligible(self.cfg.unhealthy_after, now)
+                and r.serves("decode")
+            ]
+            best: int | None = None
+            for r in candidates:
+                if r.block_size < 1 or not r.prefix_digest:
+                    return 0
+                keys = key_cache.get(r.block_size)
+                if keys is None:
+                    keys = scheduler.prompt_chain_keys(
+                        prompt, r.block_size
+                    )
+                    key_cache[r.block_size] = keys
+                tokens = scheduler.affinity_blocks(
+                    keys, r.prefix_digest
+                ) * r.block_size
+                best = tokens if best is None else min(best, tokens)
+        return best or 0
+
     def _handle_disagg(self, body: dict, prompt,
                        key_cache: dict | None = None
                        ) -> tuple[int, dict] | None:
@@ -1000,7 +1049,18 @@ class Router:
         any failure — the caller replays the request through the full
         path (token-identical by seeding), so a dead role-holder costs
         a failover, never a request."""
-        preply = self._leg(body, "prefill", "prefill", prompt, key_cache)
+        # Streaming delta (ISSUE 15): tell the prefill leg how many
+        # leading tokens the decode tier already caches — those pages
+        # never enter the wire. The resume body stays untouched (the
+        # skip is encoded in the pages' own start_block meta).
+        pbody = body
+        skip = self._decode_cached_tokens(
+            prompt, key_cache if key_cache is not None else {}
+        )
+        if skip:
+            pbody = dict(body)
+            pbody["skip_tokens"] = skip
+        preply = self._leg(pbody, "prefill", "prefill", prompt, key_cache)
         if (
             not isinstance(preply, dict)
             or not isinstance(preply.get("pages"), dict)
@@ -1020,6 +1080,13 @@ class Router:
         if not isinstance(dreply, dict):
             return None
         self.registry.counter("router/handoffs_total").inc()
+        if skip:
+            # Counted only on a COMPLETED handoff: a fallback after a
+            # stale-digest 400 saved nothing, and the "tokens kept off
+            # the wire" metric must not overstate itself.
+            self.registry.counter(
+                "router/handoff_delta_tokens_total"
+            ).inc(skip)
         pre_total = preply.get("total_s")
         if isinstance(pre_total, (int, float)):
             for key in ("ttft_s", "total_s"):

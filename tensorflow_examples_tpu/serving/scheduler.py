@@ -91,16 +91,118 @@ def prompt_chain_keys(prompt, block_size: int) -> list[str]:
 
 def affinity_blocks(chain_keys: list[str], digest) -> int:
     """How many leading blocks of a prompt (``chain_keys`` from
-    :func:`prompt_chain_keys`) a replica's published ``digest`` (a set
-    of chain keys) already holds — the router's affinity score. The
-    walk stops at the first miss: cached blocks are only mappable as a
-    chain from the root."""
+    :func:`prompt_chain_keys`) a replica's published ``digest``
+    already holds — the router's affinity score. ``digest`` is
+    anything supporting ``in``: the exact frozenset of published
+    chain keys, or a :class:`BloomDigest` when the replica's cache
+    outgrew the key-list cap. The walk stops at the first miss:
+    cached blocks are only mappable as a chain from the root."""
     n = 0
     for key in chain_keys:
         if key not in digest:
             break
         n += 1
     return n
+
+
+# ------------------------------------------------------- bloom digest
+#
+# ISSUE 15 satellite (PR 11/12 follow-up): ``prefix_digest()`` caps its
+# key list at DIGEST_MAX_KEYS to bound the /health payload, which
+# blinds affinity routing to everything past the cap on very large
+# caches. When the cap bites, the replica ALSO publishes a bloom
+# filter over its ENTIRE chain-key set — fixed ~1.25 KiB per 1k keys
+# instead of 16 B/key — and the router matches against that. False
+# positives can only OVERSTATE affinity (a preference, load-guarded;
+# a wrong delta-handoff skip is validated importer-side and falls
+# back), and there are no false negatives, so routing keeps working
+# where the truncated list went blind. ``digest_truncated`` stays the
+# operator's fallback signal.
+
+BLOOM_BITS_PER_KEY = 10   # ~1% false-positive rate at 7 hashes
+BLOOM_HASHES = 7
+BLOOM_MIN_BITS = 64
+BLOOM_MAX_BITS = 1 << 20  # 128 KiB hard cap on the /health payload
+
+
+def _bloom_indices(key: str, m: int, k: int) -> list[int]:
+    """Double hashing from one blake2b digest: k bit indices in
+    [0, m)."""
+    h = hashlib.blake2b(key.encode("ascii"), digest_size=16).digest()
+    a = int.from_bytes(h[:8], "big")
+    b = int.from_bytes(h[8:], "big") | 1  # odd: never collapses
+    return [(a + i * b) % m for i in range(k)]
+
+
+def encode_bloom(keys) -> dict:
+    """Bloom filter over chain keys as a JSON-safe /health payload:
+    ``{m, k, n, bits}`` with the bit array base64'd."""
+    keys = list(keys)
+    m = min(
+        BLOOM_MAX_BITS,
+        max(BLOOM_MIN_BITS, len(keys) * BLOOM_BITS_PER_KEY),
+    )
+    m = (m + 7) // 8 * 8  # whole bytes
+    bits = bytearray(m // 8)
+    for key in keys:
+        for idx in _bloom_indices(key, m, BLOOM_HASHES):
+            bits[idx // 8] |= 1 << (idx % 8)
+    return {
+        "m": m,
+        "k": BLOOM_HASHES,
+        "n": len(keys),
+        "bits": base64.b64encode(bytes(bits)).decode("ascii"),
+    }
+
+
+class BloomDigest:
+    """Read side of :func:`encode_bloom`: supports ``key in digest``
+    (what :func:`affinity_blocks` needs) and ``len()`` (the published
+    key count, so an empty filter is falsy like an empty frozenset)."""
+
+    __slots__ = ("m", "k", "n", "_bits")
+
+    def __init__(self, m: int, k: int, n: int, bits: bytes):
+        self.m = m
+        self.k = k
+        self.n = n
+        self._bits = bits
+
+    def __contains__(self, key) -> bool:
+        if not isinstance(key, str) or self.n == 0:
+            return False
+        return all(
+            self._bits[idx // 8] & (1 << (idx % 8))
+            for idx in _bloom_indices(key, self.m, self.k)
+        )
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __repr__(self):
+        return f"BloomDigest(m={self.m}, k={self.k}, n={self.n})"
+
+
+def decode_bloom(payload) -> BloomDigest:
+    """Parse a published bloom payload; every malformation raises
+    ``ValueError`` (a garbage /health body must fail THIS field, not
+    the probe sweep — the router treats it as 'no digest')."""
+    if not isinstance(payload, dict):
+        raise ValueError("bloom digest must be a JSON object")
+    try:
+        m, k, n = int(payload["m"]), int(payload["k"]), int(payload["n"])
+        bits = base64.b64decode(payload["bits"], validate=True)
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed bloom digest: {e}") from None
+    if m < 8 or m % 8 or m > BLOOM_MAX_BITS:
+        raise ValueError(f"bloom m={m} out of range")
+    if not 1 <= k <= 32 or n < 0:
+        raise ValueError(f"bloom k={k}/n={n} out of range")
+    if len(bits) != m // 8:
+        raise ValueError(
+            f"bloom bits: {len(bits)} bytes does not match m={m}"
+        )
+    return BloomDigest(m, k, n, bits)
 
 
 # ------------------------------------------------------- chunk planning
@@ -149,13 +251,26 @@ def _b64(arr: np.ndarray) -> str:
 def encode_pages(meta: dict, arrays: dict) -> dict:
     """Serialize a slot's finished KV blocks for the prefill->decode
     handoff. ``arrays`` maps name -> numpy array (``k``/``v`` always,
-    ``k_scale``/``v_scale`` under int8); geometry rides in ``meta`` so
-    the importer can validate before touching its pool."""
+    ``k_scale``/``v_scale`` when quantized); geometry rides in
+    ``meta`` so the importer can validate before touching its pool.
+
+    ``meta["start_block"]`` (optional, default 0) is the streaming
+    DELTA handoff (ISSUE 15 satellite): the arrays cover only blocks
+    ``[start_block, ceil(length / block_size))`` — the exporter left
+    off the leading blocks the router's digest exchange says the
+    importer already caches. The importer validates its prefix cache
+    actually covers the skipped tokens (400 + full-path fallback when
+    a probe-stale digest lied)."""
     missing = [k for k in _PAGE_META if k not in meta]
     if missing:
         raise ValueError(f"page meta missing {missing}")
     payload = {"version": PAGE_WIRE_VERSION, **{k: int(meta[k]) for k in
                                                 _PAGE_META}}
+    start = int(meta.get("start_block", 0))
+    if start < 0:
+        raise ValueError(f"start_block={start} must be >= 0")
+    if start:
+        payload["start_block"] = start
     payload["arrays"] = {
         name: {
             "dtype": str(arr.dtype),
@@ -186,6 +301,20 @@ def decode_pages(payload) -> tuple[dict, dict]:
             raise ValueError(f"pages meta {key!r} = {v!r} is not a "
                              "positive int")
         meta[key] = v
+    if "start_block" in payload:
+        start = payload["start_block"]
+        if not isinstance(start, int) or isinstance(start, bool) \
+                or start < 0:
+            raise ValueError(
+                f"pages meta 'start_block' = {start!r} is not a "
+                "non-negative int"
+            )
+        if start * meta["block_size"] >= meta["length"]:
+            raise ValueError(
+                f"pages start_block={start} skips the whole "
+                f"{meta['length']}-token prompt"
+            )
+        meta["start_block"] = start
     raw = payload.get("arrays")
     if not isinstance(raw, dict) or "k" not in raw or "v" not in raw:
         raise ValueError("pages payload is missing the k/v arrays")

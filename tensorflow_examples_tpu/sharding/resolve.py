@@ -37,8 +37,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tensorflow_examples_tpu.core.mesh import AxisNames
 from tensorflow_examples_tpu.core.sharding import (
     ShardingRules,
+    _clip_spec,
     _filter_spec,
     _path_str,
+    _rule_path,
     shardings_for_params,
 )
 
@@ -158,7 +160,13 @@ def resolve_params(
 
     def one(path, leaf):
         p = _path_str(path)
-        spec = rules.spec_for(p)
+        # Rule matching + rank clipping mirror shardings_for_params: a
+        # quantized child matches rules under its WEIGHT's path (so
+        # anchored patterns keep working), the scale resolves (and is
+        # accounted) under the weight's leading-dims spec. The row
+        # keeps the FULL path — q and scale stay distinct in the
+        # digest and the table.
+        spec = _clip_spec(rules.spec_for(_rule_path(path)), path, leaf)
         placed = _filter_spec(spec, mesh)
         shape = tuple(getattr(leaf, "shape", ()))
         dtype = getattr(leaf, "dtype", None)

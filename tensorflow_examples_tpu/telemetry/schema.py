@@ -8,7 +8,8 @@ keys on the batcher's ``serving`` object, see ``SERVING_KEYS_V8``; v9
 in ISSUE 12 — the prefix-cache summary behind cache-aware fleet
 scheduling, see ``SERVING_KEYS_V9``; v10 in ISSUE 13 — SLO-class
 admission, brownout, and digest-truncation observability, see
-``SERVING_KEYS_V10``).
+``SERVING_KEYS_V10``; v11 in ISSUE 15 — the weight-quantization
+story behind int8/fp8 end-to-end serving, see ``SERVING_KEYS_V11``).
 
 Every line the JSONL sink emits carries ``schema_version`` so offline
 consumers (tools/telemetry_report.py, tools/bench_gate.py, future
@@ -145,9 +146,17 @@ SCHEMA_VERSION = 5
 # stamps its own numbers; the router stamps the fleet view (max
 # brownout level, summed transitions). Forbidden on v4-v9 serving
 # lines, same mislabeling rule as every earlier bump.
-SERVING_SCHEMA_VERSION = 10
+#
+# Version 11 (ISSUE 15): additive — a weight-quantized serving line
+# may carry the precision registry's facts (weight_bits /
+# param_bytes / param_bytes_f32 / quantized_params — what precision
+# the replica is ACTUALLY serving at, and what it costs in HBM
+# versus f32). All numeric; optional on write (an unquantized line
+# carries none), FORBIDDEN on v4-v10 serving lines, same mislabeling
+# rule as every earlier bump.
+SERVING_SCHEMA_VERSION = 11
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -225,6 +234,22 @@ SERVING_KEYS_V10 = (
     "shed_interactive", "shed_batch", "preempted_batch",
     "brownout_level", "brownout_transitions", "digest_truncated",
 )
+
+# v11-only serving-object keys (ISSUE 15): the precision registry's
+# serving facts — weight payload bits, param bytes as stored vs what
+# the same tree costs at f32, and the quantized-leaf count. Stamped by
+# the batcher only when the engine serves quantized weights; FORBIDDEN
+# on v4-v10 serving lines.
+SERVING_KEYS_V11 = ("weight_bits", "param_bytes", "param_bytes_f32",
+                    "quantized_params")
+
+# Instrument namespaces of the serving tier whose counter/gauge/
+# histogram registrations the graftlint drift pass cross-checks
+# against the docs catalog (ISSUE 15 satellite: the pass LEARNS this
+# list from here — adding a namespace is a schema-module edit, not a
+# lint-pass edit).
+INSTRUMENT_PREFIXES = ("serving/", "router/", "autoscaler/",
+                       "precision/")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -512,6 +537,13 @@ def validate_line(obj: Any) -> list[str]:
                     if key in obj["serving"]:
                         problems.append(
                             f"v10 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
+            if version < 11:
+                for key in SERVING_KEYS_V11:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v11 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
     elif "serving" in obj:

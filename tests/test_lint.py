@@ -115,6 +115,62 @@ class TestSchemaPassFixtures:
             "unstamped-schema-key:ghost_key",
         ]
 
+    def test_tuples_learned_by_naming_convention(self):
+        """ISSUE 15 satellite: the pass discovers every SERVING_KEYS*
+        tuple in the real schema module by the naming convention — the
+        v11 bump (and any future one) needs no pass-side list edit."""
+        from tensorflow_examples_tpu.telemetry import schema
+
+        src = drift._load(REPO, drift.SCHEMA_FILE)
+        tuples = drift.schema_keys(src)
+        assert "SERVING_KEYS_V11" in tuples
+        assert tuples["SERVING_KEYS_V11"] == set(schema.SERVING_KEYS_V11)
+        # Every live bump is discovered, none hand-listed.
+        for n in range(6, 12):
+            assert f"SERVING_KEYS_V{n}" in tuples
+        # Precedence: the base (v4) tuple claims shared keys first.
+        assert drift._tuple_order("SERVING_KEYS") < drift._tuple_order(
+            "SERVING_KEYS_V6"
+        )
+
+    def test_instrument_prefixes_learned_from_schema_module(self):
+        """The scanned namespaces come from INSTRUMENT_PREFIXES in the
+        schema module (precision/ rides in via ISSUE 15); a schema file
+        without the constant falls back to the pre-ISSUE-15 trio."""
+        from tensorflow_examples_tpu.telemetry import schema
+
+        src = drift._load(REPO, drift.SCHEMA_FILE)
+        assert drift.instrument_prefixes(src) == tuple(
+            schema.INSTRUMENT_PREFIXES
+        )
+        assert "precision/" in drift.instrument_prefixes(src)
+        # The mini-tree fixture's schema module predates the constant.
+        mini = drift._load(
+            _fixture("schema_tree"),
+            "tensorflow_examples_tpu/telemetry/schema.py",
+        )
+        assert drift.instrument_prefixes(mini) == (
+            "serving/", "router/", "autoscaler/"
+        )
+
+    def test_precision_instruments_are_scanned(self):
+        """The engine's precision/* gauges are inside the drift pass's
+        net: scrubbing one from the docs would be a finding (proved by
+        scanning the real engine file with the learned prefixes)."""
+        src = common.load_source(
+            os.path.join(
+                REPO, "tensorflow_examples_tpu/serving/engine.py"
+            ),
+            REPO,
+        )
+        schema_src = drift._load(REPO, drift.SCHEMA_FILE)
+        names = drift.registered_instruments(
+            src, drift.instrument_prefixes(schema_src)
+        )
+        assert "precision/weight_bits" in names
+        assert "precision/param_bytes" in names
+        assert "serving/kv_pages_delta_skipped" in names
+
 
 # ------------------------------------------------------------- baseline
 

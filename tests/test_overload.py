@@ -591,7 +591,7 @@ class TestSchemaV10:
         b = ContinuousBatcher(eng)
         line = json.loads(json.dumps(b.stats_line()))
         assert line["schema_version"] == \
-            schema.SERVING_SCHEMA_VERSION == 10
+            schema.SERVING_SCHEMA_VERSION == 11
         assert schema.validate_line(line) == []
         assert line["serving"]["brownout_level"] == 0
         assert line["serving"]["shed_interactive"] == 0
@@ -650,7 +650,7 @@ class TestSchemaV10:
             rep.brownout_transitions = 3
             rep.digest_truncated = (i == 1)
         line = json.loads(json.dumps(r.stats_line()))
-        assert line["schema_version"] == 10
+        assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION
         assert schema.validate_line(line) == []
         assert line["serving"]["brownout_level"] == 2  # fleet MAX
         assert line["serving"]["brownout_transitions"] == 6

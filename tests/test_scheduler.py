@@ -727,6 +727,341 @@ class TestHandoffGolden:
             fut.result(timeout=5)
 
 
+# ------------------------------------------ streaming delta (ISSUE 15)
+
+
+class TestDeltaHandoff:
+    """PR 11 follow-up: /prefill -> /resume ships only the pages the
+    importer's prefix cache doesn't already hold — the digest exchange
+    rides the handoff request as ``skip_tokens`` and the pages'
+    ``start_block`` meta."""
+
+    def test_start_block_codec_roundtrip_and_malformations(self):
+        meta = dict(block_size=8, num_layers=1, num_heads=2, head_dim=8,
+                    length=20, kv_bits=32, start_block=1)
+        arrays = {"k": np.ones((1, 2, 2, 8, 8), np.float32),
+                  "v": np.zeros((1, 2, 2, 8, 8), np.float32)}
+        payload = json.loads(json.dumps(
+            scheduler.encode_pages(meta, arrays)
+        ))
+        got_meta, got_arrays = scheduler.decode_pages(payload)
+        assert got_meta["start_block"] == 1
+        assert got_arrays["k"].shape == (1, 2, 2, 8, 8)
+        # Absent start_block reads as 0 (pre-delta payloads): neither
+        # the wire nor the parsed meta carry the key.
+        no_skip = scheduler.encode_pages(
+            {**meta, "start_block": 0}, arrays
+        )
+        assert "start_block" not in no_skip
+        assert "start_block" not in scheduler.decode_pages(no_skip)[0]
+        with pytest.raises(ValueError, match="start_block"):
+            scheduler.encode_pages({**meta, "start_block": -1}, arrays)
+        bad = dict(payload)
+        bad["start_block"] = -2
+        with pytest.raises(ValueError, match="start_block"):
+            scheduler.decode_pages(bad)
+        whole = dict(payload)
+        whole["start_block"] = 5  # 5 * 8 >= length 20
+        with pytest.raises(ValueError, match="skips the whole"):
+            scheduler.decode_pages(whole)
+
+    @pytest.mark.timeout(300)
+    def test_delta_import_token_identical_when_prefix_held(self):
+        """Engine level: the importer already caches the shared prefix
+        (an earlier full handoff); a delta export skipping it imports
+        clean and the continued stream is token-identical — while the
+        wire payload carries strictly fewer blocks."""
+        donor = _build_engine()
+        importer = _build_engine()
+        rng = np.random.default_rng(31)
+        prompt = [int(t) for t in rng.integers(0, 211, 37)]
+        # Round 1: full handoff seeds the importer's prefix cache.
+        slot = donor.pool.alloc()
+        first, _ = donor.prefill(slot, prompt, seed=5)
+        full = donor.export_kv_pages(slot, prompt)
+        donor.pool.free(slot)
+        i_slot = importer.pool.alloc()
+        importer.import_kv_pages(i_slot, full, prompt)
+        importer.pool.free(i_slot)
+        # Round 2: same prompt, digest says the importer holds
+        # (len-1)//bs * bs = 32 leading tokens.
+        slot = donor.pool.alloc()
+        first2, _ = donor.prefill(slot, prompt, seed=5)
+        delta = json.loads(json.dumps(
+            donor.export_kv_pages(slot, prompt, skip_tokens=32)
+        ))
+        donor.pool.free(slot)
+        assert first2 == first
+        assert delta["start_block"] == 4
+        nb_full = len(full["arrays"]["k"]["data"])
+        nb_delta = len(delta["arrays"]["k"]["data"])
+        assert nb_delta < nb_full // 3  # 1 of 5 blocks on the wire
+        exported = donor.registry.counter_values()
+        assert exported["serving/kv_pages_delta_skipped"] == 4
+        i_slot = importer.pool.alloc()
+        importer.import_kv_pages(i_slot, delta, prompt)
+        stream = []
+        tok = int(first)
+        for _ in range(4):
+            tok = importer.decode([(i_slot, tok, 5, 0.0, 0)])[i_slot]
+            stream.append(tok)
+        importer.pool.free(i_slot)
+        ref = importer.reference_generate(prompt, max_new=5, seed=5)
+        assert [int(first)] + stream == ref
+
+    @pytest.mark.timeout(300)
+    def test_cold_importer_rejects_delta_loudly(self):
+        """A delta payload landing on a replica whose prefix cache
+        does NOT cover the skip (probe-stale digest) is a loud
+        ValueError (-> 400 -> router full-path fallback), never a torn
+        cache."""
+        donor = _build_engine()
+        cold = _build_engine()
+        prompt = list(range(40))
+        slot = donor.pool.alloc()
+        donor.prefill(slot, prompt, seed=0)
+        delta = donor.export_kv_pages(slot, prompt, skip_tokens=16)
+        donor.pool.free(slot)
+        i_slot = cold.pool.alloc()
+        try:
+            with pytest.raises(ValueError, match="prefix cache covers"):
+                cold.import_kv_pages(i_slot, delta, prompt)
+        finally:
+            cold.pool.free(i_slot)
+
+    @pytest.mark.timeout(300)
+    def test_skip_tokens_over_http_prefill(self):
+        """The wire surface: /prefill accepts skip_tokens and the
+        reply's pages carry start_block; junk skip_tokens is a 400."""
+        engine = _build_engine()
+        batcher = ContinuousBatcher(engine).start()
+        frontend = ServingFrontend(batcher, port=0).start()
+
+        def post(path, body):
+            req = urllib.request.Request(
+                frontend.url(path), data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read() or b"{}")
+
+        prompt = list(range(30))
+        try:
+            status, pre = post(
+                "/prefill", {"prompt": prompt, "skip_tokens": 16},
+            )
+            assert status == 200, pre
+            assert pre["pages"]["start_block"] == 2
+            status, err = post(
+                "/prefill", {"prompt": prompt, "skip_tokens": -1},
+            )
+            assert status == 400
+            # skip_tokens is a prefill-leg field only.
+            status, err = post(
+                "/generate", {"prompt": prompt, "skip_tokens": 8},
+            )
+            assert status == 400 and "unknown" in err["error"]
+        finally:
+            batcher.close(drain=True)
+            frontend.close()
+
+    def test_failed_delta_handoff_counts_no_savings(self):
+        """router/handoff_delta_tokens_total only ticks on COMPLETED
+        handoffs: a handoff whose legs fail (dead replicas here) falls
+        back to the full path having saved nothing."""
+        from tensorflow_examples_tpu.serving.router import RouterConfig
+
+        router = Router(
+            ["http://127.0.0.1:1", "http://127.0.0.1:2"],
+            cfg=RouterConfig(max_retries=0, retry_budget_s=0.1,
+                             retry_backoff_s=0.0),
+        )
+        pre, dec = router.replicas
+        for r, role in ((pre, "prefill"), (dec, "decode")):
+            r.probed = True
+            r.role = role
+            r.block_size = 8
+        prompt = list(range(33))
+        dec.prefix_digest = frozenset(
+            scheduler.prompt_chain_keys(prompt, 8)
+        )
+        out = router._handle_disagg({"prompt": prompt}, prompt, {})
+        assert out is None  # both legs dead -> full-path fallback
+        counters = router.registry.counter_values()
+        assert counters.get("router/handoff_delta_tokens_total", 0) == 0
+
+    def test_router_digest_exchange_is_conservative_minimum(self):
+        """_decode_cached_tokens: the skip is the MINIMUM over eligible
+        decode-serving replicas (safe whichever one the resume lands
+        on), and 0 the moment any candidate has no digest."""
+        router = Router(["http://a", "http://b"])
+        a, b = router.replicas
+        for r, role in ((a, "decode"), (b, "mixed")):
+            r.probed = True
+            r.role = role
+            r.block_size = 8
+        prompt = list(range(33))
+        keys = scheduler.prompt_chain_keys(prompt, 8)
+        a.prefix_digest = frozenset(keys)       # holds 4 blocks
+        b.prefix_digest = frozenset(keys[:2])   # holds 2 blocks
+        assert router._decode_cached_tokens(prompt, {}) == 16
+        b.prefix_digest = frozenset()
+        assert router._decode_cached_tokens(prompt, {}) == 0
+        b.role = "prefill"  # not a resume candidate anymore
+        assert router._decode_cached_tokens(prompt, {}) == 32
+
+
+# ------------------------------------------- bloom digest (ISSUE 15)
+
+
+class TestBloomDigest:
+    def test_roundtrip_no_false_negatives(self):
+        keys = [scheduler.chain_key("", [i]) for i in range(300)]
+        bloom = scheduler.decode_bloom(json.loads(json.dumps(
+            scheduler.encode_bloom(keys)
+        )))
+        assert len(bloom) == 300
+        assert all(k in bloom for k in keys), "bloom NEVER false-negs"
+
+    def test_false_positive_rate_sane(self):
+        keys = [scheduler.chain_key("", [i]) for i in range(500)]
+        bloom = scheduler.decode_bloom(scheduler.encode_bloom(keys))
+        probes = [
+            scheduler.chain_key("x", [i]) for i in range(2000)
+        ]
+        fp = sum(p in bloom for p in probes) / len(probes)
+        assert fp < 0.05, f"false-positive rate {fp} out of spec"
+
+    def test_empty_filter_is_falsy_and_matches_nothing(self):
+        bloom = scheduler.decode_bloom(scheduler.encode_bloom([]))
+        assert not bloom
+        assert scheduler.chain_key("", [1]) not in bloom
+
+    def test_malformed_payloads_are_loud(self):
+        good = scheduler.encode_bloom(["ab"])
+        for mutate in (
+            lambda p: p.pop("bits"),
+            lambda p: p.__setitem__("bits", "###"),
+            lambda p: p.__setitem__("m", 7),
+            lambda p: p.__setitem__("m", scheduler.BLOOM_MAX_BITS * 2),
+            lambda p: p.__setitem__("k", 0),
+            lambda p: p.__setitem__("n", -1),
+        ):
+            bad = dict(good)
+            mutate(bad)
+            with pytest.raises(ValueError):
+                scheduler.decode_bloom(bad)
+        with pytest.raises(ValueError):
+            scheduler.decode_bloom("not a dict")
+
+    def test_affinity_blocks_walks_a_bloom(self):
+        prompt = list(range(40))
+        keys = scheduler.prompt_chain_keys(prompt, 8)
+        bloom = scheduler.decode_bloom(scheduler.encode_bloom(keys[:3]))
+        got = scheduler.affinity_blocks(keys, bloom)
+        assert got >= 3  # exact is 3; a false positive may extend it
+
+    def test_pool_publishes_bloom_when_truncated(self):
+        pool = PagedKVPool(
+            num_layers=1, num_slots=2, num_heads=1, max_len=32,
+            head_dim=4, block_size=8, registry=MetricsRegistry(),
+        )
+        for i in range(6):
+            slot = pool.alloc()
+            prompt = [i * 100 + j for j in range(16)]
+            total = -(-len(prompt) // 8)
+            blocks = pool.alloc_blocks(total)
+            pool.assign(slot, blocks)
+            pool.lengths[slot] = len(prompt)
+            pool.insert_prefix(slot, prompt)
+            pool.free(slot)
+        full = pool.prefix_digest()
+        assert "bloom" not in full  # under the cap: exact keys suffice
+        capped = pool.prefix_digest(max_keys=4)
+        assert capped["truncated"]
+        bloom = scheduler.decode_bloom(capped["bloom"])
+        # The bloom covers EVERY chain key, including the shed tail.
+        assert len(bloom) == full["blocks"]
+        assert all(k in bloom for k in full["keys"])
+
+    def test_bloom_cached_until_published_set_changes(self):
+        """The encoded filter is built once per cache generation (and
+        outside the lock): an unchanged cache serves the same object
+        to every probe; publishing a new chain invalidates it."""
+        pool = PagedKVPool(
+            num_layers=1, num_slots=2, num_heads=1, max_len=32,
+            head_dim=4, block_size=8, registry=MetricsRegistry(),
+        )
+
+        def publish(base):
+            slot = pool.alloc()
+            prompt = [base + j for j in range(16)]
+            blocks = pool.alloc_blocks(2)
+            pool.assign(slot, blocks)
+            pool.lengths[slot] = 16
+            pool.insert_prefix(slot, prompt)
+            pool.free(slot)
+
+        publish(0)
+        publish(100)
+        b1 = pool.prefix_digest(max_keys=1)["bloom"]
+        b2 = pool.prefix_digest(max_keys=1)["bloom"]
+        assert b1 is b2, "unchanged cache must reuse the encoded bloom"
+        publish(200)
+        b3 = pool.prefix_digest(max_keys=1)["bloom"]
+        assert b3 is not b1
+        key = scheduler.chain_key("", [200 + j for j in range(8)])
+        assert key in scheduler.decode_bloom(b3)
+
+    def test_router_probe_prefers_bloom_over_truncated_list(self):
+        router = Router(["http://a"])
+        (a,) = router.replicas
+        prompt = list(range(40))
+        keys = scheduler.prompt_chain_keys(prompt, 8)
+        payload = scheduler.encode_bloom(keys)
+
+        def fake_get(url, timeout):
+            return 200, {
+                "ok": True,
+                "prefix_block_size": 8,
+                "prefix_digest": keys[:1],  # capped list
+                "digest_truncated": True,
+                "prefix_bloom": payload,
+            }
+
+        from tensorflow_examples_tpu.serving import router as router_mod
+
+        orig = router_mod._get_json
+        router_mod._get_json = fake_get
+        try:
+            router.probe_once()
+        finally:
+            router_mod._get_json = orig
+        assert isinstance(a.prefix_digest, scheduler.BloomDigest)
+        assert scheduler.affinity_blocks(keys, a.prefix_digest) >= len(
+            keys
+        ) - 0
+        # A malformed bloom keeps the key list instead of failing the
+        # probe sweep.
+        def bad_get(url, timeout):
+            return 200, {
+                "ok": True,
+                "prefix_block_size": 8,
+                "prefix_digest": keys[:1],
+                "prefix_bloom": {"m": 7, "k": 1, "n": 1, "bits": "x"},
+            }
+
+        router_mod._get_json = bad_get
+        try:
+            router.probe_once()
+        finally:
+            router_mod._get_json = orig
+        assert a.prefix_digest == frozenset(keys[:1])
+
+
 # -------------------------------------------------------------- schema
 
 
@@ -736,7 +1071,7 @@ class TestSchemaV9:
         batcher = ContinuousBatcher(engine)
         line = json.loads(json.dumps(batcher.stats_line()))
         assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION
-        assert line["schema_version"] == 10
+        assert line["schema_version"] == 11
         assert schema.validate_line(line) == []
         assert line["serving"]["prefix_blocks"] == 0
         assert line["serving"]["prefix_chains"] == 0

@@ -1262,6 +1262,257 @@ class TestInt8KV:
             )
 
 
+class TestFp8KV:
+    """fp8 KV (ISSUE 15): falls out of the precision registry — the
+    int8 write/gather/wire paths are dtype-generic, the pool just
+    stores float8_e4m3fn."""
+
+    @pytest.mark.timeout(180)
+    def test_bounded_divergence_vs_fp32_reference(self):
+        from tensorflow_examples_tpu.core import precision
+
+        if not precision.fp8_supported():
+            pytest.skip("no working float8_e4m3fn on this build")
+        cfg = tiny_cfg(num_layers=1, d_model=16, max_len=32)
+        eng = InferenceEngine(
+            cfg,
+            _tiny_params(cfg),
+            cfg=ServeConfig(
+                max_slots=2, prefill_bucket_floor=16, kv_bucket_floor=16,
+                kv_block_size=8, kv_dtype="fp8",
+            ),
+            registry=MetricsRegistry(),
+        )
+        eng.warmup()
+        assert eng.pool.kv_bits == 8
+        assert eng.pool.k.dtype == precision.fp8_dtype()
+        rng = np.random.default_rng(5)
+        for i in range(3):
+            prompt = [int(t) for t in rng.integers(0, 211, 5 + i * 6)]
+            slot = eng.pool.alloc()
+            tok, _ = eng.prefill(slot, prompt, seed=i)
+            seq = [tok]
+            for _ in range(5):
+                seq.append(eng.decode([(slot, seq[-1], i, 0.0, 0)])[slot])
+            eng.pool.free(slot)
+            ref = eng.reference_generate(prompt, max_new=6, seed=i)
+            assert seq[0] == ref[0], "first token must be exact"
+            agree = sum(a == b for a, b in zip(seq, ref))
+            assert agree >= 0.75 * len(ref), (
+                f"fp8 diverged beyond bound: {seq} vs {ref}"
+            )
+        assert eng.post_warmup_recompiles() == 0
+
+    def test_fp8_rejects_fused_kernel(self):
+        from tensorflow_examples_tpu.core import precision
+
+        if not precision.fp8_supported():
+            pytest.skip("no working float8_e4m3fn on this build")
+        cfg = tiny_cfg(num_layers=1, d_model=16, max_len=32)
+        with pytest.raises(ValueError, match="paged_flash"):
+            InferenceEngine(
+                cfg, _tiny_params(cfg),
+                cfg=ServeConfig(
+                    kv_block_size=8, kv_dtype="fp8",
+                    attention="paged_flash",
+                    prefill_bucket_floor=16, kv_bucket_floor=16,
+                ),
+                registry=MetricsRegistry(),
+            )
+
+
+class TestQuantizedWeights:
+    """Weight-only quantization (ISSUE 15 tentpole): the registry
+    rewrites the tree at load time, the forward dequantizes in the
+    matmuls, and serving stays exactly as deterministic as the tree
+    it was given."""
+
+    def _engines(self, weight_dtype):
+        cfg = tiny_cfg()
+        params = _tiny_params(cfg)
+        kw = dict(
+            max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=32,
+        )
+        f32 = InferenceEngine(
+            cfg, params, cfg=ServeConfig(**kw),
+            registry=MetricsRegistry(),
+        )
+        quant = InferenceEngine(
+            cfg, params,
+            cfg=ServeConfig(weight_dtype=weight_dtype, **kw),
+            registry=MetricsRegistry(),
+        )
+        return f32, quant
+
+    @pytest.mark.timeout(300)
+    def test_batcher_golden_bounded_divergence_vs_f32(self):
+        """THE quantized acceptance: int8-weight serving through the
+        continuous batcher is (a) token-identical to its OWN unbatched
+        reference — batching never changes numerics, quantized or not
+        — and (b) first-token-exact with >= 75% stream agreement
+        against the f32 engine, with zero post-warmup recompiles and
+        HBM param bytes <= 0.35x f32 (engine.byte_breakdown)."""
+        f32, quant = self._engines("int8")
+        assert quant.quantized_weights and not f32.quantized_weights
+        bb_q, bb_f = quant.byte_breakdown(), f32.byte_breakdown()
+        assert bb_q["weight_bits"] == 8
+        assert bb_q["params_bytes"] <= 0.35 * bb_f["params_bytes"], (
+            f"{bb_q['params_bytes']} vs f32 {bb_f['params_bytes']}"
+        )
+        quant.warmup()
+        reqs = _mixed_requests(10, quant.model_cfg)
+        batcher = ContinuousBatcher(quant).start()
+        try:
+            results = [
+                f.result(timeout=120)
+                for f in [batcher.submit(r) for r in reqs]
+            ]
+        finally:
+            batcher.close(drain=True)
+        first_exact = 0
+        for req, res in zip(reqs, results):
+            own_ref = quant.reference_generate(
+                req.prompt, max_new=req.max_new_tokens, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+            )
+            assert res.tokens == own_ref, (
+                "quantized batching must stay token-identical to the "
+                "quantized reference"
+            )
+            f32_ref = f32.reference_generate(
+                req.prompt, max_new=req.max_new_tokens, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+            )
+            first_exact += res.tokens[0] == f32_ref[0]
+            agree = sum(a == b for a, b in zip(res.tokens, f32_ref))
+            assert agree >= 0.75 * len(f32_ref), (
+                f"int8 weights diverged beyond bound: {res.tokens} vs "
+                f"{f32_ref}"
+            )
+        assert first_exact == len(reqs), "first tokens must be exact"
+        assert quant.post_warmup_recompiles() == 0
+
+    @pytest.mark.timeout(180)
+    def test_fp8_weights_bounded_divergence(self):
+        from tensorflow_examples_tpu.core import precision
+
+        if not precision.fp8_supported():
+            pytest.skip("no working float8_e4m3fn on this build")
+        f32, quant = self._engines("fp8")
+        assert quant.byte_breakdown()["weight_bits"] == 8
+        quant.warmup()
+        rng = np.random.default_rng(3)
+        for i in range(3):
+            prompt = [int(t) for t in rng.integers(0, 200, 4 + 9 * i)]
+            got = quant.reference_generate(prompt, max_new=6, seed=i)
+            ref = f32.reference_generate(prompt, max_new=6, seed=i)
+            assert got[0] == ref[0]
+            agree = sum(a == b for a, b in zip(got, ref))
+            assert agree >= 0.75 * len(ref)
+        assert quant.post_warmup_recompiles() == 0
+
+    @pytest.mark.timeout(180)
+    def test_quantized_paged_prefix_and_spec_compose(self):
+        """The registry composes with the rest of the serving stack:
+        paged pool + prefix cache + speculation, all on, quantized
+        tree — batched streams still token-identical to the quantized
+        reference, zero recompiles."""
+        cfg = tiny_cfg()
+        eng = InferenceEngine(
+            cfg, _tiny_params(cfg),
+            cfg=ServeConfig(
+                max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=32,
+                weight_dtype="int8", kv_block_size=8, kv_dtype="int8",
+                spec_decode_k=3,
+            ),
+            registry=MetricsRegistry(),
+        )
+        eng.warmup()
+        reqs = _mixed_requests(6, cfg)
+        batcher = ContinuousBatcher(eng).start()
+        try:
+            results = [
+                f.result(timeout=120)
+                for f in [batcher.submit(r) for r in reqs]
+            ]
+        finally:
+            batcher.close(drain=True)
+        for req, res in zip(reqs, results):
+            ref = eng.reference_generate(
+                req.prompt, max_new=req.max_new_tokens, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+            )
+            assert res.tokens == ref
+        assert eng.post_warmup_recompiles() == 0
+
+    def test_cast_only_precision_config_applies(self):
+        """A registry with cast rules and no quantization still runs
+        at load time: precision=PrecisionConfig(default='bf16') serves
+        bf16 leaves, never a silently-f32 tree."""
+        import jax.numpy as jnp
+
+        from tensorflow_examples_tpu.core.precision import PrecisionConfig
+
+        cfg = tiny_cfg()
+        eng = InferenceEngine(
+            cfg, _tiny_params(cfg),
+            cfg=ServeConfig(
+                max_slots=2, prefill_bucket_floor=16, kv_bucket_floor=32,
+            ),
+            registry=MetricsRegistry(),
+            precision=PrecisionConfig(default="bf16"),
+        )
+        assert eng.params["wte"]["embedding"].dtype == jnp.bfloat16
+        assert eng.params["h_0"]["ln_1"]["scale"].dtype == jnp.bfloat16
+        assert not eng.quantized_weights
+
+    def test_v11_keys_stamped_only_when_quantized(self):
+        """The schema-v11 serving keys ride the stats line exactly when
+        the engine serves quantized weights (optional-on-write, like
+        every bump); the line validates either way."""
+        _, quant = self._engines("int8")
+        quant.warmup()
+        b = ContinuousBatcher(quant).start()
+        try:
+            line = b.stats_line()
+        finally:
+            b.close(drain=True)
+        assert schema.validate_line(line) == []
+        assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION
+        for key in schema.SERVING_KEYS_V11:
+            assert key in line["serving"], key
+        assert line["serving"]["weight_bits"] == 8
+        assert line["serving"]["quantized_params"] > 0
+        assert (
+            line["serving"]["param_bytes"]
+            < line["serving"]["param_bytes_f32"]
+        )
+
+    def test_v11_keys_absent_on_unquantized_line(self, warm_engine):
+        b = ContinuousBatcher(warm_engine).start()
+        try:
+            line = b.stats_line()
+        finally:
+            b.close(drain=True)
+        assert schema.validate_line(line) == []
+        for key in schema.SERVING_KEYS_V11:
+            assert key not in line["serving"], key
+
+    def test_v11_keys_flagged_on_older_versions(self):
+        """Mislabeling rule: a v10 line carrying a v11 key is flagged,
+        like every earlier bump."""
+        _, quant = self._engines("int8")
+        quant.warmup()
+        b = ContinuousBatcher(quant).start()
+        try:
+            line = b.stats_line()
+        finally:
+            b.close(drain=True)
+        line["schema_version"] = 10
+        problems = schema.validate_line(line)
+        assert any("v11 serving key" in p for p in problems)
+
+
 # ------------------------------------------------------------ SIGTERM drain
 
 

@@ -640,6 +640,218 @@ class TestShardedServing:
         assert drive(a) == drive(b)
 
 
+# ------------------------------------------- quantized x sharded (ISSUE 15)
+
+
+@pytest.mark.serving
+class TestQuantizedShardedServing:
+    """The precision registry composes with the ShardingConfig: the
+    quantized payload shards by the weight's rule, its per-row scale
+    inherits the weight's spec (rank-clipped), and a 2x2-mesh int8
+    GPT-2 serves with the same divergence contract as an unsharded
+    one — at <= 0.35x the f32 sharded baseline's per-device bytes."""
+
+    def _engine(self, *, weight_dtype, mesh={"data": 2, "model": 2}):
+        import jax
+
+        from tensorflow_examples_tpu.serving.engine import (
+            InferenceEngine,
+            ServeConfig,
+        )
+
+        mcfg = transformer.TransformerConfig(
+            vocab_size=211, max_len=64, num_layers=2, num_heads=2,
+            d_model=32, dropout=0.0, attention="xla",
+        )
+        model = transformer.Transformer(mcfg)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0)},
+            np.zeros((1, 8), np.int32),
+        )["params"]
+        return InferenceEngine(
+            mcfg, params,
+            cfg=ServeConfig(
+                max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=32,
+                weight_dtype=weight_dtype,
+            ),
+            sharding=gpt2_sharding(mesh),
+        )
+
+    def test_clip_is_scale_only_bad_rules_still_fail_loudly(self):
+        """Rank clipping exists FOR quantization scales; an over-ranked
+        spec on any other leaf must keep failing at placement — a
+        typo'd rules table must not silently re-place a bias."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+        from tensorflow_examples_tpu.core.sharding import (
+            ShardingRules,
+            shardings_for_params,
+        )
+
+        mesh = create_mesh(MeshConfig(data=4, model=2))
+        tree = {"mlp_fc": {"bias": np.zeros((8,), np.float32)}}
+        rules = ShardingRules([(r"bias", P("data", "model"))])
+        sh = shardings_for_params(tree, mesh, rules)
+        with pytest.raises(ValueError):
+            jax.device_put(tree, sh)
+        # LayerNorm params are also literally named 'scale' — the clip
+        # keys on the QuantizedWeight child's key TYPE, so a bad rule
+        # on ln scale keeps the loud failure too.
+        ln = {"ln_1": {"scale": np.ones((8,), np.float32)}}
+        ln_rules = ShardingRules([(r"ln_1/scale", P("data", "model"))])
+        with pytest.raises(ValueError):
+            jax.device_put(
+                ln, shardings_for_params(ln, mesh, ln_rules)
+            )
+
+    def test_anchored_rules_still_match_quantized_leaves(self):
+        """Quantization extends leaf paths (.../kernel -> .../kernel/q
+        + /scale); rules resolve against the WEIGHT's path, so an
+        ANCHORED pattern like 'kernel$' keeps sharding a quantized
+        weight instead of silently replicating it."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflow_examples_tpu.core.mesh import MeshConfig, create_mesh
+        from tensorflow_examples_tpu.core.precision import (
+            PrecisionConfig,
+            quantize_tree,
+        )
+        from tensorflow_examples_tpu.core.sharding import (
+            ShardingRules,
+            shardings_for_params,
+        )
+
+        mesh = create_mesh(MeshConfig(data=4, model=2))
+        tree = quantize_tree(
+            {"mlp_fc": {"kernel": np.ones((8, 16), np.float32)}},
+            PrecisionConfig.weight_only("int8"),
+        )
+        rules = ShardingRules([(r"mlp_fc/kernel$", P(None, "model"))])
+        placed = jax.device_put(
+            tree, shardings_for_params(tree, mesh, rules)
+        )
+        qw = placed["mlp_fc"]["kernel"]
+        assert "model" in str(qw.q.sharding.spec), (
+            "anchored rule must still shard the quantized payload"
+        )
+        # The scale [8] clips the weight's spec to P(None): replicated
+        # here, but resolved THROUGH the weight's rule, not a no-match.
+        assert all(a is None for a in qw.scale.sharding.spec)
+
+    def test_scales_sharded_like_their_weights(self):
+        from tensorflow_examples_tpu.core.precision import QuantizedWeight
+
+        eng = self._engine(weight_dtype="int8")
+        qkv = eng.params["h_0"]["attn"]["qkv"]["kernel"]
+        assert isinstance(qkv, QuantizedWeight)
+        # The payload keeps the weight's full spec (heads over model)…
+        assert "model" in str(qkv.q.sharding.spec)
+        assert len({s.device for s in qkv.q.addressable_shards}) >= 2
+        # …and the scale [d, 3, H] carries the spec's leading dims —
+        # the head axis survives the rank clip, so the scale splits
+        # over `model` exactly where its weight does.
+        assert "model" in str(qkv.scale.sharding.spec)
+        assert len(qkv.scale.sharding.spec) == qkv.scale.ndim
+        # Replicated-by-rule leaves (embeddings) stay replicated.
+        wte = eng.params["wte"]["embedding"]
+        assert isinstance(wte, QuantizedWeight)
+        assert all(a is None for a in wte.q.sharding.spec)
+
+    @pytest.mark.timeout(300)
+    def test_golden_bytes_and_zero_recompiles(self):
+        """The satellite acceptance in one run: batcher golden
+        first-token-exact vs the f32 sharded twin with bounded stream
+        divergence, zero post-warmup recompiles, and per-device param
+        bytes <= 0.35x the f32 sharded baseline via
+        byte_breakdown(per_device=True)."""
+        from tensorflow_examples_tpu.serving.batcher import (
+            ContinuousBatcher,
+            Request,
+        )
+
+        f32 = self._engine(weight_dtype="")
+        quant = self._engine(weight_dtype="int8")
+        bb_q = quant.byte_breakdown(per_device=True)
+        bb_f = f32.byte_breakdown(per_device=True)
+        assert bb_q["params_bytes"] <= 0.35 * bb_f["params_bytes"]
+        # The per-device view reports only per-device-meaningful
+        # fields — no silently-global numbers to mis-ratio against.
+        assert "params_bytes_f32" not in bb_q
+        assert "kv_cache_bytes" not in bb_q
+        for eng in (f32, quant):
+            eng.warmup()
+        reqs = [
+            Request(prompt=[7], max_new_tokens=5, seed=3),
+            Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=6, seed=11,
+                    temperature=0.9, top_k=13),
+            Request(prompt=list(range(1, 20)), max_new_tokens=4, seed=5),
+            Request(prompt=list(range(40, 2, -1)), max_new_tokens=5,
+                    seed=8),
+        ]
+        batcher = ContinuousBatcher(quant).start()
+        try:
+            futures = [batcher.submit(r) for r in reqs]
+            got = [f.result(timeout=120).tokens for f in futures]
+        finally:
+            batcher.close()
+        for r, tokens in zip(reqs, got):
+            own = quant.reference_generate(
+                r.prompt, max_new=r.max_new_tokens, seed=r.seed,
+                temperature=r.temperature, top_k=r.top_k,
+            )
+            assert tokens == own, "batched != quantized reference"
+            ref = f32.reference_generate(
+                r.prompt, max_new=r.max_new_tokens, seed=r.seed,
+                temperature=r.temperature, top_k=r.top_k,
+            )
+            assert tokens[0] == ref[0], "first token must be exact"
+            agree = sum(a == b for a, b in zip(tokens, ref))
+            assert agree >= 0.75 * len(ref), (tokens, ref)
+        assert quant.post_warmup_recompiles() == 0
+
+    def test_sharded_quantized_matches_replicated_quantized(self):
+        """Quantization happens on the host BEFORE placement, so the
+        sharded tree holds the same values — placement still never
+        changes tokens, quantized or not."""
+        from tensorflow_examples_tpu.serving.engine import (
+            InferenceEngine,
+            ServeConfig,
+        )
+
+        sharded = self._engine(weight_dtype="int8")
+        mcfg = sharded.model_cfg
+        import jax
+
+        model = transformer.Transformer(mcfg)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0)},
+            np.zeros((1, 8), np.int32),
+        )["params"]
+        replicated = InferenceEngine(
+            mcfg, params,
+            cfg=ServeConfig(
+                max_slots=4, prefill_bucket_floor=16, kv_bucket_floor=32,
+                weight_dtype="int8",
+            ),
+        )
+        for eng in (sharded, replicated):
+            eng.warmup()
+
+        def drive(eng):
+            slot = eng.pool.alloc()
+            tok, _ = eng.prefill(slot, [5, 4, 3], seed=2)
+            out = [tok]
+            for _ in range(4):
+                out.append(eng.decode([(slot, out[-1], 2, 0.0, 0)])[slot])
+            eng.pool.free(slot)
+            return out
+
+        assert drive(sharded) == drive(replicated)
+
+
 # ------------------------------------------------------------- schema v5
 
 

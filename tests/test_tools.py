@@ -1286,6 +1286,71 @@ class TestBenchGate:
         assert ranked["prefix_hit_rate_affinity"] == "regressed"
         assert doc["prefix_hit_rate_affinity"] == 0.2
 
+    def test_quant_keys_stamp_and_gate(self, tmp_path, capsys):
+        """ISSUE 15 satellite: the serve_quant record's
+        tpot_speedup_quant gates as a stamped MINIMUM and
+        hbm_bytes_per_replica as a MAXIMUM — a dequant-path regression
+        that eats the speedup, or a registry change that quietly grows
+        the per-replica footprint, fails CI like any other perf loss."""
+        rec = {
+            "bench": "serve_quant",
+            "tpot_speedup_quant": 1.03,
+            "hbm_bytes_per_replica": 41132,
+        }
+        good = tmp_path / "quant.json"
+        good.write_text(json.dumps(rec))
+        floors = tmp_path / "quant_floors.json"
+        assert self._gate(
+            ["--stamp", str(good), "--floors", str(floors)]
+        ) == 0
+        with open(floors) as f:
+            stamped = json.load(f)
+        assert stamped["tpot_speedup_quant"] == {"min": 1.03}
+        assert stamped["hbm_bytes_per_replica"] == {"max": 41132}
+        assert self._gate(
+            ["--record", str(good), "--floors", str(floors)]
+        ) == 0
+        slow = tmp_path / "quant_slow.json"
+        slow.write_text(json.dumps(dict(rec, tpot_speedup_quant=0.4)))
+        assert self._gate(
+            ["--record", str(slow), "--floors", str(floors)]
+        ) == 1
+        assert "[FAIL] tpot_speedup_quant" in capsys.readouterr().out
+        fat = tmp_path / "quant_fat.json"
+        fat.write_text(
+            json.dumps(dict(rec, hbm_bytes_per_replica=9 * 41132))
+        )
+        assert self._gate(
+            ["--record", str(fat), "--floors", str(floors)]
+        ) == 1
+        assert "[FAIL] hbm_bytes_per_replica" in capsys.readouterr().out
+
+    def test_quant_keys_ranked_by_run_diff(self, tmp_path):
+        """ISSUE 15 satellite: the quant keys land in run_diff's
+        DIFF_KEYS/GATE_KEYS — a quant regression ranks and the
+        candidate's values flatten for bench_gate --record."""
+        import run_diff
+
+        a = {"bench": "serve_quant", "tpot_speedup_quant": 1.1,
+             "hbm_bytes_per_replica": 41132, "stream_agreement": 1.0}
+        b = {"bench": "serve_quant", "tpot_speedup_quant": 0.6,
+             "hbm_bytes_per_replica": 41132, "stream_agreement": 0.8}
+        a_path, b_path = tmp_path / "qa.json", tmp_path / "qb.json"
+        a_path.write_text(json.dumps(a))
+        b_path.write_text(json.dumps(b))
+        out = tmp_path / "qdiff.json"
+        rc = run_diff.main(
+            [str(a_path), str(b_path), "--json", str(out)]
+        )
+        assert rc == 0
+        with open(out) as f:
+            doc = json.load(f)
+        ranked = {d["metric"]: d["verdict"] for d in doc["ranked"]}
+        assert ranked["tpot_speedup_quant"] == "regressed"
+        assert ranked["stream_agreement"] == "regressed"
+        assert doc["tpot_speedup_quant"] == 0.6
+        assert doc["hbm_bytes_per_replica"] == 41132
+
     def test_floorless_report_lists_unbanked_gate_keys(
         self, tmp_path, capsys
     ):
@@ -1303,7 +1368,11 @@ class TestBenchGate:
                     # ISSUE 13: the overload/traffic keys stay on the
                     # harvest list until a TPU floor is stamped.
                     "ttft_p95_interactive_ms", "ttft_p95_batch_ms",
-                    "shed_rate_interactive", "scale_up_latency_s"):
+                    "shed_rate_interactive", "scale_up_latency_s",
+                    # ISSUE 15: the quantization pair joins it (the
+                    # CPU CI ratio is dispatch-bound ~1.0; the
+                    # memory-bound floor needs the HBM rig).
+                    "tpot_speedup_quant", "hbm_bytes_per_replica"):
             assert f"[WARN] gate key '{key}'" in out, key
         # A stamped floor removes its key from the report.
         floors = tmp_path / "floors.json"
@@ -1543,6 +1612,51 @@ class TestServeBench:
         # Prompt-like traffic through the n-gram drafter must actually
         # accept drafts — otherwise the A/B measured nothing.
         assert rec["draft_hit_rate"] > 0.25
+
+    @pytest.mark.timeout(300)
+    def test_weight_dtype_smoke_banks_quant_record(self, tmp_path):
+        """ISSUE 15 CI satellite: ``--smoke --weight-dtype int8``
+        drives the SAME prompts through an f32 engine and a
+        weight-quantized one, banks a ``serve_quant`` record with the
+        measured HBM ratio (<= 0.35x — the ~4x claim), the
+        first-token-exact + bounded-divergence verdict, and zero
+        post-warmup recompiles across both engines."""
+        import serve_bench
+
+        out = tmp_path / "quant_record.json"
+        rc = serve_bench.main([
+            "--smoke", "--weight-dtype", "int8", "--requests", "10",
+            "--out", str(out),
+        ])
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        assert rec["bench"] == "serve_quant"
+        assert rec["weight_dtype"] == "int8" and rec["weight_bits"] == 8
+        assert rec["errors"] == 0 and rec["ok"] is True
+        assert rec["first_token_exact"] is True
+        assert rec["stream_agreement"] >= serve_bench.QUANT_AGREEMENT_FLOOR
+        assert rec["verify_ok"] is True
+        assert rec["post_warmup_recompiles"] == 0
+        assert rec["hbm_bytes_per_replica"] <= (
+            0.35 * rec["hbm_bytes_per_replica_f32"]
+        )
+        assert rec["hbm_ratio_vs_f32"] <= 0.35
+        assert rec["tpot_speedup_quant"] is not None
+        assert rec["tpot_speedup_quant"] > 0
+
+    def test_bench_modes_are_mutually_exclusive(self, capsys):
+        """Each mode banks its own record; combining two must be a
+        loud usage error, never a silently-one-mode run."""
+        import serve_bench
+
+        with pytest.raises(SystemExit) as e:
+            serve_bench.main(
+                ["--smoke", "--weight-dtype", "int8",
+                 "--spec-decode", "3"]
+            )
+        assert e.value.code == 2
+        assert "don't compose" in capsys.readouterr().err
 
     @pytest.mark.timeout(300)
     def test_router_smoke_two_paged_replicas(self, tmp_path):

@@ -111,6 +111,14 @@ RECORD_KEYS: dict[str, str] = {
     "ttft_p95_batch_ms": "max",
     "shed_rate_interactive": "max",
     "scale_up_latency_s": "max",
+    # Weight quantization (ISSUE 15): serve_bench --weight-dtype banks
+    # the serve_quant A/B record — the f32/quant TPOT ratio pinned as
+    # a minimum (a dequant-path regression that quietly eats the
+    # memory-bound speedup fails CI) and HBM param bytes per replica
+    # as a maximum (the ~4x replicas-per-host claim, measured via
+    # engine.byte_breakdown).
+    "tpot_speedup_quant": "min",
+    "hbm_bytes_per_replica": "max",
 }
 
 

@@ -91,6 +91,10 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("shed_rate_batch", "lower", "", 1.0),
     ("scale_up_latency_s", "lower", "s", 1.0),
     ("p95_during_resize_ms", "lower", "ms", 1.0),
+    # ---- weight quantization records (ISSUE 15) ----
+    ("tpot_speedup_quant", "higher", "x", 1.0),
+    ("hbm_bytes_per_replica", "lower", "MiB", 1.0 / 2**20),
+    ("stream_agreement", "higher", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -123,6 +127,9 @@ GATE_KEYS = (
     "ttft_p95_batch_ms",
     "shed_rate_interactive",
     "scale_up_latency_s",
+    # weight-quantization gate keys (ISSUE 15)
+    "tpot_speedup_quant",
+    "hbm_bytes_per_replica",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

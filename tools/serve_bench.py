@@ -80,6 +80,19 @@ Modes:
   post-warmup recompiles across both engines. ``bench_gate`` gates
   ``tpot_speedup`` as a stamped minimum.
 
+* ``--weight-dtype {int8,fp8}`` (ISSUE 15) — weight-quantization A/B:
+  the SAME mixed-length prompts through an f32 engine and one whose
+  weights the precision registry quantized at load time, banking a
+  ``serve_quant`` record: ``hbm_bytes_per_replica`` +
+  ``hbm_ratio_vs_f32`` (the ~4x HBM claim, via
+  ``engine.byte_breakdown``), ``tpot_speedup_quant`` /
+  ``ttft_speedup_quant``, and the bounded-divergence verdict int8 KV
+  established — ``first_token_exact`` over every request plus
+  ``stream_agreement`` >= ``QUANT_AGREEMENT_FLOOR``, zero post-warmup
+  recompiles on both engines. ``--smoke --weight-dtype int8`` is the
+  tier-1 quantization smoke; ``bench_gate`` gates
+  ``tpot_speedup_quant`` (min) and ``hbm_bytes_per_replica`` (max).
+
 * ``--traffic {ramp,flash,diurnal}`` (ISSUE 13) — the replayable
   open-loop traffic model: seeded exponential arrivals at a per-mode
   rate profile, heavy-tail prompt lengths, a seeded interactive/batch
@@ -1224,6 +1237,178 @@ def run_spec_bench(args) -> dict:
     return rec
 
 
+# Divergence floor for the serve_quant verdict: mean fraction of
+# stream positions agreeing with the f32 twin — the same gate shape
+# the int8 KV golden uses (first token exact, bounded divergence).
+QUANT_AGREEMENT_FLOOR = 0.75
+
+
+def run_quant_bench(args) -> dict:
+    """--weight-dtype D (ISSUE 15): drive the SAME mixed-length
+    prompts through two freshly built engines — weights served as
+    loaded (f32), then weight-quantized to D via the precision
+    registry — and bank one ``serve_quant`` record. The claims it
+    carries, all measured: ``hbm_bytes_per_replica`` (quantized param
+    bytes from ``engine.byte_breakdown``) with ``hbm_ratio_vs_f32``
+    (the ~4x HBM-per-replica claim, the fleet-economics headline),
+    ``tpot_speedup_quant`` / ``ttft_speedup_quant`` (f32 p50 / quant
+    p50 — decode is memory-bound, so 1-byte weights buy TPOT on HBM
+    rigs; ~1.0 where weights fit in cache), and the divergence verdict
+    int8 KV established: ``first_token_exact`` over EVERY request plus
+    ``stream_agreement`` >= QUANT_AGREEMENT_FLOOR, with zero
+    post-warmup recompiles on both engines (the quantized tree warms
+    the same AOT ladder)."""
+    import jax
+
+    from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
+    from tensorflow_examples_tpu.serving.engine import ServeConfig
+    from tensorflow_examples_tpu.serving.frontend import ServingFrontend
+    from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+
+    serve_kw = dict(
+        max_slots=args.max_slots,
+        max_delay_s=0.002,
+        request_timeout_s=args.timeout,
+        kv_block_size=max(args.kv_block_size, 0),
+        kv_dtype=args.kv_dtype,
+    )
+    if args.smoke:
+        serve_kw.update(prefill_bucket_floor=16, kv_bucket_floor=32)
+
+    def build(weight_dtype: str):
+        reg = MetricsRegistry()
+        cfg = ServeConfig(weight_dtype=weight_dtype, **serve_kw)
+        if args.workdir:
+            eng = build_checkpoint_engine(args.workdir, cfg, registry=reg)
+        else:
+            eng = build_smoke_engine(cfg, registry=reg)
+        eng.warmup()
+        return eng, reg
+
+    def phase(eng, reg, prompts):
+        batcher = ContinuousBatcher(eng, registry=reg).start()
+        frontend = ServingFrontend(batcher, port=0)  # in-proc transport
+        try:
+            outcome = drive(
+                frontend, prompts,
+                concurrency=args.concurrency,
+                max_new=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                http_url=None, timeout=args.timeout,
+            )
+        finally:
+            batcher.close(drain=True)
+            frontend.close()
+        return outcome
+
+    n = args.requests or (12 if args.smoke else 48)
+    # Both engines (and their AOT warmups) are built before the clock
+    # starts: wall_s measures request driving only.
+    f32_eng, f32_reg = build("")
+    q_eng, q_reg = build(args.weight_dtype)
+    model_cfg = f32_eng.model_cfg
+    prompts = make_prompts(
+        n, vocab=model_cfg.vocab_size, max_len=model_cfg.max_len,
+        max_new=args.max_new_tokens,
+    )
+    t0 = time.perf_counter()
+    f32_out = phase(f32_eng, f32_reg, prompts)
+    q_out = phase(q_eng, q_reg, prompts)
+    wall = time.perf_counter() - t0
+
+    def done(outcome):
+        return [
+            r for r in outcome["replies"] if r is not None and r[0] == 200
+        ]
+
+    errors = 2 * n - len(done(f32_out)) - len(done(q_out))
+    # first_token_exact is a NUMERICS verdict over the pairs that both
+    # completed — a transport error/timeout is already counted in
+    # ``errors`` (which fails ``ok`` on its own) and must not
+    # masquerade as quantization divergence.
+    first_exact = True
+    agreements = []
+    for a, b in zip(f32_out["replies"], q_out["replies"]):
+        if a is None or b is None or a[0] != 200 or b[0] != 200:
+            continue
+        ta, tb = a[1].get("tokens") or [], b[1].get("tokens") or []
+        if not ta or not tb or ta[0] != tb[0]:
+            first_exact = False
+        width = max(len(ta), len(tb))
+        if width:
+            agreements.append(
+                sum(x == y for x, y in zip(ta, tb)) / width
+            )
+    agreement = (
+        round(sum(agreements) / len(agreements), 4)
+        if agreements else 0.0
+    )
+
+    def p50_ms(reg, hist):
+        h = reg.histogram_summaries().get(f"serving/{hist}")
+        v = h and h.get("p50")
+        return round(v * 1e3, 4) if v is not None else None
+
+    def speedup(f32_v, q_v):
+        return round(f32_v / q_v, 3) if f32_v and q_v else None
+
+    def toks_per_s(outcome):
+        toks = sum(len(r[1].get("tokens", ())) for r in done(outcome))
+        return round(toks / outcome["wall_s"], 3) if outcome["wall_s"] \
+            else None
+
+    bb_q = q_eng.byte_breakdown()
+    bb_f = f32_eng.byte_breakdown()
+    tpot_f, tpot_q = p50_ms(f32_reg, "tpot"), p50_ms(q_reg, "tpot")
+    ttft_f, ttft_q = p50_ms(f32_reg, "ttft"), p50_ms(q_reg, "ttft")
+    recompiles = (
+        f32_eng.post_warmup_recompiles() + q_eng.post_warmup_recompiles()
+    )
+    rec = {
+        "bench": "serve_quant",
+        "backend": jax.default_backend(),
+        "requests": n,
+        "weight_dtype": args.weight_dtype,
+        "weight_bits": bb_q["weight_bits"],
+        "max_new_tokens": args.max_new_tokens,
+        "concurrency": args.concurrency,
+        "temperature": args.temperature,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "tpot_f32_p50_ms": tpot_f,
+        "tpot_quant_p50_ms": tpot_q,
+        "tpot_speedup_quant": speedup(tpot_f, tpot_q),
+        "ttft_f32_p50_ms": ttft_f,
+        "ttft_quant_p50_ms": ttft_q,
+        "ttft_speedup_quant": speedup(ttft_f, ttft_q),
+        "tok_per_s_f32": toks_per_s(f32_out),
+        "tok_per_s_quant": toks_per_s(q_out),
+        "hbm_bytes_per_replica": bb_q["params_bytes"],
+        "hbm_bytes_per_replica_f32": bb_f["params_bytes"],
+        "hbm_ratio_vs_f32": (
+            round(bb_q["params_bytes"] / bb_f["params_bytes"], 4)
+            if bb_f["params_bytes"] else None
+        ),
+        "first_token_exact": first_exact,
+        "stream_agreement": agreement,
+        "expected_compiles": q_eng.expected_compiles(),
+        "post_warmup_recompiles": recompiles,
+        "kv_block_size": serve_kw["kv_block_size"],
+        "kv_dtype": args.kv_dtype,
+        "verified": n,
+        "verify_ok": bool(
+            first_exact and agreement >= QUANT_AGREEMENT_FLOOR
+        ),
+        "transport": "inproc",
+    }
+    rec["ok"] = bool(
+        errors == 0
+        and rec["verify_ok"]
+        and recompiles == 0
+    )
+    return rec
+
+
 # ---------------------------------------------------------------------------
 # Replayable traffic model (ISSUE 13 tentpole (4)): "millions of
 # users" as a seeded, deterministic scenario.
@@ -1896,7 +2081,15 @@ def main(argv=None) -> int:
                     help="paged KV block size; -1 = dense pool "
                          "(--router defaults to 16)")
     ap.add_argument("--kv-dtype", default="",
-                    help="'' (cache dtype) or 'int8' (paged only)")
+                    help="'' (cache dtype), 'int8', or 'fp8' (paged "
+                         "only; fp8 needs backend float8 support)")
+    ap.add_argument("--weight-dtype", default="",
+                    choices=("", "int8", "fp8"),
+                    help="ISSUE 15: A/B the same prompts through an "
+                         "f32 engine and a weight-quantized one; "
+                         "banks the serve_quant record "
+                         "(tpot_speedup_quant, hbm_bytes_per_replica, "
+                         "first_token_exact + stream_agreement)")
     ap.add_argument("--requests", type=int, default=0,
                     help="request count (default: 20 smoke / 64 otherwise)")
     ap.add_argument("--concurrency", type=int, default=8)
@@ -1917,6 +2110,18 @@ def main(argv=None) -> int:
         ap.error("pick a target: --smoke or --workdir DIR")
     if args.affinity == "ab" and not args.router:
         ap.error("--affinity ab is a --router A/B mode")
+    modes = [name for name, on in (
+        ("--weight-dtype", bool(args.weight_dtype)),
+        ("--spec-decode", args.spec_decode > 0),
+        ("--traffic", bool(args.traffic)),
+        ("--chaos", args.chaos),
+        ("--router", args.router),
+    ) if on]
+    if len(modes) > 1:
+        # Each mode banks its own record; silently running only one
+        # would label the output as measuring something it didn't.
+        ap.error(f"pick ONE bench mode: {' + '.join(modes)} don't "
+                 "compose")
     if args.replicas <= 0:
         args.replicas = 3 if args.chaos else 2
 
@@ -1931,6 +2136,15 @@ def main(argv=None) -> int:
 
     if args.router and args.affinity == "ab":
         rec = run_affinity_bench(args)
+        print(json.dumps(rec))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        return 0 if rec["ok"] else 1
+
+    if args.weight_dtype:
+        rec = run_quant_bench(args)
         print(json.dumps(rec))
         if args.out:
             with open(args.out, "w") as f:

@@ -37,8 +37,18 @@ from typing import Callable
 
 from tensorflow_examples_tpu.serving.batcher import ContinuousBatcher
 from tensorflow_examples_tpu.serving.frontend import ServingFrontend
-from tensorflow_examples_tpu.serving.router import Router, RouterConfig
+from tensorflow_examples_tpu.serving.journal import (
+    Lease,
+    RequestJournal,
+    StandbyMonitor,
+)
+from tensorflow_examples_tpu.serving.router import (
+    Router,
+    RouterConfig,
+    RouterFrontend,
+)
 from tensorflow_examples_tpu.serving.supervisor import Supervisor
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.utils import faults as faults_mod
 
 log = logging.getLogger(__name__)
@@ -266,3 +276,156 @@ class ChaosFleet:
             self.router.close()
         for r in self.replicas:
             r.close()
+
+
+class RouterPair:
+    """Primary + warm-standby routers over one journal + lease (ISSUE
+    16): the control plane as a unit of failure, the way
+    :class:`ChaosFleet` makes replicas one.
+
+    Both routers share ONE :class:`RequestJournal` instance (in-proc,
+    the standby's tail-follow ``refresh()`` is a no-op because the
+    primary's appends advance the shared read offset — the file is
+    still written crash-safe, and ``serve_fleet --standby`` tails the
+    same file across processes) and one metrics registry, so the
+    journal/takeover counters survive the switch and a post-takeover
+    stats line tells the whole story.
+
+    Lifecycle: ``start()`` grants the primary the lease's first
+    fencing token, replays any incomplete intents left by a previous
+    incarnation, and brings up BOTH HTTP frontends — the standby's
+    answers fenced 503s (retryable) until its monitor promotes it, so
+    a client's failover retry loop needs no coordination beyond two
+    URLs. ``kill_primary`` is registered as the ``killrouter@T``
+    verb; on promotion the kill verb re-registers onto the new active
+    router and the supervisor (if any) is re-pointed via
+    ``adopt_router``.
+    """
+
+    def __init__(
+        self,
+        urls: list,
+        *,
+        journal_path: str,
+        lease_path: str,
+        router_cfg: RouterConfig | None = None,
+        supervisor: Supervisor | None = None,
+        primary_port: int = 0,
+        standby_port: int = 0,
+        standby_interval_s: float = 0.25,
+        miss_budget_s: float = 1.5,
+        dedup_window: int = 256,
+    ):
+        self.registry = registry_mod.MetricsRegistry()
+        self.journal = RequestJournal(
+            journal_path, dedup_window=dedup_window,
+            registry=self.registry,
+        )
+        self.lease = Lease(lease_path)
+        self.supervisor = supervisor
+        self.cfg = router_cfg or RouterConfig(
+            probe_interval_s=0.1,
+            retry_budget_s=30.0,
+            max_retries=4,
+            eject_after=2,
+            eject_cooldown_s=1.0,
+        )
+        self.primary = Router(
+            list(urls), cfg=self.cfg, registry=self.registry,
+            journal=self.journal, lease=self.lease,
+        )
+        self.standby = Router(
+            list(urls), cfg=self.cfg, registry=self.registry,
+            journal=self.journal,
+        )
+        self.primary_frontend = RouterFrontend(
+            self.primary, port=primary_port
+        )
+        self.standby_frontend = RouterFrontend(
+            self.standby, port=standby_port
+        )
+        # Constructing the monitor fences the standby (token 0) — it
+        # refuses dispatch until promoted.
+        self.monitor = StandbyMonitor(
+            self.standby, lease=self.lease, journal=self.journal,
+            interval_s=standby_interval_s,
+            miss_budget_s=miss_budget_s,
+            on_promote=self._on_promote,
+        )
+        self.replayed_at_start = 0
+
+    def start(self) -> "RouterPair":
+        token = self.lease.acquire()
+        self.primary.attach_lease(self.lease, token)
+        self.journal.refresh()
+        self.primary.start()
+        # A previous incarnation may have died with accepted requests
+        # un-served — drain them before taking traffic.
+        self.replayed_at_start = self.primary.replay_incomplete()
+        self.primary_frontend.start()
+        self.standby_frontend.start()
+        self.monitor.primary_url = self.primary_frontend.url("")
+        faults_mod.register_router_kill(self.kill_primary)
+        self.monitor.start()
+        log.info(
+            "router pair live: primary %s (token %d), standby %s "
+            "(fenced), %d intent(s) replayed",
+            self.primary_frontend.url(""), token,
+            self.standby_frontend.url(""), self.replayed_at_start,
+        )
+        return self
+
+    # ------------------------------------------------------- fault verbs
+
+    def kill_primary(self) -> None:
+        """Die like a SIGKILLed router process (the ``killrouter@T``
+        verb): reset every in-flight client connection, stop the
+        probe loop — and with it the lease heartbeats the standby's
+        monitor is watching."""
+        self.primary_frontend.abort()
+        self.primary.close()
+        log.warning("router pair: PRIMARY KILLED (transport reset)")
+
+    def kill_standby(self) -> None:
+        self.standby_frontend.abort()
+        self.standby.close()
+        log.warning("router pair: standby killed (transport reset)")
+
+    def _on_promote(self, monitor: StandbyMonitor) -> None:
+        if self.supervisor is not None:
+            self.supervisor.adopt_router(self.standby)
+        # The kill verb always lands on the ACTIVE router.
+        faults_mod.register_router_kill(self.kill_standby)
+
+    # -------------------------------------------------------- inspection
+
+    @property
+    def active_router(self) -> Router:
+        return (
+            self.standby if self.monitor.promoted.is_set()
+            else self.primary
+        )
+
+    @property
+    def active_frontend(self) -> RouterFrontend:
+        return (
+            self.standby_frontend if self.monitor.promoted.is_set()
+            else self.primary_frontend
+        )
+
+    def endpoints(self) -> list:
+        """Both generate URLs, primary first — a client retries in
+        this order, and the fenced loser answers a retryable 503."""
+        return [
+            self.primary_frontend.url("/generate"),
+            self.standby_frontend.url("/generate"),
+        ]
+
+    def close(self) -> None:
+        faults_mod.register_router_kill(None)
+        self.monitor.close()
+        self.primary_frontend.close()
+        self.standby_frontend.close()
+        self.primary.close()
+        self.standby.close()
+        self.journal.close()

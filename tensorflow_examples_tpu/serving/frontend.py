@@ -153,6 +153,13 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
     known = {
         "prompt", "text", "max_new_tokens", "temperature", "top_k",
         "seed", "eos_id", "deadline_s", "top_n", "slo",
+        # ISSUE 16: idempotency / resume markers. The ROUTER consumes
+        # these (journal dedupe, replay-and-skip) and strips them
+        # before dispatch, but a replica must also tolerate them so a
+        # client talking straight to one frontend isn't rejected —
+        # accepted and ignored here (a single replica regenerates
+        # deterministically anyway).
+        "request_id", "resume_from",
     }
     if kind == "resume":
         known |= {"pages", "first_token"}

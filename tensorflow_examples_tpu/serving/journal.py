@@ -1,0 +1,529 @@
+"""Durable request journal + router lease: the control plane's crash
+story (ISSUE 16 tentpole).
+
+PRs 9 and 12 made every DATA-plane failure an ordinary input, but the
+router that provides those guarantees held accepted requests only in
+its own memory — router death lost them, and a client whose connection
+dropped mid-generation lost the stream even though per-request
+``fold_in`` seeding makes every token bit-reproducible. This module is
+the missing durability layer, three pieces:
+
+* :class:`RequestJournal` — a crash-safe JSONL log with the PR-2 sink
+  discipline (append-only, one ``flush``+``fsync`` per line, a
+  torn-tail-tolerant reader that treats a half-written final line as
+  the crash artifact it is, schema-validated records). Three record
+  kinds per request id: ``intent`` (everything needed to replay the
+  generation token-identically — prompt ids, seed, sampling params,
+  SLO class, tenant key), ``progress`` (a committed-token offset), and
+  ``done`` (the final stream + status). ``incomplete()`` is the replay
+  worklist a restarted/promoted router drains through the fleet; the
+  in-memory dedupe window (sized, counted) is what makes a duplicated
+  ``request_id`` retry return the ORIGINAL tokens instead of burning a
+  second generation. ``refresh()`` tails the file, so a standby
+  holding its own instance converges on the primary's appends.
+* :class:`Lease` — the active-router lease file with a MONOTONIC
+  fencing token. Promotion rewrites the lease with ``token + 1``
+  (atomic ``os.replace``, never a torn read); every dispatching router
+  checks the file before serving, so a stalled-then-revived primary
+  whose token is now stale refuses its own dispatches
+  (``router/fenced_dispatch_total``) — no request is ever served by
+  two routers (the split-brain pin).
+* :class:`StandbyMonitor` — the warm-standby loop (thread
+  ``router-standby``): heartbeat-watches the lease the primary
+  refreshes from its probe loop, mirrors the primary's ``/replicas``
+  view so fleet membership survives the handover, and on a missed
+  heartbeat budget promotes its router — acquire the fenced lease,
+  start probing (state rebuilt from the first synchronous ``/health``
+  sweep), replay the journal's incomplete intents through the fleet
+  (token-identical by seeding), and stamp ``router/takeover_total`` +
+  ``router/takeover_latency_s``.
+
+``serving/chaos.RouterPair`` composes all three over an in-proc fleet;
+``tools/serve_fleet.py --standby`` wires the same machinery over
+process fleets.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+JOURNAL_VERSION = 1
+
+RECORD_KINDS = ("intent", "progress", "done")
+
+# Per-kind required fields of a journal record (the reader validates
+# every line it keeps; an invalid line is counted, never applied).
+_REQUIRED: dict[str, tuple] = {
+    "intent": ("request_id", "prompt", "max_new_tokens", "temperature",
+               "top_k", "seed", "slo", "tenant", "ts"),
+    "progress": ("request_id", "committed", "ts"),
+    "done": ("request_id", "tokens", "status", "ts"),
+}
+
+
+def validate_record(rec) -> list[str]:
+    """Problems with one journal record ([] = valid). Schema-validated
+    in the telemetry sense: kind-dispatched required fields, typed."""
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    problems = []
+    if rec.get("v") != JOURNAL_VERSION:
+        problems.append(f"journal version {rec.get('v')!r} != "
+                        f"{JOURNAL_VERSION}")
+    kind = rec.get("rec")
+    if kind not in RECORD_KINDS:
+        return problems + [f"unknown record kind {kind!r}"]
+    for key in _REQUIRED[kind]:
+        if key not in rec:
+            problems.append(f"{kind} record missing {key!r}")
+    rid = rec.get("request_id")
+    if not isinstance(rid, str) or not rid:
+        problems.append("request_id must be a non-empty string")
+    if kind == "intent":
+        prompt = rec.get("prompt")
+        if not (isinstance(prompt, list) and prompt
+                and all(isinstance(t, int) and not isinstance(t, bool)
+                        for t in prompt)):
+            problems.append("intent prompt must be non-empty token ids")
+    if kind == "progress" and not isinstance(rec.get("committed"), int):
+        problems.append("progress committed must be an int offset")
+    if kind == "done":
+        toks = rec.get("tokens")
+        if not isinstance(toks, list):
+            problems.append("done tokens must be a list")
+        if not isinstance(rec.get("status"), int):
+            problems.append("done status must be an int")
+    return problems
+
+
+class RequestJournal:
+    """Crash-safe JSONL intent/progress/done log + dedupe window.
+
+    One writer at a time (the ACTIVE router — the lease's fencing token
+    is what enforces "one"); any number of tailing readers. All mutable
+    state is lock-guarded: appends come from router dispatch threads,
+    ``refresh()`` from the standby loop, ``stats()`` from whoever asks.
+    """
+
+    def __init__(self, path: str, *, dedup_window: int = 256,
+                 registry=None):
+        self.path = path
+        self.registry = registry
+        self.dedup_window = int(dedup_window)
+        self._lock = threading.Lock()
+        self._fh = None                    # guard: RequestJournal._lock (lazy append handle)
+        self._read_pos = 0                 # guard: RequestJournal._lock (tail-follow offset)
+        self._intents: dict = {}           # guard: RequestJournal._lock (request_id -> intent)
+        self._progress: dict = {}          # guard: RequestJournal._lock (request_id -> committed)
+        self._done = collections.OrderedDict()  # guard: RequestJournal._lock (dedupe window)
+        self._done_ids: set = set()        # guard: RequestJournal._lock (ALL completed ids)
+        self.appends = 0                   # guard: RequestJournal._lock
+        self.invalid_lines = 0             # guard: RequestJournal._lock
+        self.torn_tail = 0                 # guard: RequestJournal._lock
+        self.dedup_evictions = 0           # guard: RequestJournal._lock
+        self.refresh()
+
+    # -------------------------------------------------------- reading
+
+    def refresh(self) -> int:
+        """Tail the file from the last consumed offset: apply every
+        complete, valid line; a half-written FINAL line (no newline —
+        the writer died mid-append) is the torn tail the format
+        tolerates by design, left for the next refresh in case the
+        writer is merely slow. Returns records applied."""
+        applied = 0
+        with self._lock:
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(self._read_pos)
+                    chunk = f.read()
+            except FileNotFoundError:
+                return 0
+            lines = chunk.split(b"\n")
+            # A final fragment with no trailing newline is a torn tail
+            # (the writer died — or is still — mid-append): tolerated,
+            # not consumed, so a later refresh can pick it up whole.
+            tail = lines.pop()
+            if tail:
+                self.torn_tail += 1
+            self._read_pos += len(chunk) - len(tail)
+            for raw in lines:
+                if not raw.strip():
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    self.invalid_lines += 1
+                    continue
+                if validate_record(rec):
+                    self.invalid_lines += 1
+                    continue
+                self._apply_locked(rec)
+                applied += 1
+        return applied
+
+    def _apply_locked(self, rec: dict) -> None:
+        # Caller holds self._lock (graftlint lock-pass convention).
+        kind, rid = rec["rec"], rec["request_id"]
+        if kind == "intent":
+            self._intents.setdefault(rid, rec)
+        elif kind == "progress":
+            self._progress[rid] = max(
+                int(rec["committed"]), self._progress.get(rid, 0)
+            )
+        else:
+            self._done_ids.add(rid)
+            self._done[rid] = rec
+            self._done.move_to_end(rid)
+            while len(self._done) > self.dedup_window:
+                self._done.popitem(last=False)
+                self.dedup_evictions += 1
+
+    # -------------------------------------------------------- writing
+
+    def _append_locked(self, rec: dict) -> dict:
+        # Caller holds self._lock. PR-2 sink discipline: one line, one
+        # flush, one fsync — a crash tears at most the line in flight,
+        # and the reader side treats that torn tail as absent.
+        problems = validate_record(rec)
+        if problems:
+            raise ValueError(
+                f"refusing to append invalid journal record: {problems}"
+            )
+        if self._fh is None:
+            self._fh = open(self.path, "ab")
+            self._fh.seek(0, os.SEEK_END)
+        line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+        self._fh.write(line)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._read_pos = self._fh.tell()  # own appends are pre-applied
+        self._apply_locked(rec)
+        self.appends += 1
+        if self.registry is not None:
+            self.registry.counter("router/journal_appends_total").inc()
+        return rec
+
+    def append_intent(self, request_id: str, body: dict) -> dict:
+        """Journal one accepted generate request — everything replay
+        needs to reproduce the stream bit-identically (generation is a
+        pure function of (params, prompt, seed)), plus the SLO class
+        and a tenant-ready key for the multi-tenant roadmap item."""
+        rec = {
+            "rec": "intent", "v": JOURNAL_VERSION,
+            "request_id": str(request_id),
+            "prompt": [int(t) for t in body.get("prompt", [])],
+            "max_new_tokens": int(body.get("max_new_tokens", 16)),
+            "temperature": float(body.get("temperature", 0.0)),
+            "top_k": int(body.get("top_k", 0)),
+            "seed": int(body.get("seed", 0)),
+            "slo": str(body.get("slo", "interactive")),
+            "tenant": str(body.get("tenant", "default")),
+            "ts": time.time(),
+        }
+        with self._lock:
+            return self._append_locked(rec)
+
+    def append_progress(self, request_id: str, committed: int) -> dict:
+        """Journal a committed-token offset (the resume watermark)."""
+        rec = {
+            "rec": "progress", "v": JOURNAL_VERSION,
+            "request_id": str(request_id),
+            "committed": int(committed), "ts": time.time(),
+        }
+        with self._lock:
+            return self._append_locked(rec)
+
+    def append_done(self, request_id: str, tokens, status: int) -> dict:
+        """Journal a request's final stream. The done record is also
+        the dedupe window's entry: a duplicated ``request_id`` retry is
+        answered from here, not the fleet."""
+        rec = {
+            "rec": "done", "v": JOURNAL_VERSION,
+            "request_id": str(request_id),
+            "tokens": [int(t) for t in tokens],
+            "status": int(status), "ts": time.time(),
+        }
+        with self._lock:
+            return self._append_locked(rec)
+
+    # -------------------------------------------------------- queries
+
+    def has_intent(self, request_id: str) -> bool:
+        with self._lock:
+            return request_id in self._intents
+
+    def lookup(self, request_id: str) -> dict | None:
+        """The done record for ``request_id`` while it is inside the
+        dedupe window (None = never completed, or evicted)."""
+        with self._lock:
+            rec = self._done.get(request_id)
+            return dict(rec) if rec is not None else None
+
+    def committed(self, request_id: str) -> int:
+        with self._lock:
+            return self._progress.get(request_id, 0)
+
+    def incomplete(self) -> list[dict]:
+        """Intent records with no done record — the replay worklist a
+        restarted or promoted router drains through the fleet. Ordered
+        by journal position (insertion order)."""
+        with self._lock:
+            return [
+                dict(rec) for rid, rec in self._intents.items()
+                if rid not in self._done_ids
+            ]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "appends": self.appends,
+                "intents": len(self._intents),
+                "done": len(self._done_ids),
+                "incomplete": sum(
+                    1 for rid in self._intents
+                    if rid not in self._done_ids
+                ),
+                "dedup_window": self.dedup_window,
+                "dedup_entries": len(self._done),
+                "dedup_evictions": self.dedup_evictions,
+                "invalid_lines": self.invalid_lines,
+                "torn_tail": self.torn_tail,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is not None:
+            fh.close()
+
+
+class Lease:
+    """Active-router lease file with a monotonic fencing token.
+
+    The file is a single JSON object ``{"token", "owner", "ts"}``
+    written via temp-file + ``os.replace`` so readers NEVER see a torn
+    lease. ``acquire()`` bumps the token (promotion); ``heartbeat()``
+    refreshes ``ts`` only while the caller still holds the newest
+    token; ``fenced(token)`` is the dispatch-time check — true once
+    anyone acquired a newer token, at which point the stale holder must
+    refuse to serve (split-brain fencing)."""
+
+    def __init__(self, path: str, *, owner: str = "router"):
+        self.path = path
+        self.owner = owner
+        self._lock = threading.Lock()
+
+    def read(self) -> dict | None:
+        """The current lease, or None (no file yet / unreadable —
+        an unreadable lease never crashes a dispatch path)."""
+        try:
+            with open(self.path, "rb") as f:
+                rec = json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+        if not isinstance(rec, dict) or not isinstance(
+            rec.get("token"), int
+        ):
+            return None
+        return rec
+
+    def _write_locked(self, rec: dict) -> None:
+        # Caller holds self._lock. Atomic replace: a reader sees the
+        # old lease or the new one, never a torn hybrid.
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def acquire(self) -> int:
+        """Take the lease with a strictly newer fencing token (the
+        promotion verb; also the initial grant). Returns the token."""
+        with self._lock:
+            cur = self.read()
+            token = (cur["token"] + 1) if cur else 1
+            self._write_locked(
+                {"token": token, "owner": self.owner, "ts": time.time()}
+            )
+        log.info("lease %s acquired by %s (fencing token %d)",
+                 self.path, self.owner, token)
+        return token
+
+    def heartbeat(self, token: int) -> bool:
+        """Refresh ``ts`` while still holding the newest token. False
+        (and NO write) once fenced — a stale heartbeat must never
+        clobber the new holder's lease."""
+        with self._lock:
+            cur = self.read()
+            if cur is None or cur["token"] != token:
+                return False
+            cur["ts"] = time.time()
+            self._write_locked(cur)
+            return True
+
+    def fenced(self, token: int) -> bool:
+        """True when a NEWER token exists: the holder of ``token`` has
+        been superseded and must refuse dispatch."""
+        cur = self.read()
+        return cur is not None and cur["token"] > int(token)
+
+    def age_s(self) -> float | None:
+        """Seconds since the holder's last heartbeat (None = no
+        lease)."""
+        cur = self.read()
+        if cur is None or not isinstance(cur.get("ts"), (int, float)):
+            return None
+        return max(0.0, time.time() - float(cur["ts"]))
+
+
+class StandbyMonitor:
+    """Warm-standby takeover loop (thread ``router-standby``).
+
+    Watches the primary's lease heartbeats and mirrors its
+    ``/replicas`` view onto the standby router; once the lease goes
+    stale past ``miss_budget_s`` the standby promotes itself:
+
+    1. ``lease.acquire()`` — the monotonic fencing token now outranks
+       the primary's, so a stalled-then-revived primary refuses its
+       own dispatches (split-brain pin);
+    2. ``router.start()`` — the first synchronous probe sweep rebuilds
+       fleet state from ``/health``;
+    3. ``router.replay_incomplete()`` — the journal's accepted-but-
+       unfinished intents replay through the fleet, token-identical by
+       seeding, so router death lost nothing;
+    4. stamp ``router/takeover_total`` and the detection-to-serving
+       wall in ``router/takeover_latency_s``.
+
+    Until promotion the standby router is dispatch-fenced (its token 0
+    is older than any granted lease), so a client hitting the standby
+    endpoint early gets a retryable 503, never a second serving path.
+    """
+
+    def __init__(self, router, *, lease: Lease,
+                 journal: RequestJournal | None = None,
+                 primary_url: str | None = None,
+                 interval_s: float = 0.25,
+                 miss_budget_s: float = 1.5,
+                 on_promote=None):
+        self.router = router
+        self.lease = lease
+        self.journal = journal
+        self.primary_url = (
+            primary_url.rstrip("/") if primary_url else None
+        )
+        self.interval_s = float(interval_s)
+        self.miss_budget_s = float(miss_budget_s)
+        self.on_promote = on_promote
+        self.promoted = threading.Event()
+        self.takeover_latency_s: float | None = None
+        self.replayed = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        router.attach_lease(lease, 0)  # fenced until promotion
+
+    # ------------------------------------------------------------ loop
+
+    def start(self) -> "StandbyMonitor":
+        self._thread = threading.Thread(
+            target=self._loop, name="router-standby", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the watchdog must survive
+                log.exception("standby poll failed")
+            if self.promoted.is_set():
+                return  # promoted: the router's own loops take over
+            self._stop.wait(self.interval_s)
+
+    def poll_once(self) -> None:
+        """One watch step (tests call it directly for determinism):
+        tail the journal, mirror fleet membership, check the
+        heartbeat, and promote when the budget is blown."""
+        if self.promoted.is_set():
+            return
+        if self.journal is not None:
+            self.journal.refresh()
+        self._mirror_replicas()
+        age = self.lease.age_s()
+        if age is not None and age > self.miss_budget_s:
+            self.promote(detected_age_s=age)
+
+    def _mirror_replicas(self) -> None:
+        """Adopt the primary's fleet membership (the autoscaler may
+        have resized it since the standby was configured). Best-effort:
+        an unreachable primary changes nothing — that is exactly the
+        heartbeat's case to detect."""
+        if self.primary_url is None:
+            return
+        from tensorflow_examples_tpu.serving.router import _get_json
+
+        status, body = _get_json(
+            self.primary_url + "/replicas", self.interval_s * 2
+        )
+        if status != 200 or not isinstance(body.get("replicas"), list):
+            return
+        want: dict = {}
+        for snap in body["replicas"]:
+            if isinstance(snap, dict) and isinstance(
+                snap.get("url"), str
+            ):
+                want[snap["url"].rstrip("/")] = snap.get("set", "base")
+        if not want:
+            return
+        have = {r.url for r in self.router.replicas}
+        for url, set_name in want.items():
+            if url not in have:
+                self.router.add_replica(url, set_name)
+        for url in have - set(want):
+            self.router.remove_replica(url)
+
+    # ------------------------------------------------------- promotion
+
+    def promote(self, detected_age_s: float = 0.0) -> None:
+        """Missed-heartbeat takeover (idempotent)."""
+        if self.promoted.is_set():
+            return
+        t0 = time.monotonic()
+        token = self.lease.acquire()
+        self.router.attach_lease(self.lease, token)
+        log.warning(
+            "STANDBY PROMOTED: primary heartbeat stale %.2fs past the "
+            "%.2fs budget — fencing token now %d",
+            detected_age_s, self.miss_budget_s, token,
+        )
+        self.router.start()  # synchronous first sweep: /health rebuild
+        if self.journal is not None:
+            self.journal.refresh()
+        self.replayed = self.router.replay_incomplete()
+        self.takeover_latency_s = time.monotonic() - t0
+        reg = self.router.registry
+        reg.counter("router/takeover_total").inc()
+        reg.gauge("router/takeover_latency_s").set(
+            self.takeover_latency_s
+        )
+        self.promoted.set()
+        log.warning(
+            "takeover complete in %.3fs (%d incomplete intent(s) "
+            "replayed)", self.takeover_latency_s, self.replayed,
+        )
+        if self.on_promote is not None:
+            self.on_promote(self)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -102,10 +102,12 @@ import http.server
 import json
 import logging
 import queue
+import socket
 import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry import schema
@@ -113,6 +115,7 @@ from tensorflow_examples_tpu.telemetry.serve import (
     json_safe,
     render_prometheus,
 )
+from tensorflow_examples_tpu.utils import faults as faults_mod
 
 log = logging.getLogger(__name__)
 
@@ -403,6 +406,9 @@ class Router:
         canary: list[str] | None = None,
         cfg: RouterConfig | None = None,
         registry=None,
+        journal=None,
+        lease=None,
+        fencing_token: int = 0,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica URL")
@@ -424,6 +430,25 @@ class Router:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._start_unix = time.time()
+        # Control-plane durability (ISSUE 16): the request journal and
+        # the active-router lease. Both optional — a journal-less
+        # router still strips the client's request_id/resume_from
+        # control fields (replicas reject unknown fields) and serves
+        # resume by replay-and-skip; it just cannot dedupe or replay
+        # across its own death.
+        self.journal = journal
+        if journal is not None and journal.registry is None:
+            journal.registry = self.registry
+        self._lease = lease
+        self._fencing_token = int(fencing_token)
+
+    def attach_lease(self, lease, token: int) -> None:
+        """(Re)bind this router to the active-router lease at fencing
+        ``token``. Dispatch refuses once the lease holds a NEWER token
+        (a promoted standby fenced this router out); the probe loop
+        heartbeats the lease while the token is still the newest."""
+        self._lease = lease
+        self._fencing_token = int(token)
 
     # ------------------------------------------------------------ probes
 
@@ -528,12 +553,35 @@ class Router:
         while not self._stop.is_set():
             try:
                 self.probe_once()
+                self._heartbeat()
             except Exception:  # noqa: BLE001 — the probe must survive
                 log.exception("replica probe sweep failed")
             self._stop.wait(self.cfg.probe_interval_s)
 
+    def _heartbeat(self) -> None:
+        """Refresh the active-router lease (ISSUE 16). Rides the probe
+        cadence: a router whose probe loop stalls (or whose process
+        dies) stops heartbeating, which is precisely the signal the
+        warm standby promotes on. A fenced heartbeat is a no-op write-
+        wise (the lease refuses it), so a stalled-then-revived primary
+        can never clobber its successor's lease."""
+        if self._lease is not None and self._fencing_token > 0:
+            self._lease.heartbeat(self._fencing_token)
+
+    def fenced(self) -> bool:
+        """True when the lease holds a NEWER fencing token than ours:
+        a standby promoted itself over this router, and every dispatch
+        here must be refused (split-brain pin — no request is ever
+        served by two routers). Also true for a never-promoted standby
+        (token 0 vs any granted lease): passivity and fencing are the
+        same check."""
+        if self._lease is None:
+            return False
+        return self._lease.fenced(self._fencing_token)
+
     def start(self) -> "Router":
         self.probe_once()  # synchronous first sweep: never dispatch blind
+        self._heartbeat()
         self._thread = threading.Thread(
             target=self._probe_loop, name="router-probe", daemon=True
         )
@@ -1107,10 +1155,82 @@ class Router:
         disaggregated roles, generate requests route through the
         prefill->decode handoff first (canary split and hedging apply
         to the full path only), falling back to the full path whenever
-        a leg cannot complete."""
+        a leg cannot complete.
+
+        ISSUE 16 control plane: generate bodies may carry the client
+        fields ``request_id`` (idempotency key) and ``resume_from`` (a
+        committed-token offset) — both stripped before dispatch
+        (replica frontends reject unknown fields). With a journal
+        attached, a duplicated ``request_id`` inside the dedupe window
+        returns the ORIGINAL tokens (``router/dedup_hits_total``, no
+        second generation); every accepted token-id request appends an
+        intent record before dispatch and a progress+done record on
+        completion; ``resume_from > 0`` answers with the remainder of
+        the SAME stream (journal dedupe hit, or replay-and-skip — the
+        re-dispatch is token-identical by seeding, so slicing off the
+        committed prefix IS the original stream's tail). A router
+        whose lease is fenced (a promoted standby holds a newer token)
+        refuses every dispatch with a retryable 503."""
         reg = self.registry
         reg.counter("router/requests_total").inc()
         t0 = time.monotonic()
+        request_id: str | None = None
+        resume_from = 0
+        if kind == "generate" and (
+            "request_id" in body or "resume_from" in body
+        ):
+            body = dict(body)  # never mutate the caller's dict
+            request_id = body.pop("request_id", None)
+            resume_from = body.pop("resume_from", 0)
+            if request_id is not None and (
+                not isinstance(request_id, str) or not request_id
+            ):
+                return 400, {
+                    "error": "'request_id' must be a non-empty string"
+                }
+            if (
+                isinstance(resume_from, bool)
+                or not isinstance(resume_from, int)
+                or resume_from < 0
+            ):
+                return 400, {
+                    "error": "'resume_from' must be a non-negative "
+                             "committed-token offset"
+                }
+        if self.fenced():
+            # Split-brain pin (ISSUE 16): a stalled-then-revived
+            # primary must never dispatch against the fleet a promoted
+            # standby now owns. Retryable — the client's next attempt
+            # lands on the active router.
+            reg.counter("router/fenced_dispatch_total").inc()
+            reply = {
+                "error": "router fenced: a newer lease token is "
+                         "active (standby takeover)",
+                "fenced": True, "retry": True, "shed": True,
+            }
+            reg.histogram("router/e2e").record(time.monotonic() - t0)
+            return 503, reply
+        journal = self.journal if kind == "generate" else None
+        if journal is not None and request_id is not None:
+            hit = journal.lookup(request_id)
+            if hit is not None:
+                # Idempotency-key dedupe: the original stream answers
+                # the retry — no second generation burned.
+                reg.counter("router/dedup_hits_total").inc()
+                tokens = list(hit["tokens"])
+                reply = {
+                    "tokens": tokens[resume_from:],
+                    "request_id": request_id,
+                    "dedup": True,
+                }
+                if resume_from:
+                    reg.counter("router/resumed_streams_total").inc()
+                    reply["resumed"] = True
+                    reply["resume_from"] = resume_from
+                reg.histogram("router/e2e").record(
+                    time.monotonic() - t0
+                )
+                return 200, reply
         if self.fleet_down():
             # Fast-fail (ISSUE 13 satellite): a fleet-wide outage
             # sheds NOW — no per-request retry-budget burn, no backoff
@@ -1126,6 +1246,63 @@ class Router:
             reg.histogram("router/e2e").record(time.monotonic() - t0)
             return 503, reply
         prompt = self._clean_prompt(body)
+        if journal is not None and prompt is None:
+            # A 'text' body has no token ids until a replica tokenizes
+            # it — not replayable, so not journaled (dedupe above still
+            # applied if the client keyed it).
+            journal = None
+        if journal is not None:
+            if request_id is None:
+                request_id = f"auto-{uuid.uuid4().hex[:12]}"
+            if not journal.has_intent(request_id):
+                # Accepted = journaled, BEFORE dispatch: if this router
+                # dies mid-request, the successor's replay finds the
+                # intent and finishes the stream.
+                journal.append_intent(request_id, body)
+        feng = faults_mod.serve_active()
+        if feng is not None and feng.router_dispatch():
+            # killrouter@T just hard-aborted THIS router (ISSUE 16
+            # satellite): the client's connection is already reset —
+            # leave the intent incomplete for the successor's journal
+            # replay instead of racing a dispatch against takeover.
+            return 503, {
+                "error": "router killed (injected fault)", "retry": True,
+            }
+        status, reply = self._handle_dispatch(body, kind, t0, prompt)
+        if status == 200 and journal is not None and isinstance(
+            reply.get("tokens"), list
+        ):
+            # Completion records — skipped once fenced: the successor
+            # owns the journal now, and it will (re)complete the
+            # intent itself. Duplicate done records for the same id
+            # would be harmless (identical by seeding) but one writer
+            # is one writer.
+            if not self.fenced():
+                journal.append_progress(
+                    request_id, len(reply["tokens"])
+                )
+                journal.append_done(
+                    request_id, reply["tokens"], status
+                )
+        if status == 200 and isinstance(reply.get("tokens"), list):
+            if resume_from:
+                # Replay-and-skip (reusing the PR 9 failover
+                # machinery): the re-dispatched stream is
+                # token-identical by seeding, so the reconnecting
+                # client gets the remainder of the SAME stream.
+                reg.counter("router/resumed_streams_total").inc()
+                reply["tokens"] = reply["tokens"][resume_from:]
+                reply["resumed"] = True
+                reply["resume_from"] = resume_from
+            if request_id is not None:
+                reply.setdefault("request_id", request_id)
+        return status, reply
+
+    def _handle_dispatch(self, body: dict, kind: str, t0: float,
+                         prompt) -> tuple[int, dict]:
+        """The dispatch core handle() wraps: disagg handoff first,
+        then the canary-aware bounded-retry loop."""
+        reg = self.registry
         key_cache: dict = {}  # prompt chain keys, hashed once per request
         if kind == "generate" and prompt is not None \
                 and self._disagg_ready():
@@ -1235,6 +1412,41 @@ class Router:
         )
         return status, reply
 
+    # -------------------------------------------- journal replay (ISSUE 16)
+
+    def replay_incomplete(self) -> int:
+        """Drain the journal's accepted-but-unfinished intents through
+        the fleet (the restart/takeover verb): each incomplete intent
+        re-dispatches as an ordinary generate — token-identical to
+        what the dead router would have served, because generation is
+        a pure function of (params, prompt, seed) — and its done
+        record closes the intent. Returns the number replayed."""
+        if self.journal is None:
+            return 0
+        replayed = 0
+        for intent in self.journal.incomplete():
+            body = {
+                "prompt": intent["prompt"],
+                "max_new_tokens": intent["max_new_tokens"],
+                "temperature": intent["temperature"],
+                "top_k": intent["top_k"],
+                "seed": intent["seed"],
+                "slo": intent["slo"],
+                "request_id": intent["request_id"],
+            }
+            status, _ = self.handle(body, kind="generate")
+            if status == 200:
+                replayed += 1
+                self.registry.counter(
+                    "router/journal_replayed_total"
+                ).inc()
+            else:
+                log.warning(
+                    "journal replay of %s failed with status %d",
+                    intent["request_id"], status,
+                )
+        return replayed
+
     # ------------------------------------------------------------ stats
 
     def canary_records(self) -> tuple[dict, dict]:
@@ -1322,6 +1534,25 @@ class Router:
                 "digest_truncated": int(
                     any(r.digest_truncated for r in probed)
                 ),
+                # --- v12 (ISSUE 16): control-plane durability — the
+                # journal's append count, warm-standby takeovers and the
+                # last takeover's detection-to-serving wall, resumed
+                # client streams, and idempotency-key dedupe hits.
+                "journal_appends": int(
+                    counters.get("router/journal_appends_total", 0)
+                ),
+                "takeover_total": int(
+                    counters.get("router/takeover_total", 0)
+                ),
+                "resumed_streams": int(
+                    counters.get("router/resumed_streams_total", 0)
+                ),
+                "dedup_hits": int(
+                    counters.get("router/dedup_hits_total", 0)
+                ),
+                "takeover_latency_s": float(
+                    gauges.get("router/takeover_latency_s", 0.0)
+                ),
             }
         return {
             "schema_version": schema.SERVING_SCHEMA_VERSION,
@@ -1383,6 +1614,26 @@ class _RouterHTTPServer(http.server.ThreadingHTTPServer):
     # reach the dispatcher (which sheds by POLICY), not bounce off the
     # stdlib's 5-entry accept backlog as transport failures (ISSUE 13).
     request_queue_size = 128
+
+    # In-flight client connections, tracked so RouterFrontend.abort()
+    # can RESET them (the killrouter fault's PR-9 semantics: the
+    # router dies like a SIGKILLed process, clients observe transport
+    # failures — never a polite 503). Normal shutdown never touches
+    # this.
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.conn_lock = threading.Lock()
+        self.live_connections: set = set()
+
+    def process_request(self, request, client_address):
+        with self.conn_lock:
+            self.live_connections.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self.conn_lock:
+            self.live_connections.discard(request)
+        super().shutdown_request(request)
 
 
 class RouterFrontend:
@@ -1536,3 +1787,30 @@ class RouterFrontend:
         httpd.server_close()
         if thread is not None and thread is not threading.current_thread():
             thread.join(timeout=5)
+
+    def abort(self) -> None:
+        """Die like a killed router process (the ``killrouter@T``
+        fault's verb, ISSUE 16 — same semantics as
+        ``ServingFrontend.abort``): stop listening AND reset every
+        in-flight client connection, so clients observe transport
+        failures, never a drained 503. Handler threads hit the dead
+        sockets on their own (ConnectionError, already swallowed);
+        nothing is joined — safe from any thread, including a handler
+        mid-dispatch."""
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            self._thread = None
+        if httpd is None:
+            return
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:  # pragma: no cover - best-effort teardown
+            pass
+        with httpd.conn_lock:
+            conns = list(httpd.live_connections)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already gone

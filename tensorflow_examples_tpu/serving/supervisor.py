@@ -173,6 +173,17 @@ class Supervisor:
         fights the restart budget."""
         return self._busy
 
+    def adopt_router(self, router: "Router") -> None:
+        """Re-point supervision at a new router (ISSUE 16: warm-standby
+        takeover). The standby rebuilt its replica view from /health
+        sweeps before promoting, so every supervised URL is expected to
+        exist there; any that don't are added so quarantine/readmit
+        keep working across the switch."""
+        for url in self.handles:
+            if router._find(url) is None:
+                router.add_replica(url)
+        self.router = router
+
     def add_handle(self, handle) -> None:
         """Supervise one more replica at runtime (the autoscaler's
         scale-up registers its freshly-green spawn here)."""

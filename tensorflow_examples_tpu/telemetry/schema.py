@@ -154,9 +154,16 @@ SCHEMA_VERSION = 5
 # versus f32). All numeric; optional on write (an unquantized line
 # carries none), FORBIDDEN on v4-v10 serving lines, same mislabeling
 # rule as every earlier bump.
-SERVING_SCHEMA_VERSION = 11
+#
+# Version 12 (ISSUE 16): additive — a control-plane-resilient serving
+# line may carry the router journal/takeover facts (journal_appends /
+# takeover_total / resumed_streams / dedup_hits — counters — and
+# takeover_latency_s, the last promotion's detect-to-serving wall
+# time). Stamped by the router only; FORBIDDEN on v4-v11 serving
+# lines, same mislabeling rule as every earlier bump.
+SERVING_SCHEMA_VERSION = 12
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
@@ -242,6 +249,17 @@ SERVING_KEYS_V10 = (
 # on v4-v10 serving lines.
 SERVING_KEYS_V11 = ("weight_bits", "param_bytes", "param_bytes_f32",
                     "quantized_params")
+
+# v12-only serving-object keys (ISSUE 16): the control-plane
+# resilience story — durable-journal appends, standby promotions and
+# the last takeover's detect-to-serving latency, client streams
+# resumed mid-generation, and idempotent-retry dedupe hits. All
+# numeric; optional on write (a journal-less router carries none),
+# FORBIDDEN on v4-v11 serving lines, same mislabeling rule as every
+# earlier bump.
+SERVING_KEYS_V12 = ("journal_appends", "takeover_total",
+                    "resumed_streams", "dedup_hits",
+                    "takeover_latency_s")
 
 # Instrument namespaces of the serving tier whose counter/gauge/
 # histogram registrations the graftlint drift pass cross-checks
@@ -544,6 +562,13 @@ def validate_line(obj: Any) -> list[str]:
                     if key in obj["serving"]:
                         problems.append(
                             f"v11 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
+            if version < 12:
+                for key in SERVING_KEYS_V12:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v12 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
     elif "serving" in obj:

@@ -239,7 +239,8 @@ class Engine:
 
 SERVE_ENV_VAR = "TPU_SERVE_FAULT_INJECT"
 
-SERVE_KINDS = ("crash", "slowrep", "transport", "kvexhaust", "badhealth")
+SERVE_KINDS = ("crash", "slowrep", "transport", "kvexhaust", "badhealth",
+               "killrouter")
 
 
 class InjectedCrash(RuntimeError):
@@ -256,16 +257,25 @@ class ServeFaultPlan:
     transport_drop: dict[int, int] = dataclasses.field(default_factory=dict)
     kvexhaust_at: dict[int, int] = dataclasses.field(default_factory=dict)
     bad_health: dict[int, int] = dataclasses.field(default_factory=dict)
+    # ISSUE 16: kill the ACTIVE router (hard-abort its frontend, PR-9
+    # abort() semantics) after this many accepted dispatches. No
+    # replica index — the fault targets whichever router is currently
+    # dispatching, which is by definition the active one.
+    kill_router_at: int | None = None
 
 
 def parse_serve_spec(spec: str) -> ServeFaultPlan:
     """Parse ``"crash@1:4,slowrep@0:0.2,transport@2:1,badhealth@0:3"``
-    (``kind@replica:arg`` tokens, comma separated)."""
+    (``kind@replica:arg`` tokens, comma separated). The one
+    router-side kind is ``killrouter@T`` — no replica index, just the
+    dispatch count T after which the active router's frontend is
+    hard-aborted."""
     crash: dict[int, int] = {}
     slow: dict[int, float] = {}
     transport: dict[int, int] = {}
     kvex: dict[int, int] = {}
     badhealth: dict[int, int] = {}
+    kill_router_at: int | None = None
     for token in filter(None, (t.strip() for t in spec.split(","))):
         kind, _, arg = token.partition("@")
         if kind not in SERVE_KINDS:
@@ -273,6 +283,15 @@ def parse_serve_spec(spec: str) -> ServeFaultPlan:
                 f"unknown serve fault kind {kind!r} "
                 f"(one of {'/'.join(SERVE_KINDS)})"
             )
+        if kind == "killrouter":
+            try:
+                kill_router_at = int(arg)
+            except ValueError:
+                raise ValueError(
+                    f"malformed serve fault token {token!r}: "
+                    "killrouter needs '@<dispatch count>'"
+                ) from None
+            continue
         head, sep, tail = arg.partition(":")
         if not head or not sep or not tail:
             raise ValueError(
@@ -297,6 +316,7 @@ def parse_serve_spec(spec: str) -> ServeFaultPlan:
     return ServeFaultPlan(
         crash_at=crash, slow_replica=slow, transport_drop=transport,
         kvexhaust_at=kvex, bad_health=badhealth,
+        kill_router_at=kill_router_at,
     )
 
 
@@ -314,6 +334,8 @@ class ServeEngine:
         self._health_left = dict(plan.bad_health)
         self._fired_crash: set[int] = set()
         self._fired_kvex: set[int] = set()
+        self._router_dispatches = 0
+        self._fired_killrouter = False
         self.fired: list[tuple[str, int, int]] = []  # (kind, replica, idx)
 
     # ------------------------------------------------------ decode hooks
@@ -396,6 +418,35 @@ class ServeEngine:
             self.fired.append(("badhealth", replica, left))
         return True
 
+    # ----------------------------------------------------- router hooks
+
+    def router_dispatch(self) -> bool:
+        """Called by ``Router.handle`` once per accepted generate
+        dispatch (after the intent is journaled). Counts dispatches
+        across whichever router is currently active; on the
+        ``kill_router_at``-th call it fires the registered router-kill
+        callback and returns True — the firing dispatch returns an
+        error without reaching the fleet, leaving its intent
+        incomplete in the journal for the successor to replay."""
+        with self._lock:
+            at = self.plan.kill_router_at
+            if at is None or self._fired_killrouter:
+                return False
+            self._router_dispatches += 1
+            n = self._router_dispatches
+            if n < at:
+                return False
+            self._fired_killrouter = True
+            self.fired.append(("killrouter", -1, n))
+            kill = _router_kill_cb
+        log.warning(
+            "SERVE FAULT: killing the active router after %d dispatches",
+            n,
+        )
+        if kill is not None:
+            kill()
+        return True
+
 
 # Crash callbacks live at module level, not on the armed engine, so a
 # replica can register its kill at build time regardless of whether the
@@ -408,6 +459,21 @@ def register_serve_crash(replica: int, kill: Callable[[], None]) -> None:
     """Register replica ``replica``'s transport-kill callable (the
     chaos harness registers ``InProcReplica.kill`` at every start)."""
     _serve_crash_cbs[replica] = kill
+
+
+# The router-kill callback for killrouter@T. Like the replica crash
+# callbacks it lives at module level: the chaos harness registers the
+# ACTIVE router's hard-abort (frontend abort + router close) and
+# re-registers on takeover, so the fault always lands on whichever
+# router currently holds the lease.
+_router_kill_cb: Callable[[], None] | None = None
+
+
+def register_router_kill(kill: Callable[[], None] | None) -> None:
+    """Register (or clear, with None) the active router's hard-abort
+    callable for ``killrouter@T``."""
+    global _router_kill_cb
+    _router_kill_cb = kill
 
 
 _serve_engine: ServeEngine | None = None

@@ -151,6 +151,7 @@ _LOCKORDER_MODULES = (
     "test_chaos.py",
     "test_router.py",
     "test_overload.py",
+    "test_journal.py",
 )
 _THREAD_GUARD_MODULES = _LOCKORDER_MODULES + ("test_serving.py",)
 
@@ -164,6 +165,7 @@ _OWNED_THREAD_NAMES = (
     "serving-frontend",
     "router-probe",
     "router-frontend",
+    "router-standby",
     "replica-supervisor",
     "fleet-autoscaler",
     "telemetry-metrics-server",

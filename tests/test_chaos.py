@@ -26,7 +26,7 @@ import numpy as np
 import pytest
 
 from tensorflow_examples_tpu.serving import kv_cache
-from tensorflow_examples_tpu.serving.chaos import ChaosFleet
+from tensorflow_examples_tpu.serving.chaos import ChaosFleet, RouterPair
 from tensorflow_examples_tpu.serving.engine import ServeConfig
 from tensorflow_examples_tpu.serving.router import (
     Router,
@@ -785,4 +785,271 @@ class TestChaosGolden:
             ) > handoffs_before
         finally:
             rfront.close()
+            fleet.close()
+
+
+# ------------------------------------- ISSUE 16: the control plane dies
+
+
+class TestRouterPairFake:
+    """Takeover mechanics over device-free fake replicas: the full
+    RouterPair choreography (journal, lease, killrouter, promotion,
+    client failover, dedupe, split-brain fence) at O(ms) per request.
+    The real-engine version with token-identity is TestTakeoverGolden."""
+
+    @pytest.mark.timeout(120)
+    def test_killrouter_takeover_zero_lost_requests(
+        self, serve_faults, tmp_path
+    ):
+        import serve_bench
+
+        fault_engine = serve_faults("killrouter@3")
+        fleet = _fake_fleet(2)
+        pair = RouterPair(
+            fleet.urls,
+            journal_path=str(tmp_path / "journal.jsonl"),
+            lease_path=str(tmp_path / "lease.json"),
+            router_cfg=fleet.router_cfg,
+            standby_interval_s=0.05,
+            miss_budget_s=0.3,
+        )
+        pair.supervisor = fleet.supervisor
+        pair.start()
+        try:
+            n, max_new = 8, 4
+            prompts = serve_bench.make_prompts(
+                n, vocab=211, max_len=64, max_new=max_new, seed=11,
+            )
+            out = serve_bench._drive_takeover(
+                pair.endpoints(), prompts, concurrency=3,
+                max_new=max_new, temperature=0.0, top_k=0,
+                timeout=30.0,
+            )
+            statuses = [
+                r[0] if r is not None else None for r in out["replies"]
+            ]
+            # ZERO lost accepted requests across the router kill: the
+            # client's two-endpoint retry loop plus the journal absorb
+            # it.
+            assert statuses.count(200) == n, statuses
+            assert any(k == "killrouter" for k, _, _ in fault_engine.fired)
+            # The standby serves as soon as it holds the lease — replay
+            # may still be in flight when the drive returns, so wait
+            # for promote() to finish rather than sampling the event.
+            assert pair.monitor.promoted.wait(10.0)
+            assert pair.monitor.takeover_latency_s is not None
+            # The dispatch the kill interrupted was left incomplete in
+            # the journal and replayed by the promoted standby.
+            assert pair.monitor.replayed >= 1
+            # The supervisor now reports restarts to the NEW active
+            # router (adopt_router on promotion).
+            assert fleet.supervisor.router is pair.standby
+            # Nothing is left on the replay worklist.
+            assert pair.journal.incomplete() == []
+            # Explicit idempotent retry against the active endpoint:
+            # original tokens, dedup-flagged, no second generation.
+            orig = out["replies"][0][1]["tokens"]
+            status, dup = _post(pair.endpoints()[1], {
+                "prompt": prompts[0], "max_new_tokens": max_new,
+                "seed": 0, "request_id": "tko-0",
+            })
+            assert status == 200 and dup.get("dedup") is True
+            assert dup["tokens"] == orig
+            counters = pair.registry.counter_values()
+            assert counters.get("router/dedup_hits_total", 0) >= 1
+            assert counters.get("router/takeover_total", 0) == 1
+            # Resume: the remainder of the SAME stream from an offset.
+            status, res = _post(pair.endpoints()[1], {
+                "prompt": prompts[0], "max_new_tokens": max_new,
+                "seed": 0, "request_id": "tko-0", "resume_from": 2,
+            })
+            assert status == 200 and res["tokens"] == orig[2:]
+            assert res.get("resumed") is True
+        finally:
+            pair.close()
+            fleet.close()
+
+    @pytest.mark.timeout(120)
+    def test_split_brain_fenced_dispatch_refused(self, tmp_path):
+        """The split-brain pin: a primary that STALLS (misses its
+        heartbeats without dying) is fenced by the promoted standby's
+        newer token — its own dispatch path refuses to serve, so no
+        request is ever handled by two routers."""
+        fleet = _fake_fleet(2)
+        pair = RouterPair(
+            fleet.urls,
+            journal_path=str(tmp_path / "journal.jsonl"),
+            lease_path=str(tmp_path / "lease.json"),
+            router_cfg=fleet.router_cfg,
+            standby_interval_s=0.05,
+            miss_budget_s=0.2,
+        )
+        pair.start()
+        try:
+            # The live primary serves.
+            status, reply = _post(pair.endpoints()[0], {
+                "prompt": [7], "max_new_tokens": 2,
+            })
+            assert status == 200 and reply["tokens"] == [8, 9]
+            # Simulate the stall: stop the primary's loops (heartbeats
+            # cease) WITHOUT closing its HTTP frontend — the process is
+            # alive, just not heartbeating (GC pause, CPU starvation).
+            pair.primary.close()
+            deadline = time.monotonic() + 30
+            while (
+                not pair.monitor.promoted.is_set()
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert pair.monitor.promoted.is_set()
+            # The revived primary's dispatch is REFUSED: retryable
+            # fenced 503, counter stamped — the same check that kept
+            # the standby passive before promotion.
+            assert pair.primary.fenced()
+            status, body = _post(pair.endpoints()[0], {
+                "prompt": [7], "max_new_tokens": 2,
+            })
+            assert status == 503 and body.get("fenced") is True
+            assert body.get("retry") is True
+            counters = pair.registry.counter_values()
+            assert counters.get("router/fenced_dispatch_total", 0) >= 1
+            # Its stale heartbeat can never clobber the new lease.
+            assert pair.lease.heartbeat(1) is False
+            assert pair.lease.read()["token"] == 2
+            # The promoted standby serves the same request correctly.
+            status, reply = _post(pair.endpoints()[1], {
+                "prompt": [7], "max_new_tokens": 2,
+            })
+            assert status == 200 and reply["tokens"] == [8, 9]
+        finally:
+            pair.close()
+            fleet.close()
+
+
+class TestTakeoverGolden:
+    @pytest.mark.timeout(480)
+    def test_killrouter_mid_stream_zero_lost_token_identical(
+        self, serve_faults, tmp_path
+    ):
+        """ISSUE 16 acceptance: a 2-replica REAL fleet with a
+        primary/standby router pair under concurrent sampled load;
+        ``killrouter`` fires mid-stream. The standby promotes within
+        the heartbeat budget, ZERO accepted requests are lost, every
+        stream — died-in-flight, journal-replayed, client-retried —
+        is token-identical to the unbatched reference, a duplicated
+        request_id retry returns the ORIGINAL tokens as a dedupe hit
+        (no second generation), the fleet takes zero post-warmup
+        recompiles, and the v12 stats line validates."""
+        import serve_bench
+
+        fault_engine = serve_faults("killrouter@3")
+        fleet = ChaosFleet(
+            [_real_engine_factory] * 2,
+            router_cfg=RouterConfig(
+                probe_interval_s=0.1, retry_budget_s=30.0,
+                max_retries=4, eject_after=2, eject_cooldown_s=1.0,
+            ),
+            supervisor_kw=dict(
+                poll_s=0.05, health_stall_s=3.0, warm_timeout_s=240.0,
+            ),
+        )
+        fleet.start()
+        miss_budget_s = 1.0
+        pair = RouterPair(
+            fleet.urls,
+            journal_path=str(tmp_path / "journal.jsonl"),
+            lease_path=str(tmp_path / "lease.json"),
+            router_cfg=fleet.router_cfg,
+            standby_interval_s=0.1,
+            miss_budget_s=miss_budget_s,
+        )
+        pair.supervisor = fleet.supervisor
+        pair.start()
+        try:
+            n, max_new = 10, 6
+            prompts = serve_bench.make_prompts(
+                n, vocab=CHAOS_MODEL["vocab_size"],
+                max_len=CHAOS_MODEL["max_len"], max_new=max_new,
+                seed=23, shared_prefix_every=4,
+            )
+            out = serve_bench._drive_takeover(
+                pair.endpoints(), prompts, concurrency=4,
+                max_new=max_new, temperature=0.7, top_k=0,
+                timeout=60.0,
+            )
+            statuses = [
+                r[0] if r is not None else None for r in out["replies"]
+            ]
+            # ZERO lost accepted requests across the router kill.
+            assert statuses.count(200) == n, statuses
+            assert any(
+                k == "killrouter" for k, _, _ in fault_engine.fired
+            )
+            # The standby promoted, within the heartbeat budget (the
+            # promotion verb itself: acquire + sweep + replay). Clients
+            # can drain against the lease-holding standby before replay
+            # completes, so wait for the event instead of sampling it.
+            assert pair.monitor.promoted.wait(10.0)
+            latency = pair.monitor.takeover_latency_s
+            assert latency is not None and latency <= miss_budget_s * 10
+            # The interrupted dispatch replayed from the journal.
+            assert pair.monitor.replayed >= 1
+            assert pair.journal.incomplete() == []
+            # Every stream is token-identical to the unbatched
+            # reference — takeover, replay, and client retries are
+            # invisible in the tokens (pure function of params/prompt/
+            # seed).
+            ref_engine = fleet.replicas[0].engine
+            for i, prompt in enumerate(prompts):
+                expect = ref_engine.reference_generate(
+                    prompt, max_new=max_new, seed=i,
+                    temperature=0.7, top_k=0,
+                )
+                got = out["replies"][i][1]["tokens"]
+                assert got == expect, (
+                    f"request {i} diverged across takeover: "
+                    f"{got} != {expect}"
+                )
+            # Idempotency: duplicate request_id returns the ORIGINAL
+            # stream as a dedupe hit — no second generation burned.
+            dispatched_before = pair.registry.counter_values().get(
+                "router/dispatched_total", 0
+            )
+            orig = out["replies"][0][1]["tokens"]
+            status, dup = _post(pair.endpoints()[1], {
+                "prompt": prompts[0], "max_new_tokens": max_new,
+                "temperature": 0.7, "top_k": 0, "seed": 0,
+                "request_id": "tko-0",
+            })
+            assert status == 200 and dup.get("dedup") is True
+            assert dup["tokens"] == orig
+            counters = pair.registry.counter_values()
+            assert counters.get("router/dedup_hits_total", 0) >= 1
+            assert counters.get(
+                "router/dispatched_total", 0
+            ) == dispatched_before
+            # Zero post-warmup recompiles fleet-wide.
+            for rep in fleet.replicas:
+                assert rep.engine.post_warmup_recompiles() == 0
+            # The promoted router's stats line is schema-v12 and tells
+            # the whole story (shared registry survives the switch).
+            line = json.loads(json.dumps(pair.standby.stats_line()))
+            assert schema.validate_line(line) == []
+            assert line["schema_version"] == 12
+            serving = line["serving"]
+            assert serving["takeover_total"] == 1
+            assert serving["journal_appends"] >= 2 * n
+            assert serving["dedup_hits"] >= 1
+            assert serving["takeover_latency_s"] == pytest.approx(
+                latency
+            )
+            # Split-brain coda: the dead primary's fencing token is
+            # stale — were it revived, its dispatch path refuses.
+            assert pair.primary.fenced()
+            status, body = pair.primary.handle(
+                {"prompt": [5], "max_new_tokens": 2}, kind="generate"
+            )
+            assert status == 503 and body.get("fenced") is True
+        finally:
+            pair.close()
             fleet.close()

@@ -1,0 +1,415 @@
+"""Control-plane durability units (ISSUE 16): the request journal's
+crash-safe JSONL contract (torn tail, invalid lines, dedupe window,
+tail-follow), the lease's monotonic fencing token (stale heartbeats
+refused, atomic replace), the standby monitor's promote path over a
+device-free fake fleet, and the schema-v12 ritual pin (v12 serving
+keys forbidden on v4–v11).
+
+Everything here is device-free and socket-light — the real-engine
+takeover golden lives in tests/test_chaos.py; this tier proves each
+mechanism in isolation at O(ms).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from tensorflow_examples_tpu.serving.journal import (
+    JOURNAL_VERSION,
+    Lease,
+    RequestJournal,
+    StandbyMonitor,
+    validate_record,
+)
+from tensorflow_examples_tpu.serving.router import Router, RouterConfig
+from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+
+pytestmark = pytest.mark.serving
+
+
+def _intent_body(prompt=(5, 6), seed=0, **over):
+    body = {
+        "prompt": list(prompt), "max_new_tokens": 3,
+        "temperature": 0.0, "top_k": 0, "seed": seed,
+    }
+    body.update(over)
+    return body
+
+
+class TestValidateRecord:
+    def test_valid_records_pass(self, tmp_path):
+        j = RequestJournal(str(tmp_path / "j.jsonl"))
+        intent = j.append_intent("r1", _intent_body())
+        progress = j.append_progress("r1", 2)
+        done = j.append_done("r1", [7, 8, 9], 200)
+        for rec in (intent, progress, done):
+            assert validate_record(rec) == []
+        j.close()
+
+    def test_not_an_object(self):
+        assert validate_record([1, 2]) == ["record is not an object"]
+
+    def test_wrong_version_and_unknown_kind(self):
+        problems = validate_record({"v": 99, "rec": "nope"})
+        assert any("journal version" in p for p in problems)
+        assert any("unknown record kind" in p for p in problems)
+
+    def test_missing_fields_named(self):
+        problems = validate_record(
+            {"v": JOURNAL_VERSION, "rec": "intent", "request_id": "r"}
+        )
+        assert any("missing 'prompt'" in p for p in problems)
+        assert any("missing 'seed'" in p for p in problems)
+
+    def test_typed_fields(self):
+        bad_prompt = {
+            "v": JOURNAL_VERSION, "rec": "intent", "request_id": "r",
+            "prompt": [1, True], "max_new_tokens": 4,
+            "temperature": 0.0, "top_k": 0, "seed": 0,
+            "slo": "interactive", "tenant": "default", "ts": 1.0,
+        }
+        assert any(
+            "token ids" in p for p in validate_record(bad_prompt)
+        )
+        bad_progress = {
+            "v": JOURNAL_VERSION, "rec": "progress", "request_id": "r",
+            "committed": "2", "ts": 1.0,
+        }
+        assert any(
+            "int offset" in p for p in validate_record(bad_progress)
+        )
+        bad_done = {
+            "v": JOURNAL_VERSION, "rec": "done", "request_id": "r",
+            "tokens": 7, "status": "200", "ts": 1.0,
+        }
+        problems = validate_record(bad_done)
+        assert any("tokens must be a list" in p for p in problems)
+        assert any("status must be an int" in p for p in problems)
+
+    def test_empty_request_id_rejected(self):
+        rec = {
+            "v": JOURNAL_VERSION, "rec": "progress", "request_id": "",
+            "committed": 1, "ts": 1.0,
+        }
+        assert any(
+            "non-empty string" in p for p in validate_record(rec)
+        )
+
+
+class TestRequestJournal:
+    def test_append_lookup_incomplete_roundtrip(self, tmp_path):
+        j = RequestJournal(str(tmp_path / "j.jsonl"))
+        j.append_intent("r1", _intent_body(seed=3))
+        assert j.has_intent("r1") and not j.has_intent("r2")
+        assert [i["request_id"] for i in j.incomplete()] == ["r1"]
+        j.append_progress("r1", 1)
+        j.append_progress("r1", 2)
+        assert j.committed("r1") == 2
+        j.append_done("r1", [6, 7, 8], 200)
+        assert j.incomplete() == []
+        hit = j.lookup("r1")
+        assert hit["tokens"] == [6, 7, 8] and hit["status"] == 200
+        assert j.lookup("never") is None
+        st = j.stats()
+        assert st["appends"] == 4 and st["incomplete"] == 0
+        assert st["done"] == 1 and st["torn_tail"] == 0
+        j.close()
+
+    def test_progress_watermark_is_monotonic(self, tmp_path):
+        j = RequestJournal(str(tmp_path / "j.jsonl"))
+        j.append_intent("r1", _intent_body())
+        j.append_progress("r1", 5)
+        j.append_progress("r1", 2)  # stale replayed offset
+        assert j.committed("r1") == 5
+        j.close()
+
+    def test_fresh_reader_replays_file(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        w = RequestJournal(path)
+        w.append_intent("r1", _intent_body())
+        w.append_intent("r2", _intent_body(seed=1))
+        w.append_done("r1", [9], 200)
+        w.close()
+        r = RequestJournal(path)  # __init__ refreshes
+        assert [i["request_id"] for i in r.incomplete()] == ["r2"]
+        assert r.lookup("r1")["tokens"] == [9]
+        r.close()
+
+    def test_tail_follow_between_instances(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        w = RequestJournal(path)
+        r = RequestJournal(path)
+        w.append_intent("r1", _intent_body())
+        assert r.refresh() == 1 and r.has_intent("r1")
+        # The writer's own appends are pre-applied: refresh is a no-op.
+        assert w.refresh() == 0
+        w.append_done("r1", [4], 200)
+        assert r.refresh() == 1 and r.incomplete() == []
+        w.close()
+        r.close()
+
+    def test_torn_tail_tolerated_not_consumed(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        w = RequestJournal(path)
+        full = w.append_intent("r1", _intent_body())
+        w.close()
+        # Simulate the writer dying mid-append: a valid line then half
+        # of the next one, no terminating newline.
+        frag = json.dumps(dict(full, request_id="r2"))
+        with open(path, "ab") as f:
+            f.write(frag[: len(frag) // 2].encode())
+        r = RequestJournal(path)
+        assert r.has_intent("r1") and not r.has_intent("r2")
+        assert r.stats()["torn_tail"] == 1
+        assert r.stats()["invalid_lines"] == 0
+        # The writer was merely slow: once the line completes, the next
+        # refresh applies it from the held-back offset.
+        with open(path, "ab") as f:
+            f.write((frag[len(frag) // 2:] + "\n").encode())
+        assert r.refresh() == 1 and r.has_intent("r2")
+        r.close()
+
+    def test_invalid_lines_counted_not_applied(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w") as f:
+            f.write("this is not json\n")
+            f.write(json.dumps({"rec": "intent", "v": 0}) + "\n")
+        j = RequestJournal(path)
+        assert j.stats()["invalid_lines"] == 2
+        assert j.incomplete() == []
+        j.close()
+
+    def test_append_refuses_invalid_record(self, tmp_path):
+        j = RequestJournal(str(tmp_path / "j.jsonl"))
+        with pytest.raises(ValueError, match="invalid journal record"):
+            j.append_intent("r1", {"prompt": []})
+        j.close()
+
+    def test_dedupe_window_evicts_oldest(self, tmp_path):
+        j = RequestJournal(str(tmp_path / "j.jsonl"), dedup_window=2)
+        for i in range(3):
+            rid = f"r{i}"
+            j.append_intent(rid, _intent_body(seed=i))
+            j.append_done(rid, [i], 200)
+        assert j.lookup("r0") is None  # evicted from the window
+        assert j.lookup("r1") and j.lookup("r2")
+        st = j.stats()
+        assert st["dedup_evictions"] == 1 and st["dedup_entries"] == 2
+        # Eviction only forgets the TOKENS: completion is remembered,
+        # so an evicted id never re-enters the replay worklist.
+        assert j.incomplete() == []
+        j.close()
+
+    def test_counter_stamped_per_append(self, tmp_path):
+        reg = MetricsRegistry()
+        j = RequestJournal(str(tmp_path / "j.jsonl"), registry=reg)
+        j.append_intent("r1", _intent_body())
+        j.append_done("r1", [1], 200)
+        assert reg.counter("router/journal_appends_total").value == 2
+        j.close()
+
+    def test_concurrent_appends_all_land(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = RequestJournal(path)
+
+        def work(k):
+            for i in range(10):
+                j.append_intent(f"r{k}-{i}", _intent_body(seed=i))
+
+        threads = [
+            threading.Thread(target=work, args=(k,)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        j.close()
+        fresh = RequestJournal(path)
+        assert len(fresh.incomplete()) == 40
+        assert fresh.stats()["invalid_lines"] == 0
+        fresh.close()
+
+
+class TestLease:
+    def test_acquire_is_monotonic(self, tmp_path):
+        lease = Lease(str(tmp_path / "l.json"), owner="a")
+        assert lease.acquire() == 1
+        assert lease.acquire() == 2
+        cur = lease.read()
+        assert cur["token"] == 2 and cur["owner"] == "a"
+
+    def test_missing_or_garbage_file_reads_none(self, tmp_path):
+        lease = Lease(str(tmp_path / "l.json"))
+        assert lease.read() is None and lease.age_s() is None
+        with open(lease.path, "w") as f:
+            f.write("not json")
+        assert lease.read() is None
+
+    def test_stale_heartbeat_refused_and_never_clobbers(self, tmp_path):
+        path = str(tmp_path / "l.json")
+        old = Lease(path, owner="primary")
+        t1 = old.acquire()
+        new = Lease(path, owner="standby")
+        t2 = new.acquire()
+        before = new.read()
+        assert old.heartbeat(t1) is False  # fenced: no write
+        assert new.read() == before
+        assert new.heartbeat(t2) is True
+        assert new.read()["ts"] >= before["ts"]
+
+    def test_fenced_is_strictly_newer_token(self, tmp_path):
+        lease = Lease(str(tmp_path / "l.json"))
+        t1 = lease.acquire()
+        assert not lease.fenced(t1)
+        t2 = lease.acquire()
+        assert lease.fenced(t1) and not lease.fenced(t2)
+        # Token 0 (the standby's pre-promotion token) is fenced by ANY
+        # granted lease — standby passivity is the same check.
+        assert lease.fenced(0)
+
+    def test_heartbeat_resets_age(self, tmp_path):
+        lease = Lease(str(tmp_path / "l.json"))
+        token = lease.acquire()
+        assert lease.age_s() is not None and lease.age_s() >= 0.0
+        assert lease.heartbeat(token)
+        assert lease.age_s() < 5.0
+
+    def test_atomic_write_leaves_no_tmp(self, tmp_path):
+        lease = Lease(str(tmp_path / "l.json"))
+        lease.acquire()
+        assert [p.name for p in tmp_path.glob("*.tmp.*")] == []
+
+
+class TestStandbyMonitor:
+    """Promotion mechanics over a replica-less router — the fleet side
+    is the chaos tier's job; here only the lease/journal choreography
+    is under test, driven by poll_once() for determinism."""
+
+    def _standby(self, tmp_path, **kw):
+        journal = RequestJournal(str(tmp_path / "j.jsonl"))
+        lease = Lease(str(tmp_path / "l.json"), owner="primary")
+        # One unreachable replica: the router requires a non-empty
+        # fleet, and a refused connect makes the promote-time sweep
+        # instant. Fleet behaviour itself is the chaos tier's job.
+        router = Router(
+            ["http://127.0.0.1:9"],
+            cfg=RouterConfig(probe_interval_s=30.0),
+            journal=journal,
+        )
+        monitor = StandbyMonitor(
+            router, lease=lease, journal=journal,
+            miss_budget_s=kw.pop("miss_budget_s", 0.05), **kw
+        )
+        return journal, lease, router, monitor
+
+    def test_fenced_until_promoted_then_takes_over(self, tmp_path):
+        journal, lease, router, monitor = self._standby(tmp_path)
+        try:
+            lease.acquire()  # the primary's grant
+            assert router.fenced()  # standby holds token 0
+            monitor.poll_once()  # heartbeat fresh: no promotion yet
+            assert not monitor.promoted.is_set()
+            import time as _time
+
+            _time.sleep(0.06)  # blow the miss budget
+            monitor.poll_once()
+            assert monitor.promoted.is_set()
+            assert not router.fenced()
+            assert monitor.takeover_latency_s is not None
+            reg = router.registry
+            assert reg.counter("router/takeover_total").value == 1
+            assert (
+                reg.gauge("router/takeover_latency_s").value
+                == monitor.takeover_latency_s
+            )
+        finally:
+            monitor.close()
+            router.close()
+            journal.close()
+
+    def test_no_lease_means_no_promotion(self, tmp_path):
+        journal, lease, router, monitor = self._standby(tmp_path)
+        try:
+            monitor.poll_once()  # age_s() is None: nothing to miss
+            assert not monitor.promoted.is_set()
+        finally:
+            monitor.close()
+            router.close()
+            journal.close()
+
+    def test_promote_is_idempotent(self, tmp_path):
+        journal, lease, router, monitor = self._standby(tmp_path)
+        try:
+            monitor.promote()
+            token = lease.read()["token"]
+            monitor.promote()
+            monitor.poll_once()
+            assert lease.read()["token"] == token
+            assert (
+                router.registry.counter("router/takeover_total").value
+                == 1
+            )
+        finally:
+            monitor.close()
+            router.close()
+            journal.close()
+
+
+class TestSchemaV12:
+    """The schema ritual (ISSUE 16 satellite): v12 keys exist, are
+    forbidden on every version that predates them, and the journal-less
+    router's line still validates."""
+
+    def test_v12_key_tuple_pinned(self):
+        assert schema.SERVING_SCHEMA_VERSION == 12
+        assert schema.SERVING_KEYS_V12 == (
+            "journal_appends", "takeover_total", "resumed_streams",
+            "dedup_hits", "takeover_latency_s",
+        )
+
+    def test_v12_keys_flagged_on_older_versions(self):
+        base = {
+            "schema_version": 12, "kind": "serving", "step": 1,
+            "time_unix": 1.0, "session_start_unix": 1.0, "host": 0,
+            "metrics": {}, "counters": {}, "gauges": {}, "derived": {},
+            "serving": {
+                "active_requests": 0, "queue_depth": 0, "slots": 4,
+                "kv_occupancy": 0.0, "post_warmup_recompiles": 0,
+                "draining": 0, "journal_appends": 3,
+                "takeover_total": 1, "resumed_streams": 2,
+                "dedup_hits": 4, "takeover_latency_s": 0.25,
+            },
+        }
+        assert schema.validate_line(base) == []
+        for version in (4, 5, 6, 7, 8, 9, 10, 11):
+            stale = dict(base, schema_version=version)
+            problems = schema.validate_line(stale)
+            for key in schema.SERVING_KEYS_V12:
+                assert any(
+                    f"v12 serving key '{key}'" in p for p in problems
+                ), (version, key, problems)
+
+    def test_router_line_carries_v12_keys(self, tmp_path):
+        journal = RequestJournal(str(tmp_path / "j.jsonl"))
+        router = Router(["http://127.0.0.1:9"], journal=journal)
+        try:
+            line = json.loads(json.dumps(router.stats_line()))
+            assert line["schema_version"] == 12
+            assert schema.validate_line(line) == []
+            for key in schema.SERVING_KEYS_V12:
+                assert key in line["serving"], key
+        finally:
+            router.close()
+            journal.close()
+
+    def test_journal_less_router_line_validates(self):
+        router = Router(["http://127.0.0.1:9"])
+        try:
+            line = json.loads(json.dumps(router.stats_line()))
+            assert line["schema_version"] == 12
+            assert schema.validate_line(line) == []
+        finally:
+            router.close()

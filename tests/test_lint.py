@@ -123,10 +123,10 @@ class TestSchemaPassFixtures:
 
         src = drift._load(REPO, drift.SCHEMA_FILE)
         tuples = drift.schema_keys(src)
-        assert "SERVING_KEYS_V11" in tuples
-        assert tuples["SERVING_KEYS_V11"] == set(schema.SERVING_KEYS_V11)
+        assert "SERVING_KEYS_V12" in tuples
+        assert tuples["SERVING_KEYS_V12"] == set(schema.SERVING_KEYS_V12)
         # Every live bump is discovered, none hand-listed.
-        for n in range(6, 12):
+        for n in range(6, 13):
             assert f"SERVING_KEYS_V{n}" in tuples
         # Precedence: the base (v4) tuple claims shared keys first.
         assert drift._tuple_order("SERVING_KEYS") < drift._tuple_order(

@@ -119,6 +119,13 @@ RECORD_KEYS: dict[str, str] = {
     # engine.byte_breakdown).
     "tpot_speedup_quant": "min",
     "hbm_bytes_per_replica": "max",
+    # Control-plane resilience (ISSUE 16): serve_bench --chaos banks a
+    # second serve_takeover record — the standby's detect-to-serving
+    # promotion wall pinned as a maximum (a probe-rebuild or journal-
+    # replay regression that quietly slows takeover fails CI).
+    # Floorless: the record's own ok already gates lost_requests at 0
+    # and dedup_hits >= 1, so only the latency needs a floor file.
+    "takeover_latency_s": "max",
 }
 
 

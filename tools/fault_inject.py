@@ -47,6 +47,10 @@ each replica's own decode-step/request/probe counters:
     kvexhaust@R:N   force BlockExhausted on replica R's Nth decode step
     badhealth@R:K   replica R's first K /health replies are non-JSON
                     garbage (the probe must mark it unhealthy)
+    killrouter@T    ISSUE 16, no replica index: hard-abort the ACTIVE
+                    router's frontend after its Tth accepted dispatch
+                    (clients see resets; the warm standby promotes and
+                    replays the journal's incomplete intents)
 """
 
 from __future__ import annotations

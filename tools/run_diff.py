@@ -95,6 +95,11 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("tpot_speedup_quant", "higher", "x", 1.0),
     ("hbm_bytes_per_replica", "lower", "MiB", 1.0 / 2**20),
     ("stream_agreement", "higher", "", 1.0),
+    # ---- control-plane takeover records (ISSUE 16) ----
+    ("takeover_latency_s", "lower", "s", 1.0),
+    ("lost_requests", "lower", "", 1.0),
+    ("resumed_streams", "higher", "", 1.0),
+    ("dedup_hits", "higher", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -130,6 +135,8 @@ GATE_KEYS = (
     # weight-quantization gate keys (ISSUE 15)
     "tpot_speedup_quant",
     "hbm_bytes_per_replica",
+    # control-plane takeover gate keys (ISSUE 16)
+    "takeover_latency_s",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

@@ -57,7 +57,16 @@ Modes:
   ``p95_vs_baseline`` (client-observed e2e p95 ratio vs the declared
   ``CHAOS_P95_BUDGET``). ``bench_gate`` gates ``error_rate`` at 0 and
   ``p95_vs_baseline`` as a max. ``--smoke --chaos`` is the tier-1
-  chaos smoke.
+  chaos smoke. The run then reuses the warm fleet for the ISSUE 16
+  router-kill phase: a fresh primary/standby ``RouterPair`` over the
+  same replicas, ``killrouter@T`` hard-aborting the primary
+  mid-stream, clients failing over on idempotency keys. Banks a
+  second ``serve_takeover`` record (to ``<out>_takeover.json``):
+  ``takeover_latency_s`` vs ``TAKEOVER_LATENCY_BUDGET_S``,
+  ``lost_requests`` (gated at 0 — an accepted request survives router
+  death via the durable journal), ``resumed_streams``, ``dedup_hits``
+  (a duplicated request_id retry returns the ORIGINAL tokens), and
+  zero post-warmup recompiles fleet-wide.
 
 * ``--affinity {on,off,ab}`` (ISSUE 12, with ``--router``) — prefix-
   affinity dispatch control. ``ab`` is the A/B mode: the SAME shared-
@@ -886,6 +895,13 @@ def run_affinity_bench(args) -> dict:
 # is load-noisy; the claim is "bounded", not "free".
 CHAOS_P95_BUDGET = 25.0
 
+# ISSUE 16: detect-to-serving promotion wall the serve_takeover record
+# gates on (the time from the standby noticing the stale lease to its
+# first post-promotion dispatch being possible — probe rebuild plus
+# journal replay; the heartbeat miss budget itself is configured, not
+# measured).
+TAKEOVER_LATENCY_BUDGET_S = 10.0
+
 
 def _client_p95_ms(outcome) -> float | None:
     vals = [
@@ -893,6 +909,241 @@ def _client_p95_ms(outcome) -> float | None:
         if s is not None and r is not None and r[0] == 200
     ]
     return _pct_from_values(vals, 95)
+
+
+def _drive_takeover(endpoints, prompts, *, concurrency, max_new,
+                    temperature, top_k, timeout) -> dict:
+    """Closed loop with CLIENT-SIDE failover (ISSUE 16): every request
+    carries an idempotency key, and a worker that sees a transport
+    reset or a fenced/retryable 503 simply retries against the other
+    router endpoint until its deadline — the protocol a real client of
+    a primary/standby pair speaks. Because retries reuse the
+    request_id, a request the dying primary already completed comes
+    back as a journal dedupe hit, and one it only accepted comes back
+    from the standby's replay; the caller can't tell, which is the
+    point."""
+    replies: list[tuple[int, dict] | None] = [None] * len(prompts)
+    client_s: list[float | None] = [None] * len(prompts)
+    retries = [0]
+    next_i = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = next_i[0]
+                if i >= len(prompts):
+                    return
+                next_i[0] += 1
+            body = {
+                "prompt": prompts[i],
+                "max_new_tokens": max_new,
+                "temperature": temperature,
+                "top_k": top_k,
+                "seed": i,  # per-request stream: replayable
+                "request_id": f"tko-{i}",
+            }
+            t_req = time.perf_counter()
+            deadline = t_req + timeout
+            last = None
+            while True:
+                for url in endpoints:
+                    last = _post_json(url, body, timeout)
+                    if last[0] == 200:
+                        break
+                    with lock:
+                        retries[0] += 1
+                if last[0] == 200 or time.perf_counter() > deadline:
+                    break
+                time.sleep(0.05)
+            replies[i] = last
+            client_s[i] = time.perf_counter() - t_req
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=worker, name=f"serve-bench-{k}", daemon=True
+        )
+        for k in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout * max(1, len(prompts)))
+    wall = time.perf_counter() - t0
+    return {
+        "replies": replies, "client_s": client_s, "wall_s": wall,
+        "client_retries": retries[0],
+    }
+
+
+def _takeover_phase(args, fleet, mk) -> dict:
+    """The --chaos router-kill phase (ISSUE 16): a fresh RouterPair
+    over the already-warm fleet, ``killrouter@T`` armed mid-stream,
+    clients failing over between the two endpoints. Banks the
+    ``serve_takeover`` record: takeover_latency_s, ZERO lost accepted
+    requests, resumed_streams, dedup_hits — each measured, not
+    asserted by construction."""
+    import shutil
+    import tempfile
+
+    from tensorflow_examples_tpu.serving.chaos import RouterPair
+    from tensorflow_examples_tpu.utils import faults as faults_mod
+
+    n = args.requests or (12 if args.smoke else 48)
+    kill_at = max(2, n // 3)
+    miss_budget_s = 1.0
+    tmp = tempfile.mkdtemp(prefix="serve_takeover_")
+    pair = RouterPair(
+        fleet.urls,
+        journal_path=os.path.join(tmp, "journal.jsonl"),
+        lease_path=os.path.join(tmp, "lease.json"),
+        router_cfg=fleet.router_cfg,
+        standby_interval_s=0.1,
+        miss_budget_s=miss_budget_s,
+    ).start()
+    prompts = make_prompts(n, seed=303, **mk)
+    faults_mod.serve_clear()
+    fault_engine = faults_mod.serve_install(f"killrouter@{kill_at}")
+    print(
+        f"# takeover phase: killrouter@{kill_at} over {n} requests, "
+        f"heartbeat miss budget {miss_budget_s:.1f}s",
+        file=sys.stderr,
+    )
+    try:
+        out = _drive_takeover(
+            pair.endpoints(), prompts,
+            concurrency=args.concurrency,
+            max_new=args.max_new_tokens,
+            temperature=args.temperature, top_k=args.top_k,
+            timeout=args.timeout,
+        )
+        # The standby starts serving the moment it grabs the lease,
+        # BEFORE journal replay finishes — clients can drain while
+        # promote() is still running, so wait for the completion event
+        # instead of sampling it.
+        promoted = pair.monitor.promoted.wait(
+            timeout=TAKEOVER_LATENCY_BUDGET_S
+        )
+        # Every stream — died-in-flight, journal-replayed, deduped —
+        # must be token-identical to the unbatched reference.
+        verify_ok = True
+        ref_engine = fleet.replicas[0].engine
+        verify = min(len(prompts), max(
+            args.verify if args.verify >= 0 else 3, 3
+        ))
+        for i in range(verify):
+            reply = out["replies"][i]
+            if reply is None or reply[0] != 200:
+                verify_ok = False
+                continue
+            ref = ref_engine.reference_generate(
+                prompts[i], max_new=args.max_new_tokens, seed=i,
+                temperature=args.temperature, top_k=args.top_k,
+            )
+            if reply[1]["tokens"] != ref:
+                verify_ok = False
+                print(
+                    f"# VERIFY FAIL takeover req {i}: served "
+                    f"{reply[1]['tokens']} != reference {ref}",
+                    file=sys.stderr,
+                )
+        # Idempotency: a duplicated request_id retry must return the
+        # ORIGINAL tokens as a dedupe hit, not burn a generation.
+        active = pair.endpoints()[1] if promoted else pair.endpoints()[0]
+        first_ok = next(
+            (i for i, r in enumerate(out["replies"])
+             if r is not None and r[0] == 200), None
+        )
+        dedup_ok = False
+        resume_ok = False
+        if first_ok is not None:
+            orig = out["replies"][first_ok][1]["tokens"]
+            status, dup = _post_json(active, {
+                "prompt": prompts[first_ok],
+                "max_new_tokens": args.max_new_tokens,
+                "temperature": args.temperature, "top_k": args.top_k,
+                "seed": first_ok, "request_id": f"tko-{first_ok}",
+            }, args.timeout)
+            dedup_ok = (
+                status == 200 and dup.get("dedup") is True
+                and dup.get("tokens") == orig
+            )
+            # Client resume: reconnect at a committed offset, get the
+            # remainder of the SAME stream.
+            cut = max(1, len(orig) // 2)
+            status, res = _post_json(active, {
+                "prompt": prompts[first_ok],
+                "max_new_tokens": args.max_new_tokens,
+                "temperature": args.temperature, "top_k": args.top_k,
+                "seed": first_ok, "request_id": f"tko-{first_ok}",
+                "resume_from": cut,
+            }, args.timeout)
+            resume_ok = (
+                status == 200 and res.get("tokens") == orig[cut:]
+            )
+        tally = tally_replies(out["replies"])
+        counters = pair.registry.counter_values()
+        recompiles = sum(
+            rep.engine.post_warmup_recompiles()
+            for rep in fleet.replicas if rep.engine is not None
+        )
+        lost = n - tally["completed"]
+        latency = pair.monitor.takeover_latency_s
+        rec = {
+            "bench": "serve_takeover",
+            "replicas": len(fleet.replicas),
+            "fault_spec": f"killrouter@{kill_at}",
+            "faults_fired": len(fault_engine.fired),
+            "requests": n,
+            "completed": tally["completed"],
+            "lost_requests": lost,
+            "client_retries": out["client_retries"],
+            "concurrency": args.concurrency,
+            "promoted": promoted,
+            "heartbeat_miss_budget_s": miss_budget_s,
+            "takeover_latency_s": (
+                round(latency, 4) if latency is not None else None
+            ),
+            "takeover_budget_s": TAKEOVER_LATENCY_BUDGET_S,
+            "replayed_intents": pair.monitor.replayed,
+            "journal_appends": int(
+                counters.get("router/journal_appends_total", 0)
+            ),
+            "resumed_streams": int(
+                counters.get("router/resumed_streams_total", 0)
+            ),
+            "dedup_hits": int(
+                counters.get("router/dedup_hits_total", 0)
+            ),
+            "fenced_dispatches": int(
+                counters.get("router/fenced_dispatch_total", 0)
+            ),
+            "post_warmup_recompiles": recompiles,
+            "verified": verify,
+            "verify_ok": verify_ok,
+            "dedup_ok": dedup_ok,
+            "resume_ok": resume_ok,
+            "transport": "router-http",
+        }
+        rec["ok"] = bool(
+            tally["completed"] == n
+            and lost == 0
+            and promoted
+            and fault_engine.fired
+            and verify_ok
+            and dedup_ok
+            and resume_ok
+            and rec["dedup_hits"] >= 1
+            and recompiles == 0
+            and latency is not None
+            and latency <= TAKEOVER_LATENCY_BUDGET_S
+        )
+        return rec
+    finally:
+        faults_mod.serve_clear()
+        pair.close()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def run_chaos_bench(args) -> dict:
@@ -1004,6 +1255,10 @@ def run_chaos_bench(args) -> dict:
                     f"{reply[1]['tokens']} != reference {ref}",
                     file=sys.stderr,
                 )
+        # ISSUE 16: the router-kill phase rides the same warm fleet —
+        # a fresh primary/standby RouterPair, killrouter mid-stream,
+        # clients failing over on their idempotency keys.
+        takeover = _takeover_phase(args, fleet, mk)
     finally:
         faults_mod.serve_clear()
         rfront.close()
@@ -1090,6 +1345,7 @@ def run_chaos_bench(args) -> dict:
         and survivor_recompiles == 0
         and (p95_ratio is None or p95_ratio <= CHAOS_P95_BUDGET)
     )
+    rec["takeover"] = takeover
     return rec
 
 
@@ -2163,12 +2419,23 @@ def main(argv=None) -> int:
 
     if args.chaos:
         rec = run_chaos_bench(args)
+        takeover = rec.pop("takeover", None)
         print(json.dumps(rec))
+        if takeover is not None:
+            print(json.dumps(takeover))
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(rec, f, indent=1)
                 f.write("\n")
-        return 0 if rec["ok"] else 1
+            if takeover is not None:
+                root, ext = os.path.splitext(args.out)
+                tko_out = f"{root}_takeover{ext or '.json'}"
+                with open(tko_out, "w") as f:
+                    json.dump(takeover, f, indent=1)
+                    f.write("\n")
+        return 0 if (
+            rec["ok"] and (takeover is None or takeover["ok"])
+        ) else 1
 
     if args.router:
         rec = run_router_bench(args)

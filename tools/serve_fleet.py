@@ -33,6 +33,17 @@ with replica supervision (ISSUE 10).
         --spawn 'python examples/gpt2/serve.py --workdir w0 --port {port}' \
         --min-replicas 1 --max-replicas 4 --target-queue 4
 
+    # Warm-standby control plane (ISSUE 16): accepted requests are
+    # journaled durably; a second router on --standby-port answers
+    # fenced 503s until the primary's lease heartbeat goes stale, then
+    # promotes itself — rebuilding probe state from /health sweeps and
+    # in-flight work from the journal (replayed token-identically by
+    # seeding). A client keeps both URLs and retries the other on
+    # transport failure; duplicate request_id retries dedupe.
+    python tools/serve_fleet.py --port 9000 --standby \
+        --standby-port 9001 --journal fleet.journal \
+        --replica http://host-a:8000 --replica http://host-b:8000
+
     # Canary rollout: route 25% of traffic to the canary set and bank
     # a run_diff comparison of the two sets at exit (or on demand at
     # GET /canary):
@@ -144,6 +155,29 @@ def main(argv=None) -> int:
     ap.add_argument("--scale-down-idle", type=float, default=30.0,
                     help="autoscaler: sustained-idle seconds before a "
                          "drain-first scale-down")
+    ap.add_argument("--journal", default="",
+                    help="ISSUE 16: durable request journal (JSONL). "
+                         "Accepted requests append an intent before "
+                         "dispatch; a restarted router replays the "
+                         "incomplete ones (token-identical by "
+                         "seeding), and duplicate request_id retries "
+                         "dedupe to the original tokens")
+    ap.add_argument("--standby", action="store_true",
+                    help="ISSUE 16: run a warm-standby router pair "
+                         "over the fleet — the standby tails the "
+                         "journal, answers fenced 503s until the "
+                         "primary's lease heartbeat goes stale, then "
+                         "promotes itself (monotonic fencing token: "
+                         "a stalled-then-revived primary refuses its "
+                         "own dispatches). Needs --journal")
+    ap.add_argument("--standby-port", type=int, default=0,
+                    help="standby router listen port (0 = auto)")
+    ap.add_argument("--lease", default="",
+                    help="active-router lease file (default: "
+                         "<journal>.lease)")
+    ap.add_argument("--heartbeat-miss", type=float, default=2.0,
+                    help="standby: promote after the primary's lease "
+                         "heartbeat is stale this many seconds")
     ap.add_argument("--no-affinity", action="store_true",
                     help="disable prefix-affinity dispatch (ISSUE 12; "
                          "on by default — the router prefers the "
@@ -162,6 +196,12 @@ def main(argv=None) -> int:
     if args.autoscale and not args.spawn:
         ap.error("--autoscale needs a --spawn command to use as the "
                  "replica template")
+    if args.standby and not args.journal:
+        ap.error("--standby needs --journal (the standby rebuilds "
+                 "in-flight work from the journal at takeover)")
+    if args.standby and (args.canary or args.autoscale):
+        ap.error("--standby does not compose with --canary/--autoscale "
+                 "yet (the pair owns router lifecycle)")
 
     from tensorflow_examples_tpu.serving.router import (
         Router,
@@ -210,22 +250,62 @@ def main(argv=None) -> int:
         raise
 
     replica_urls = args.replica + [rep.url for rep in spawned]
-    router = Router(
-        replica_urls,
-        canary=args.canary,
-        cfg=RouterConfig(
-            probe_interval_s=args.probe_interval,
-            request_timeout_s=args.request_timeout,
-            retry_budget_s=args.retry_budget,
-            max_retries=args.max_retries,
-            hedge_after_s=args.hedge_after,
-            eject_after=args.eject_after,
-            eject_cooldown_s=args.eject_cooldown,
-            canary_fraction=args.canary_fraction,
-            prefix_affinity=not args.no_affinity,
-            affinity_load_gap=args.affinity_load_gap,
-        ),
-    ).start()
+    cfg = RouterConfig(
+        probe_interval_s=args.probe_interval,
+        request_timeout_s=args.request_timeout,
+        retry_budget_s=args.retry_budget,
+        max_retries=args.max_retries,
+        hedge_after_s=args.hedge_after,
+        eject_after=args.eject_after,
+        eject_cooldown_s=args.eject_cooldown,
+        canary_fraction=args.canary_fraction,
+        prefix_affinity=not args.no_affinity,
+        affinity_load_gap=args.affinity_load_gap,
+    )
+    pair = None
+    journal = None
+    if args.standby:
+        # ISSUE 16: warm-standby control plane. The pair owns both
+        # routers, the journal and the lease; the primary serves
+        # --port, the standby answers fenced 503s on --standby-port
+        # until it promotes itself on missed heartbeat.
+        from tensorflow_examples_tpu.serving.chaos import RouterPair
+
+        pair = RouterPair(
+            replica_urls,
+            journal_path=args.journal,
+            lease_path=args.lease or args.journal + ".lease",
+            router_cfg=cfg,
+            primary_port=args.port,
+            standby_port=args.standby_port,
+            miss_budget_s=args.heartbeat_miss,
+        ).start()
+        router = pair.primary
+        if pair.replayed_at_start:
+            print(
+                f"journal: replayed {pair.replayed_at_start} "
+                "incomplete intent(s) from a previous incarnation",
+                file=sys.stderr,
+            )
+    else:
+        if args.journal:
+            from tensorflow_examples_tpu.serving.journal import (
+                RequestJournal,
+            )
+
+            journal = RequestJournal(args.journal)
+            journal.refresh()
+        router = Router(
+            replica_urls, canary=args.canary, cfg=cfg, journal=journal
+        ).start()
+        if journal is not None:
+            replayed = router.replay_incomplete()
+            if replayed:
+                print(
+                    f"journal: replayed {replayed} incomplete "
+                    "intent(s) from a previous incarnation",
+                    file=sys.stderr,
+                )
     supervisor = None
     if spawned:
         supervisor = Supervisor(
@@ -236,6 +316,9 @@ def main(argv=None) -> int:
             warm_timeout_s=args.spawn_warm_timeout,
             max_restarts=args.max_restarts,
         ).start()
+        if pair is not None:
+            # Takeover re-points supervision at the promoted standby.
+            pair.supervisor = supervisor
     autoscaler = None
     if args.autoscale:
         # The spawn template: --spawn[0]'s command at the next free
@@ -272,7 +355,16 @@ def main(argv=None) -> int:
             f"{args.target_ttft_p95 or 'off'}",
             file=sys.stderr,
         )
-    frontend = RouterFrontend(router, port=args.port).start()
+    if pair is not None:
+        frontend = pair.primary_frontend  # started by pair.start()
+        print(
+            f"standby router on :{pair.standby_frontend.port} "
+            f"(fenced; promotes after {args.heartbeat_miss:.1f}s of "
+            "missed heartbeats)",
+            file=sys.stderr,
+        )
+    else:
+        frontend = RouterFrontend(router, port=args.port).start()
     # Role topology (ISSUE 12): heterogeneous prefill/decode fleets are
     # first-class — say what the probe sweep actually found, so a
     # mis-roled rollout is visible before it serves.
@@ -293,7 +385,8 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.append(1))
 
     def emit_stats():
-        line = json.dumps(router.stats_line())
+        live = pair.active_router if pair is not None else router
+        line = json.dumps(live.stats_line())
         if args.stats_out:
             with open(args.stats_out, "a") as f:
                 f.write(line + "\n")
@@ -316,7 +409,12 @@ def main(argv=None) -> int:
             autoscaler.close()
         if supervisor is not None:
             supervisor.close()
-        router.close()
+        if pair is not None:
+            pair.close()  # both routers + journal + lease monitor
+        else:
+            router.close()
+            if journal is not None:
+                journal.close()
         for rep in spawned:
             rep.close()
         if autoscaler is not None:

@@ -46,11 +46,17 @@ process fleets.
 from __future__ import annotations
 
 import collections
+import contextlib
 import json
 import logging
 import os
 import threading
 import time
+
+try:  # POSIX-only; the lease degrades to in-process locking without it
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX hosts
+    fcntl = None
 
 log = logging.getLogger(__name__)
 
@@ -127,6 +133,8 @@ class RequestJournal:
         self.appends = 0                   # guard: RequestJournal._lock
         self.invalid_lines = 0             # guard: RequestJournal._lock
         self.torn_tail = 0                 # guard: RequestJournal._lock
+        self.torn_tail_repaired = 0        # guard: RequestJournal._lock
+        self._torn_at: int | None = None   # guard: RequestJournal._lock (offset of last-seen fragment)
         self.dedup_evictions = 0           # guard: RequestJournal._lock
         self.refresh()
 
@@ -152,7 +160,15 @@ class RequestJournal:
             # not consumed, so a later refresh can pick it up whole.
             tail = lines.pop()
             if tail:
-                self.torn_tail += 1
+                # One crash (or slow write) = one count: the fragment
+                # grows in place across polls, so key the stat on where
+                # it STARTS, not on how many refreshes observed it.
+                start = self._read_pos + len(chunk) - len(tail)
+                if start != self._torn_at:
+                    self.torn_tail += 1
+                    self._torn_at = start
+            else:
+                self._torn_at = None
             self._read_pos += len(chunk) - len(tail)
             for raw in lines:
                 if not raw.strip():
@@ -200,6 +216,26 @@ class RequestJournal:
         if self._fh is None:
             self._fh = open(self.path, "ab")
             self._fh.seek(0, os.SEEK_END)
+            if self._fh.tell() > 0:
+                # A dead predecessor may have left a torn (newline-less)
+                # fragment at the tail. Appending straight onto it would
+                # weld OUR record to the fragment into one invalid line —
+                # silently discarding the new record for every reader.
+                # Terminate the fragment first: it becomes a complete
+                # invalid line (counted, never applied) and our append
+                # starts clean.
+                with open(self.path, "rb") as probe:
+                    probe.seek(-1, os.SEEK_END)
+                    last = probe.read(1)
+                if last != b"\n":
+                    self._fh.write(b"\n")
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self.torn_tail_repaired += 1
+                    log.warning(
+                        "journal %s: terminated a torn tail left by a "
+                        "dead writer before appending", self.path,
+                    )
         line = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
         self._fh.write(line)
         self._fh.flush()
@@ -296,6 +332,7 @@ class RequestJournal:
                 "dedup_evictions": self.dedup_evictions,
                 "invalid_lines": self.invalid_lines,
                 "torn_tail": self.torn_tail,
+                "torn_tail_repaired": self.torn_tail_repaired,
             }
 
     def close(self) -> None:
@@ -314,12 +351,41 @@ class Lease:
     refreshes ``ts`` only while the caller still holds the newest
     token; ``fenced(token)`` is the dispatch-time check — true once
     anyone acquired a newer token, at which point the stale holder must
-    refuse to serve (split-brain fencing)."""
+    refuse to serve (split-brain fencing).
+
+    ``acquire()`` and ``heartbeat()`` are read-modify-write sequences,
+    and the competing routers may be separate PROCESSES (``serve_fleet
+    --standby`` tails the same file across processes), so the in-process
+    ``threading.Lock`` alone cannot serialize them: a revived primary's
+    heartbeat could read its old token, pass the check, and
+    ``os.replace`` AFTER a standby's acquire wrote ``token + 1`` —
+    reverting the lease and un-fencing the old primary. Both verbs
+    therefore also hold an exclusive ``fcntl.flock`` on a sidecar
+    ``<path>.lock`` file for the whole read-check-write, making the
+    sequence atomic across processes on the same host (the only
+    deployment the file-based lease supports)."""
 
     def __init__(self, path: str, *, owner: str = "router"):
         self.path = path
         self.owner = owner
         self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def _exclusive(self):
+        """self._lock + an exclusive flock on the sidecar lock file:
+        the cross-process critical section for read-modify-write."""
+        with self._lock:
+            if fcntl is None:  # pragma: no cover - non-POSIX hosts
+                yield
+                return
+            fd = os.open(self.path + ".lock", os.O_CREAT | os.O_RDWR,
+                         0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                yield
+            finally:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                os.close(fd)
 
     def read(self) -> dict | None:
         """The current lease, or None (no file yet / unreadable —
@@ -348,7 +414,7 @@ class Lease:
     def acquire(self) -> int:
         """Take the lease with a strictly newer fencing token (the
         promotion verb; also the initial grant). Returns the token."""
-        with self._lock:
+        with self._exclusive():
             cur = self.read()
             token = (cur["token"] + 1) if cur else 1
             self._write_locked(
@@ -362,7 +428,7 @@ class Lease:
         """Refresh ``ts`` while still holding the newest token. False
         (and NO write) once fenced — a stale heartbeat must never
         clobber the new holder's lease."""
-        with self._lock:
+        with self._exclusive():
             cur = self.read()
             if cur is None or cur["token"] != token:
                 return False

@@ -1259,7 +1259,9 @@ class Router:
                 # dies mid-request, the successor's replay finds the
                 # intent and finishes the stream.
                 journal.append_intent(request_id, body)
-        feng = faults_mod.serve_active()
+        # killrouter@T counts GENERATE dispatches only (the fault
+        # grammar's spec): classify/score traffic must not advance T.
+        feng = faults_mod.serve_active() if kind == "generate" else None
         if feng is not None and feng.router_dispatch():
             # killrouter@T just hard-aborted THIS router (ISSUE 16
             # satellite): the client's connection is already reset —

@@ -268,8 +268,9 @@ def parse_serve_spec(spec: str) -> ServeFaultPlan:
     """Parse ``"crash@1:4,slowrep@0:0.2,transport@2:1,badhealth@0:3"``
     (``kind@replica:arg`` tokens, comma separated). The one
     router-side kind is ``killrouter@T`` — no replica index, just the
-    dispatch count T after which the active router's frontend is
-    hard-aborted."""
+    accepted-GENERATE-dispatch count T after which the active router's
+    frontend is hard-aborted (classify/score traffic never advances
+    T)."""
     crash: dict[int, int] = {}
     slow: dict[int, float] = {}
     transport: dict[int, int] = {}

@@ -172,6 +172,82 @@ class TestRequestJournal:
         assert r.refresh() == 1 and r.has_intent("r2")
         r.close()
 
+    def test_append_after_torn_tail_not_welded(self, tmp_path):
+        """A successor writer appending after a crash-torn tail must
+        terminate the dead writer's fragment first: welding the new
+        record onto the fragment would merge them into ONE invalid
+        line, silently discarding the new record for every reader."""
+        path = str(tmp_path / "j.jsonl")
+        w = RequestJournal(path)
+        full = w.append_intent("r1", _intent_body())
+        w.close()
+        # The old writer died mid-append: half a line, no newline.
+        frag = json.dumps(dict(full, request_id="r2"))
+        with open(path, "ab") as f:
+            f.write(frag[: len(frag) // 2].encode())
+        successor = RequestJournal(path)
+        successor.append_intent("r3", _intent_body(seed=9))
+        assert successor.stats()["torn_tail_repaired"] == 1
+        assert successor.has_intent("r3")
+        successor.close()
+        # Every fresh reader sees the successor's record intact; the
+        # dead writer's fragment is one complete invalid line, counted
+        # and never applied.
+        reader = RequestJournal(path)
+        assert reader.has_intent("r1") and reader.has_intent("r3")
+        assert not reader.has_intent("r2")
+        st = reader.stats()
+        assert st["invalid_lines"] == 1 and st["torn_tail"] == 0
+        reader.close()
+
+    def test_clean_tail_append_repairs_nothing(self, tmp_path):
+        """The repair path only fires on a torn tail: reopening a
+        cleanly-closed journal appends without touching the file."""
+        path = str(tmp_path / "j.jsonl")
+        w = RequestJournal(path)
+        w.append_intent("r1", _intent_body())
+        w.close()
+        again = RequestJournal(path)
+        again.append_intent("r2", _intent_body(seed=1))
+        assert again.stats()["torn_tail_repaired"] == 0
+        again.close()
+        reader = RequestJournal(path)
+        assert reader.stats()["invalid_lines"] == 0
+        assert reader.has_intent("r1") and reader.has_intent("r2")
+        reader.close()
+
+    def test_torn_tail_counted_once_per_fragment(self, tmp_path):
+        """One crash artifact = one count: a fragment that persists
+        across poll ticks (the standby refreshes every 0.25s) must not
+        inflate the stat once per refresh."""
+        path = str(tmp_path / "j.jsonl")
+        w = RequestJournal(path)
+        full = w.append_intent("r1", _intent_body())
+        w.close()
+        frag = json.dumps(dict(full, request_id="r2"))
+        with open(path, "ab") as f:
+            f.write(frag[:10].encode())
+        r = RequestJournal(path)
+        for _ in range(5):
+            r.refresh()
+        assert r.stats()["torn_tail"] == 1
+        # A merely-slow writer growing the SAME fragment in place is
+        # still the same single torn tail.
+        with open(path, "ab") as f:
+            f.write(frag[10:20].encode())
+        r.refresh()
+        assert r.stats()["torn_tail"] == 1
+        # Completing the line consumes it; a NEW fragment at a new
+        # offset is a second artifact.
+        with open(path, "ab") as f:
+            f.write((frag[20:] + "\n").encode())
+        assert r.refresh() == 1 and r.has_intent("r2")
+        with open(path, "ab") as f:
+            f.write(b'{"half')
+        r.refresh()
+        assert r.stats()["torn_tail"] == 2
+        r.close()
+
     def test_invalid_lines_counted_not_applied(self, tmp_path):
         path = str(tmp_path / "j.jsonl")
         with open(path, "w") as f:
@@ -282,6 +358,39 @@ class TestLease:
         lease.acquire()
         assert [p.name for p in tmp_path.glob("*.tmp.*")] == []
 
+    def test_concurrent_acquires_across_instances_stay_monotonic(
+        self, tmp_path
+    ):
+        """Separate Lease INSTANCES (each with its own threading.Lock —
+        the shape two router PROCESSES have) racing acquires: the
+        sidecar flock serializes the read-modify-write, so every grant
+        is a unique, strictly increasing token. Without it a revived
+        primary's heartbeat could read its old token, pass the check,
+        and clobber a standby's newer lease — reverting the fence."""
+        path = str(tmp_path / "l.json")
+        tokens = []
+        tlock = threading.Lock()
+
+        def work(owner):
+            lease = Lease(path, owner=owner)
+            for _ in range(10):
+                t = lease.acquire()
+                with tlock:
+                    tokens.append(t)
+
+        threads = [
+            threading.Thread(target=work, args=(f"r{k}",))
+            for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 40 serialized read-modify-writes: tokens 1..40, no duplicate
+        # (a duplicate = two holders believing they own the lease).
+        assert sorted(tokens) == list(range(1, 41))
+        assert os.path.exists(path + ".lock")
+
 
 class TestStandbyMonitor:
     """Promotion mechanics over a replica-less router — the fleet side
@@ -356,6 +465,50 @@ class TestStandbyMonitor:
             monitor.close()
             router.close()
             journal.close()
+
+
+class TestKillRouterCountsGeneratesOnly:
+    """``killrouter@T`` is specified in GENERATE dispatches: mixed
+    classify/score traffic must not advance T, or a chaos run kills
+    the router earlier than the fault spec says."""
+
+    def test_classify_never_advances_the_kill_count(
+        self, serve_faults, tmp_path
+    ):
+        engine = serve_faults("killrouter@1")
+        router = Router(
+            ["http://127.0.0.1:9"],
+            cfg=RouterConfig(
+                probe_interval_s=30.0, retry_budget_s=0.2,
+                max_retries=0, retry_backoff_s=0.01,
+                # Keep breaker/health ejection out of this test: the
+                # unreachable replica must stay nominally eligible so
+                # dispatches reach the fault hook, not the fast-fail.
+                eject_after=100, unhealthy_after=100,
+            ),
+        )
+        try:
+            # Classify dispatches reach the (unreachable) fleet and
+            # fail there — the router-kill hook never sees them.
+            for _ in range(3):
+                status, body = router.handle(
+                    {"prompt": [1, 2]}, kind="classify"
+                )
+                assert status == 503
+                assert "router killed" not in body.get("error", "")
+            assert not any(
+                k == "killrouter" for k, _, _ in engine.fired
+            )
+            # The first GENERATE dispatch is the one that fires it.
+            status, body = router.handle(
+                {"prompt": [1, 2], "max_new_tokens": 2},
+                kind="generate",
+            )
+            assert status == 503
+            assert "router killed" in body.get("error", "")
+            assert any(k == "killrouter" for k, _, _ in engine.fired)
+        finally:
+            router.close()
 
 
 class TestSchemaV12:

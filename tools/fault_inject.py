@@ -48,7 +48,8 @@ each replica's own decode-step/request/probe counters:
     badhealth@R:K   replica R's first K /health replies are non-JSON
                     garbage (the probe must mark it unhealthy)
     killrouter@T    ISSUE 16, no replica index: hard-abort the ACTIVE
-                    router's frontend after its Tth accepted dispatch
+                    router's frontend after its Tth accepted GENERATE
+                    dispatch — classify/score traffic never advances T
                     (clients see resets; the warm standby promotes and
                     replays the journal's incomplete intents)
 """

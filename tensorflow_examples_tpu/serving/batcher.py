@@ -98,6 +98,10 @@ from tensorflow_examples_tpu.serving.paged_kv import BlockExhausted
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry import schema
 from tensorflow_examples_tpu.telemetry.spans import span
+from tensorflow_examples_tpu.telemetry.tracing import (
+    ExemplarStore,
+    close_span,
+)
 
 log = logging.getLogger(__name__)
 
@@ -148,6 +152,14 @@ class Request:
     #                              interactive is served first
     #                              everywhere; batch absorbs shedding
     #                              and preemption first
+    trace: dict | None = None    # ISSUE 18: the router's trace context
+    #                              ({"trace_id", "parent_span_id",
+    #                              "sampled"}). When set, the batcher
+    #                              collects this request's spans
+    #                              (queue_wait, prefill chunks, decode
+    #                              segments, preemptions) and returns
+    #                              them on Result.spans; None costs the
+    #                              hot path nothing.
 
 
 @dataclasses.dataclass
@@ -173,13 +185,19 @@ class Result:
     # Disaggregated prefill (ISSUE 12): the serialized KV pages a
     # kind="prefill" request resolves with (None otherwise).
     pages: dict | None = None
+    # ISSUE 18: this request's replica-side span dicts (None when the
+    # request carried no trace context). The frontend returns them as
+    # the reply's "trace_spans"; top-level spans carry parent_id=None
+    # and the router reparents them under its dispatch span.
+    spans: list | None = None
 
 
 class _InFlight:
     __slots__ = (
         "req", "future", "slot", "t_submit", "t_admit", "t_first",
         "deadline", "tokens", "last_token", "spec_drafted",
-        "spec_accepted", "max_new_eff",
+        "spec_accepted", "max_new_eff", "spans", "t_decode0",
+        "decode_seg", "decode_tok0",
     )
 
     def __init__(self, req: Request, future, t_submit: float):
@@ -195,6 +213,13 @@ class _InFlight:
         )
         self.tokens: list[int] = []
         self.last_token: int | None = None
+        # ISSUE 18 trace collection (None = untraced, zero overhead).
+        # The span list SURVIVES preemption resets below — a preempted
+        # request's trace shows every decode segment it lived through.
+        self.spans: list | None = [] if req.trace is not None else None
+        self.t_decode0: float | None = None  # current decode segment t0
+        self.decode_seg = 0
+        self.decode_tok0 = 0  # committed tokens at segment start
         # Per-request speculation accounting (ISSUE 11): drafts offered
         # to verify steps and drafts accepted. Committed tokens ==
         # len(tokens) always — acceptance is a speed story, never a
@@ -266,6 +291,11 @@ class ContinuousBatcher:
                 getattr(cfg, "brownout_max_new_tokens", 8)
             ),
         )
+        # ISSUE 18: worst-recent TTFT/e2e observations with their
+        # trace_id, exposed as /metrics exemplars. Per-INSTANCE (not
+        # module-global): in-proc fleets share one process, and a
+        # shared store would cross-pollute replicas' exemplars.
+        self.exemplars = ExemplarStore()
         self._active: dict[int, _InFlight] = {}
         # Chunked prefills in flight (ISSUE 12): slot -> (item, engine
         # ChunkedPrefill state). One chunk runs per decode-loop
@@ -784,6 +814,16 @@ class ContinuousBatcher:
         self._active.pop(slot, None)
         self.engine.pool.free(slot)
         self._drop_draft(slot)
+        if item.spans is not None:
+            if item.t_decode0 is not None:
+                self._close_decode_segment(item, preempted=True)
+            else:
+                # Evicted mid-prefill: a point marker keeps the
+                # preemption visible (and forced-kept) in the trace.
+                item.spans.append(close_span(
+                    "preempted", time.monotonic(),
+                    tags={"preempted": True, "phase": "prefill"},
+                ))
         # Full reset: re-admission replays prefill + decode from the
         # prompt (same tokens by seeding); the original t_submit keeps
         # queue-wait/deadline accounting honest about the total wait.
@@ -833,6 +873,15 @@ class ContinuousBatcher:
         reg.histogram(
             f"serving/queue_wait_{req.slo}"
         ).record(now - item.t_submit)
+        if item.spans is not None:
+            # ISSUE 18: the queue-wait span carries the brownout rung
+            # in force AT ADMISSION — a brownout_level tag > 0 is a
+            # forced-keep signal for the tail sampler.
+            item.spans.append(close_span(
+                "queue_wait", item.t_submit,
+                tags={"slo": req.slo,
+                      "brownout_level": self._overload.level},
+            ))
         cap = self._overload.max_new_cap()
         if cap is not None and req.kind in ("generate", "resume"):
             # Brownout level 2 (ISSUE 13): cap the generation budget at
@@ -843,13 +892,24 @@ class ContinuousBatcher:
             # Disaggregated decode (ISSUE 12): no prefill — map the
             # handed-off KV pages in and continue the stream from the
             # prefill replica's first token.
+            t_import = time.monotonic()
             with span("serve_resume", tokens=len(req.prompt)):
                 self.engine.import_kv_pages(slot, req.pages, req.prompt)
             item.t_first = time.monotonic()
+            if item.spans is not None:
+                item.spans.append(close_span(
+                    "resume_import", t_import,
+                    tags={"tokens": len(req.prompt)},
+                ))
             ttft = item.t_first - item.t_submit
             reg.histogram("serving/ttft").record(ttft)
             reg.histogram(f"serving/ttft_{req.slo}").record(ttft)
             self._overload.note_ttft(ttft)
+            if item.spans is not None:
+                self.exemplars.record(
+                    "serving/ttft", ttft, req.trace["trace_id"]
+                )
+                self._start_decode_segment(item)
             item.tokens.append(req.first_token)
             item.last_token = req.first_token
             if self._draft is not None:
@@ -896,6 +956,30 @@ class ContinuousBatcher:
         reg.histogram("serving/prefill").record(time.perf_counter() - t0)
         self._finish_prefill(item, first, last_logits)
 
+    def _start_decode_segment(self, item: _InFlight) -> None:
+        """Open a decode segment span (traced requests only): one
+        continuous slot residency. Preemption closes it; re-admission
+        opens the next — a preempted request's trace shows each
+        segment it decoded through."""
+        item.t_decode0 = time.monotonic()
+        item.decode_seg += 1
+        item.decode_tok0 = len(item.tokens)
+
+    def _close_decode_segment(self, item: _InFlight, *,
+                              preempted: bool = False) -> None:
+        if item.spans is None or item.t_decode0 is None:
+            return
+        tags = {
+            "segment": item.decode_seg,
+            "tokens": len(item.tokens) - item.decode_tok0,
+        }
+        if preempted:
+            tags["preempted"] = True
+        item.spans.append(
+            close_span("decode_segment", item.t_decode0, tags=tags)
+        )
+        item.t_decode0 = None
+
     def _finish_prefill(self, item: _InFlight, first: int,
                         last_logits) -> None:
         """Shared tail of single-shot and chunked prefill: record TTFT
@@ -909,6 +993,18 @@ class ContinuousBatcher:
         reg.histogram("serving/ttft").record(ttft)
         reg.histogram(f"serving/ttft_{req.slo}").record(ttft)
         self._overload.note_ttft(ttft)
+        if item.spans is not None:
+            # Admission-to-first-token: single-shot this is the one
+            # prefill dispatch; chunked, it brackets the per-chunk
+            # spans (decode steps interleave inside — that is the
+            # chunking's point and the span shows it).
+            item.spans.append(close_span(
+                "prefill", item.t_admit,
+                tags={"prompt_tokens": len(req.prompt)},
+            ))
+            self.exemplars.record(
+                "serving/ttft", ttft, req.trace["trace_id"]
+            )
         if req.kind == "classify":
             from tensorflow_examples_tpu.serving.engine import top_logprobs
 
@@ -942,6 +1038,8 @@ class ContinuousBatcher:
             return
         item.tokens.append(first)
         item.last_token = first
+        if item.spans is not None:
+            self._start_decode_segment(item)
         if self._draft is not None:
             # The drafter's context: prompt + everything committed.
             self._draft.begin(slot, list(req.prompt) + [first])
@@ -977,6 +1075,7 @@ class ContinuousBatcher:
                     "mid-chunked-prefill"
                 ))
             return
+        t_chunk = time.monotonic()
         try:
             with span("serve_prefill_chunk"):
                 done, first, last_logits = self.engine.prefill_step(state)
@@ -992,6 +1091,10 @@ class ContinuousBatcher:
             if isinstance(e, EngineStepError):
                 self._fail_active(e)
             return
+        if item.spans is not None:
+            item.spans.append(close_span(
+                "prefill_chunk", t_chunk, tags={"chunk": state.idx}
+            ))
         if not done:
             return
         del self._prefilling[slot]
@@ -1037,6 +1140,7 @@ class ContinuousBatcher:
         if item.slot is not None:
             self.engine.pool.free(item.slot)
             self._drop_draft(item.slot)
+        self._close_decode_segment(item)
         self._resolve(
             item,
             Result(
@@ -1072,6 +1176,12 @@ class ContinuousBatcher:
             result.tokens
         )
         reg.counter("serving/generated_tokens_total").inc(generated)
+        if item.spans is not None:
+            result.spans = item.spans
+            self.exemplars.record(
+                "serving/e2e", result.total_s,
+                item.req.trace["trace_id"],
+            )
         if not item.future.set_running_or_notify_cancel():
             return  # caller gave up; nothing to deliver
         item.future.set_result(result)

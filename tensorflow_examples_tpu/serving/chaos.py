@@ -49,6 +49,7 @@ from tensorflow_examples_tpu.serving.router import (
 )
 from tensorflow_examples_tpu.serving.supervisor import Supervisor
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
+from tensorflow_examples_tpu.telemetry import tracing as tracing_mod
 from tensorflow_examples_tpu.utils import faults as faults_mod
 
 log = logging.getLogger(__name__)
@@ -330,13 +331,21 @@ class RouterPair:
             eject_after=2,
             eject_cooldown_s=1.0,
         )
+        # ONE trace recorder for both incarnations (ISSUE 18): the
+        # journal stamps each intent/done with its trace_id, so a
+        # takeover-survived request's post-promotion spans MERGE into
+        # the trace the dead primary opened — a shared recorder is
+        # what makes that merge land in one stitched tree (and keeps
+        # /trace/{id} answering on whichever frontend is asked).
+        self.recorder = tracing_mod.TraceRecorder(registry=self.registry)
         self.primary = Router(
             list(urls), cfg=self.cfg, registry=self.registry,
             journal=self.journal, lease=self.lease,
+            recorder=self.recorder,
         )
         self.standby = Router(
             list(urls), cfg=self.cfg, registry=self.registry,
-            journal=self.journal,
+            journal=self.journal, recorder=self.recorder,
         )
         self.primary_frontend = RouterFrontend(
             self.primary, port=primary_port

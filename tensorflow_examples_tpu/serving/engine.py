@@ -66,6 +66,7 @@ from tensorflow_examples_tpu.ops.attention import NEG_INF, attention_reference
 from tensorflow_examples_tpu.serving import kv_cache as kv_mod
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry.compilation import CompilationSentinel
+from tensorflow_examples_tpu.telemetry.spans import span as host_span
 from tensorflow_examples_tpu.utils import faults as faults_mod
 
 log = logging.getLogger(__name__)
@@ -1279,9 +1280,16 @@ class InferenceEngine:
         and :class:`EngineStepError` surfaces — the one place the
         donation-recovery contract lives (prefill/extend, decode, and
         verify all route through it; the batcher fails the whole
-        in-flight set on the error)."""
+        in-flight set on the error).
+
+        Every dispatch runs inside a host-side span
+        (``span/engine_{kind}_dispatch``, ISSUE 18): the compiled call
+        returns un-synced device arrays, so the span measures DISPATCH
+        wall only — tracing adds no device sync and no new compiled
+        programs (the zero-recompile sentinel stays golden-pinned)."""
         try:
-            return fn(*args)
+            with host_span(f"engine_{kind}_dispatch"):
+                return fn(*args)
         except Exception as e:
             self.pool.reallocate()
             raise EngineStepError(

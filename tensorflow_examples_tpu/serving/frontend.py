@@ -160,6 +160,10 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
         # accepted and ignored here (a single replica regenerates
         # deterministically anyway).
         "request_id", "resume_from",
+        # ISSUE 18: the router's traceparent-style context. A traced
+        # request's replica-side spans come back in the reply under
+        # "trace_spans"; an untraced body costs nothing.
+        "trace",
     }
     if kind == "resume":
         known |= {"pages", "first_token"}
@@ -221,6 +225,16 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
             number("skip_tokens", 0, int, 0) if kind == "prefill" else 0
         ),
         slo=slo,
+        # Tolerant parse: a malformed context disables tracing for
+        # this request, never fails it (same contract as the router's
+        # TraceContext.from_wire).
+        trace=(
+            body["trace"]
+            if isinstance(body.get("trace"), dict)
+            and isinstance(body["trace"].get("trace_id"), str)
+            and body["trace"]["trace_id"]
+            else None
+        ),
     )
 
 
@@ -321,6 +335,12 @@ class ServingFrontend:
             reply["tokens"] = result.tokens
             if self.tokenizer is not None:
                 reply["text"] = self.tokenizer.decode(result.tokens)
+        if result.spans:
+            # ISSUE 18: the replica's per-request spans ride the reply
+            # — the router (or a direct client) adopts them into the
+            # request's trace tree. No shared memory assumed, so
+            # in-proc and cross-process fleets stitch identically.
+            reply["trace_spans"] = result.spans
         return 200, reply
 
     def health_payload(self) -> tuple[int, dict]:
@@ -460,7 +480,8 @@ class ServingFrontend:
                             200,
                             "text/plain; version=0.0.4; charset=utf-8",
                             render_prometheus(
-                                server.batcher.registry
+                                server.batcher.registry,
+                                exemplars=server.batcher.exemplars,
                             ).encode(),
                         )
                     elif path == "/health":

@@ -106,6 +106,15 @@ def validate_record(rec) -> list[str]:
             problems.append("done tokens must be a list")
         if not isinstance(rec.get("status"), int):
             problems.append("done status must be an int")
+    # ISSUE 18: intent/done records may carry the request's trace_id —
+    # OPTIONAL (no journal version bump: readers ignore unknown extra
+    # fields by construction), but typed when present. This is what
+    # stitches a takeover-survived request's trace across routers: the
+    # successor's dedupe/replay recovers the original trace_id from
+    # here and continues THAT trace instead of forking a new one.
+    tid = rec.get("trace_id")
+    if tid is not None and (not isinstance(tid, str) or not tid):
+        problems.append("trace_id must be a non-empty string when present")
     return problems
 
 
@@ -247,11 +256,14 @@ class RequestJournal:
             self.registry.counter("router/journal_appends_total").inc()
         return rec
 
-    def append_intent(self, request_id: str, body: dict) -> dict:
+    def append_intent(self, request_id: str, body: dict, *,
+                      trace_id: str | None = None) -> dict:
         """Journal one accepted generate request — everything replay
         needs to reproduce the stream bit-identically (generation is a
         pure function of (params, prompt, seed)), plus the SLO class
-        and a tenant-ready key for the multi-tenant roadmap item."""
+        and a tenant-ready key for the multi-tenant roadmap item.
+        ``trace_id`` (ISSUE 18) stamps the request's trace so a
+        successor router's replay continues the SAME trace."""
         rec = {
             "rec": "intent", "v": JOURNAL_VERSION,
             "request_id": str(request_id),
@@ -264,6 +276,8 @@ class RequestJournal:
             "tenant": str(body.get("tenant", "default")),
             "ts": time.time(),
         }
+        if trace_id:
+            rec["trace_id"] = str(trace_id)
         with self._lock:
             return self._append_locked(rec)
 
@@ -277,16 +291,21 @@ class RequestJournal:
         with self._lock:
             return self._append_locked(rec)
 
-    def append_done(self, request_id: str, tokens, status: int) -> dict:
+    def append_done(self, request_id: str, tokens, status: int, *,
+                    trace_id: str | None = None) -> dict:
         """Journal a request's final stream. The done record is also
         the dedupe window's entry: a duplicated ``request_id`` retry is
-        answered from here, not the fleet."""
+        answered from here, not the fleet — and its ``trace_id``
+        (ISSUE 18, optional) is what joins the dedupe fast path's
+        spans onto the ORIGINAL request's trace."""
         rec = {
             "rec": "done", "v": JOURNAL_VERSION,
             "request_id": str(request_id),
             "tokens": [int(t) for t in tokens],
             "status": int(status), "ts": time.time(),
         }
+        if trace_id:
+            rec["trace_id"] = str(trace_id)
         with self._lock:
             return self._append_locked(rec)
 

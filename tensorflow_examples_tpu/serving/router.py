@@ -111,6 +111,7 @@ import uuid
 
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry import tracing as tracing_mod
 from tensorflow_examples_tpu.telemetry.serve import (
     json_safe,
     render_prometheus,
@@ -151,6 +152,13 @@ class RouterConfig:
     #                                 only preferred while its load
     #                                 score is within this gap of the
     #                                 least-loaded eligible replica
+    trace_sample_fraction: float = 0.01  # ISSUE 18 tail sampler: the
+    #                                 seeded deterministic share of
+    #                                 NORMAL traffic kept (slow/error/
+    #                                 retried/failed-over/hedged/
+    #                                 preempted/deduped/resumed/
+    #                                 brownout traces are ALWAYS kept)
+    trace_seed: int = 0             # the seeded fraction's hash salt
 
 
 def _as_object(status: int, body) -> tuple[int, dict]:
@@ -200,6 +208,24 @@ def post_json(url: str, body: dict, timeout: float) -> tuple[int, dict]:
         # 503 (retryable on another replica) and the probe loop will
         # notice a dead replica on its own.
         return 0, {"error": f"{type(e).__name__}: {e}"}
+
+
+class _TraceState:
+    """Per-request trace bookkeeping threaded through the dispatch
+    path (ISSUE 18): the trace id, the router's root ``request`` span
+    id, the incoming parent span (when the CLIENT originated the
+    context), the SLO class, and the forced-keep flags the dispatch
+    loop accumulates (retried / failover / hedged)."""
+
+    __slots__ = ("trace_id", "root_id", "parent_id", "slo", "flags")
+
+    def __init__(self, trace_id: str, root_id: str,
+                 parent_id: str | None, slo: str):
+        self.trace_id = trace_id
+        self.root_id = root_id
+        self.parent_id = parent_id
+        self.slo = slo
+        self.flags: set = set()
 
 
 class ReplicaState:
@@ -409,6 +435,8 @@ class Router:
         journal=None,
         lease=None,
         fencing_token: int = 0,
+        recorder=None,
+        trace_path: str | None = None,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica URL")
@@ -441,6 +469,21 @@ class Router:
             journal.registry = self.registry
         self._lease = lease
         self._fencing_token = int(fencing_token)
+        # Per-request tracing (ISSUE 18): the recorder mints/accepts
+        # trace contexts in handle(), assembles each request's span
+        # tree from the router's own dispatch/leg spans plus the
+        # replica-returned ones, and tail-samples at finish. Inject a
+        # SHARED recorder (chaos.RouterPair does) so a takeover's
+        # successor stitches onto the primary's traces in place.
+        self._owns_recorder = recorder is None
+        self.recorder = (
+            recorder if recorder is not None
+            else tracing_mod.TraceRecorder(
+                registry=self.registry, path=trace_path,
+                sample_fraction=self.cfg.trace_sample_fraction,
+                seed=self.cfg.trace_seed,
+            )
+        )
 
     def attach_lease(self, lease, token: int) -> None:
         """(Re)bind this router to the active-router lease at fencing
@@ -592,6 +635,10 @@ class Router:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._owns_recorder:
+            # An injected (shared) recorder outlives this router — the
+            # RouterPair's successor is still finishing traces into it.
+            self.recorder.close()
 
     # ------------------------------------------------ elastic fleet (ISSUE 13)
 
@@ -915,8 +962,9 @@ class Router:
         return status, reply
 
     def _dispatch(self, primary: ReplicaState, body: dict, kind: str,
-                  set_name: str | None,
-                  tried: list) -> tuple[int, dict]:
+                  set_name: str | None, tried: list, tr=None,
+                  parent_span_id: str | None = None
+                  ) -> tuple[int, dict]:
         """One dispatch attempt — hedged when ``hedge_after_s`` is set:
         if the primary has not answered by the hedge deadline, the
         request is sent again to another replica; the first 200 wins
@@ -950,9 +998,25 @@ class Router:
         tried.append(hedge)
         self.registry.counter("router/hedges_total").inc()
         self.registry.counter("router/dispatched_total").inc()
+        t_hedge = time.monotonic()
+        if tr is not None:
+            tr.flags.add("hedged")
         threading.Thread(
             target=run, args=(hedge,), name="router-hedge", daemon=True,
         ).start()
+
+        def hedge_span(won: bool):
+            # The hedge leg is router-side bookkeeping: its span hangs
+            # off the ATTEMPT that spawned it, tagged with whether the
+            # hedge's reply was the one that answered the client.
+            if tr is not None:
+                self.recorder.add_span(
+                    tr.trace_id, tracing_mod.close_span(
+                        "hedge", t_hedge, parent_id=parent_span_id,
+                        tags={"replica": hedge.url, "won": won},
+                    )
+                )
+
         first_failure = None
         for arrival in range(2):
             rep, status, reply = results.get()
@@ -968,9 +1032,11 @@ class Router:
                     self.registry.counter(
                         "router/hedge_wins_total"
                     ).inc()
+                hedge_span(won=rep is hedge)
                 return status, reply
             if first_failure is None:
                 first_failure = (status, reply)
+        hedge_span(won=False)
         return first_failure
 
     # ------------------------------------- disaggregated roles (ISSUE 12)
@@ -1002,7 +1068,8 @@ class Router:
         return "prefill" in roles and "decode" in roles
 
     def _leg(self, body: dict, kind: str, role: str | None,
-             prompt, key_cache: dict | None = None) -> dict | None:
+             prompt, key_cache: dict | None = None,
+             tr=None) -> dict | None:
         """One handoff leg with the same bounded-retry discipline as
         the full path (different replica per attempt, leg-scoped wall
         budget); None when the leg cannot complete — the caller falls
@@ -1028,7 +1095,41 @@ class Router:
                 return None
             tried.append(r)
             reg.counter("router/dispatched_total").inc()
-            status, reply = self._send_to(r, body, kind)
+            send = body
+            span_id = None
+            t_att = time.monotonic()
+            if tr is not None:
+                # Same per-attempt discipline as the full path: each
+                # leg attempt gets its own span and hands the replica
+                # a context parented under it, so a handoff trace
+                # shows prefill and resume legs side by side with
+                # their replica-side segments nested inside.
+                span_id = tracing_mod.new_span_id()
+                send = dict(body)
+                send["trace"] = {
+                    "trace_id": tr.trace_id,
+                    "parent_span_id": span_id,
+                    "sampled": True,
+                }
+            status, reply = self._send_to(r, send, kind)
+            if tr is not None:
+                rspans = reply.pop("trace_spans", None) \
+                    if isinstance(reply, dict) else None
+                if rspans:
+                    self.recorder.ingest(
+                        tr.trace_id, rspans, parent_id=span_id
+                    )
+                self.recorder.add_span(
+                    tr.trace_id, tracing_mod.close_span(
+                        f"{kind}_leg", t_att, parent_id=tr.root_id,
+                        span_id=span_id, tags={
+                            "replica": r.url,
+                            "role": role or "any",
+                            "attempt": attempts + 1,
+                            "status": int(status),
+                        },
+                    )
+                )
             if status == 200:
                 return reply
             if (
@@ -1038,10 +1139,14 @@ class Router:
             ):
                 attempts += 1
                 reg.counter("router/retries_total").inc()
+                if tr is not None:
+                    tr.flags.add("retried")
                 if status == 0:
                     # The role-holder died mid-leg: in-flight failover,
                     # same accounting as the full path.
                     reg.counter("router/failovers_total").inc()
+                    if tr is not None:
+                        tr.flags.add("failover")
                 backoff = self.cfg.retry_backoff_s * (2 ** (attempts - 1))
                 remaining = self.cfg.retry_budget_s - (
                     time.monotonic() - t0
@@ -1086,8 +1191,8 @@ class Router:
         return best or 0
 
     def _handle_disagg(self, body: dict, prompt,
-                       key_cache: dict | None = None
-                       ) -> tuple[int, dict] | None:
+                       key_cache: dict | None = None,
+                       tr=None) -> tuple[int, dict] | None:
         """Prefill/decode handoff: run the prompt on a prefill-role
         replica (affinity applies — that is where the prefix caches
         live), ship the returned KV pages to a decode-role replica's
@@ -1108,7 +1213,8 @@ class Router:
         if skip:
             pbody = dict(body)
             pbody["skip_tokens"] = skip
-        preply = self._leg(pbody, "prefill", "prefill", prompt, key_cache)
+        preply = self._leg(pbody, "prefill", "prefill", prompt,
+                           key_cache, tr)
         if (
             not isinstance(preply, dict)
             or not isinstance(preply.get("pages"), dict)
@@ -1124,7 +1230,7 @@ class Router:
         # (one copy, cold-tail-only scatter) instead of spreading N
         # copies across the decode tier.
         dreply = self._leg(res_body, "resume", "decode", prompt,
-                           key_cache)
+                           key_cache, tr)
         if not isinstance(dreply, dict):
             return None
         self.registry.counter("router/handoffs_total").inc()
@@ -1197,6 +1303,28 @@ class Router:
                     "error": "'resume_from' must be a non-negative "
                              "committed-token offset"
                 }
+        # Per-request tracing (ISSUE 18): accept the client's wire
+        # context or mint one; the "trace" body field is the router's
+        # to own from here (each dispatch attempt re-issues it with
+        # that attempt's span as the parent).
+        tr: _TraceState | None = None
+        if kind == "generate":
+            wire = body.get("trace")
+            if "trace" in body:
+                body = dict(body)
+                body.pop("trace")
+            if not isinstance(wire, dict):
+                wire = None
+            ctx = self.recorder.new_context(wire)
+            parent = (wire or {}).get("parent_span_id")
+            tr = _TraceState(
+                ctx.trace_id,
+                tracing_mod.new_span_id(),
+                parent if isinstance(parent, str) and parent else None,
+                body.get("slo")
+                if body.get("slo") in ("interactive", "batch")
+                else "interactive",
+            )
         if self.fenced():
             # Split-brain pin (ISSUE 16): a stalled-then-revived
             # primary must never dispatch against the fleet a promoted
@@ -1209,6 +1337,7 @@ class Router:
                 "fenced": True, "retry": True, "shed": True,
             }
             reg.histogram("router/e2e").record(time.monotonic() - t0)
+            self._trace_finish(tr, 503, reply, t0)
             return 503, reply
         journal = self.journal if kind == "generate" else None
         if journal is not None and request_id is not None:
@@ -1230,6 +1359,23 @@ class Router:
                 reg.histogram("router/e2e").record(
                     time.monotonic() - t0
                 )
+                if tr is not None:
+                    # The stitch (ISSUE 18): the journal's done record
+                    # carries the ORIGINAL request's trace_id — adopt
+                    # it, so the dedupe fast path's spans JOIN that
+                    # trace (across routers too: a takeover successor
+                    # shares the journal) instead of forking a new one.
+                    self.recorder.add_span(
+                        tr.trace_id, tracing_mod.close_span(
+                            "dedupe_hit", t0, parent_id=tr.root_id,
+                            tags={"request_id": request_id},
+                        )
+                    )
+                    orig_tid = hit.get("trace_id")
+                    if isinstance(orig_tid, str) and orig_tid:
+                        self.recorder.adopt(tr.trace_id, orig_tid)
+                        tr.trace_id = orig_tid
+                self._trace_finish(tr, 200, reply, t0)
                 return 200, reply
         if self.fleet_down():
             # Fast-fail (ISSUE 13 satellite): a fleet-wide outage
@@ -1244,6 +1390,7 @@ class Router:
             }
             self._set_stats["base"].record(503, reply)
             reg.histogram("router/e2e").record(time.monotonic() - t0)
+            self._trace_finish(tr, 503, reply, t0)
             return 503, reply
         prompt = self._clean_prompt(body)
         if journal is not None and prompt is None:
@@ -1257,8 +1404,13 @@ class Router:
             if not journal.has_intent(request_id):
                 # Accepted = journaled, BEFORE dispatch: if this router
                 # dies mid-request, the successor's replay finds the
-                # intent and finishes the stream.
-                journal.append_intent(request_id, body)
+                # intent and finishes the stream — and the stamped
+                # trace_id (ISSUE 18) makes that replay continue THIS
+                # trace rather than start one of its own.
+                journal.append_intent(
+                    request_id, body,
+                    trace_id=tr.trace_id if tr is not None else None,
+                )
         # killrouter@T counts GENERATE dispatches only (the fault
         # grammar's spec): classify/score traffic must not advance T.
         feng = faults_mod.serve_active() if kind == "generate" else None
@@ -1267,10 +1419,12 @@ class Router:
             # satellite): the client's connection is already reset —
             # leave the intent incomplete for the successor's journal
             # replay instead of racing a dispatch against takeover.
-            return 503, {
+            reply = {
                 "error": "router killed (injected fault)", "retry": True,
             }
-        status, reply = self._handle_dispatch(body, kind, t0, prompt)
+            self._trace_finish(tr, 503, reply, t0)
+            return 503, reply
+        status, reply = self._handle_dispatch(body, kind, t0, prompt, tr)
         if status == 200 and journal is not None and isinstance(
             reply.get("tokens"), list
         ):
@@ -1284,7 +1438,8 @@ class Router:
                     request_id, len(reply["tokens"])
                 )
                 journal.append_done(
-                    request_id, reply["tokens"], status
+                    request_id, reply["tokens"], status,
+                    trace_id=tr.trace_id if tr is not None else None,
                 )
         if status == 200 and isinstance(reply.get("tokens"), list):
             if resume_from:
@@ -1298,17 +1453,45 @@ class Router:
                 reply["resume_from"] = resume_from
             if request_id is not None:
                 reply.setdefault("request_id", request_id)
+        self._trace_finish(tr, status, reply, t0)
         return status, reply
 
+    def _trace_finish(self, tr, status: int, reply: dict,
+                      t0: float) -> None:
+        """Close the request's root span and hand the trace to the
+        tail sampler (ISSUE 18). Every handle() exit path for a traced
+        request funnels through here exactly once — including the
+        dedupe fast path, where finish() MERGES into the original
+        request's stored trace instead of forking a new one."""
+        if tr is None:
+            return
+        e2e = time.monotonic() - t0
+        self.recorder.add_span(
+            tr.trace_id, tracing_mod.close_span(
+                "request", t0, span_id=tr.root_id,
+                parent_id=tr.parent_id, tags={"status": int(status)},
+            )
+        )
+        if reply.get("dedup"):
+            tr.flags.add("deduped")
+        if reply.get("resumed"):
+            tr.flags.add("resumed")
+        self.recorder.finish(
+            tr.trace_id, slo=tr.slo, status=int(status), e2e_s=e2e,
+            flags=tr.flags,
+        )
+        self.recorder.exemplars.record("router/e2e", e2e, tr.trace_id)
+        reply.setdefault("trace_id", tr.trace_id)
+
     def _handle_dispatch(self, body: dict, kind: str, t0: float,
-                         prompt) -> tuple[int, dict]:
+                         prompt, tr=None) -> tuple[int, dict]:
         """The dispatch core handle() wraps: disagg handoff first,
         then the canary-aware bounded-retry loop."""
         reg = self.registry
         key_cache: dict = {}  # prompt chain keys, hashed once per request
         if kind == "generate" and prompt is not None \
                 and self._disagg_ready():
-            out = self._handle_disagg(body, prompt, key_cache)
+            out = self._handle_disagg(body, prompt, key_cache, tr)
             if out is not None:
                 status, reply = out
                 self._set_stats["base"].record(status, reply)
@@ -1374,9 +1557,48 @@ class Router:
                 break
             tried.append(r)
             reg.counter("router/dispatched_total").inc()
+            send = body
+            span_id = None
+            t_att = time.monotonic()
+            if tr is not None:
+                # Each attempt gets its OWN span and re-issues the
+                # wire context with that span as the parent, so the
+                # replica's spans nest under the attempt that actually
+                # carried them — a failover trace shows both the dead
+                # dispatch and the one that answered.
+                span_id = tracing_mod.new_span_id()
+                send = dict(body)
+                send["trace"] = {
+                    "trace_id": tr.trace_id,
+                    "parent_span_id": span_id,
+                    "sampled": True,
+                }
             status, reply = self._dispatch(
-                r, body, kind, set_name, tried
+                r, send, kind, set_name, tried, tr=tr,
+                parent_span_id=span_id,
             )
+            if tr is not None:
+                rspans = reply.pop("trace_spans", None) \
+                    if isinstance(reply, dict) else None
+                if rspans:
+                    self.recorder.ingest(
+                        tr.trace_id, rspans, parent_id=span_id
+                    )
+                outcome = "ok" if status == 200 else (
+                    "transport" if status == 0 else str(status)
+                )
+                self.recorder.add_span(
+                    tr.trace_id, tracing_mod.close_span(
+                        "dispatch", t_att, parent_id=tr.root_id,
+                        span_id=span_id, tags={
+                            "replica": r.url,
+                            "set": r.set_name or "base",
+                            "attempt": attempts + 1,
+                            "status": int(status),
+                            "outcome": outcome,
+                        },
+                    )
+                )
             if status == 200:
                 break
             if status in (0, 503):
@@ -1386,11 +1608,15 @@ class Router:
                 )
                 if attempts <= self.cfg.max_retries and within_budget:
                     reg.counter("router/retries_total").inc()
+                    if tr is not None:
+                        tr.flags.add("retried")
                     if status == 0:
                         # The replica died with the request possibly
                         # mid-decode: replay it from the prompt
                         # elsewhere.
                         reg.counter("router/failovers_total").inc()
+                        if tr is not None:
+                            tr.flags.add("failover")
                     backoff = self.cfg.retry_backoff_s * (
                         2 ** (attempts - 1)
                     )
@@ -1436,6 +1662,14 @@ class Router:
                 "slo": intent["slo"],
                 "request_id": intent["request_id"],
             }
+            if intent.get("trace_id"):
+                # Continue the dead router's trace (ISSUE 18): the
+                # replay's spans MERGE into the original trace_id the
+                # intent carries, so a takeover-survived request reads
+                # as one tree across both routers.
+                body["trace"] = {
+                    "trace_id": intent["trace_id"], "sampled": True,
+                }
             status, _ = self.handle(body, kind="generate")
             if status == 200:
                 replayed += 1
@@ -1472,6 +1706,10 @@ class Router:
             k: v for k, v in self.registry.gauge_values().items()
             if k.startswith("router/")
         }
+        # Taken OUTSIDE self._lock: the recorder has its own lock and
+        # nesting the two would order them router->recorder here while
+        # the dispatch path orders recorder-only — keep them disjoint.
+        tstats = self.recorder.stats()
         with self._lock:
             # One consistent fleet snapshot: the probe loop rewrites
             # these fields mid-sweep, and a line aggregated across a
@@ -1555,6 +1793,14 @@ class Router:
                 "takeover_latency_s": float(
                     gauges.get("router/takeover_latency_s", 0.0)
                 ),
+                # --- v13 (ISSUE 18): tail-sampled tracing — kept vs
+                # dropped trace counts, the resulting coverage
+                # fraction, and how many kept traces were kept for
+                # being SLOW (the p99-attribution feedstock).
+                "traces_kept": tstats["traces_kept"],
+                "traces_dropped": tstats["traces_dropped"],
+                "trace_coverage": tstats["trace_coverage"],
+                "slow_trace_count": tstats["slow_trace_count"],
             }
         return {
             "schema_version": schema.SERVING_SCHEMA_VERSION,
@@ -1640,8 +1886,9 @@ class _RouterHTTPServer(http.server.ThreadingHTTPServer):
 
 class RouterFrontend:
     """The router's HTTP surface: proxied POST /generate //classify,
-    GET /metrics //health //replicas //window (+ /canary with a canary
-    set), admin POST /drain //undrain {"replica": url}."""
+    GET /metrics //health //replicas //window //trace/{id} (+ /canary
+    with a canary set), admin POST /drain //undrain
+    {"replica": url}."""
 
     def __init__(self, router: Router, *, port: int = 0,
                  bind_host: str = ""):
@@ -1728,8 +1975,26 @@ class RouterFrontend:
                         self._send(
                             200,
                             "text/plain; version=0.0.4; charset=utf-8",
-                            render_prometheus(router.registry).encode(),
+                            render_prometheus(
+                                router.registry,
+                                exemplars=router.recorder.exemplars,
+                            ).encode(),
                         )
+                    elif path.startswith("/trace/"):
+                        # Live trace lookup (ISSUE 18): the recorder
+                        # keeps EVERY finished trace in its bounded
+                        # ring (sampling only gates sink writes), so
+                        # the operator can pull any recent request's
+                        # span tree by the trace_id its reply carried.
+                        tid = path[len("/trace/"):]
+                        doc = router.recorder.get(tid)
+                        if doc is None:
+                            self._send_json(
+                                404,
+                                {"error": f"unknown trace {tid!r}"},
+                            )
+                        else:
+                            self._send_json(200, doc)
                     elif path == "/health":
                         self._send_json(*router.health_payload())
                     elif path == "/replicas":
@@ -1749,8 +2014,8 @@ class RouterFrontend:
                             404,
                             "text/plain; charset=utf-8",
                             b"GET: /metrics /health /replicas /window "
-                            b"/canary   POST: /generate /classify "
-                            b"/drain /undrain\n",
+                            b"/canary /trace/{id}   POST: /generate "
+                            b"/classify /drain /undrain\n",
                         )
                 except ConnectionError:
                     pass

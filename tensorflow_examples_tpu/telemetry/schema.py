@@ -161,14 +161,28 @@ SCHEMA_VERSION = 5
 # takeover_latency_s, the last promotion's detect-to-serving wall
 # time). Stamped by the router only; FORBIDDEN on v4-v11 serving
 # lines, same mislabeling rule as every earlier bump.
-SERVING_SCHEMA_VERSION = 12
+#
+# Version 13 (ISSUE 18): a new line KIND — ``kind="trace"`` carries one
+# completed per-request trace tree (top-level "trace" object:
+# trace_id, SLO class, final status, client-visible e2e seconds, the
+# tail-sampler's keep_reason, and the span list — each span a
+# span_id/name/start_unix/dur_s record with optional parent_id and
+# tags). Written by telemetry/tracing.py with the PR-2 sink discipline
+# (one line per trace, flushed per append, torn-tail-tolerant read).
+# Both the kind and the object are FORBIDDEN on v4-v12 lines. The
+# serving object gains the trace-accounting keys (traces_kept /
+# traces_dropped / trace_coverage / slow_trace_count — stamped by the
+# router only), FORBIDDEN on v4-v12 serving lines, same mislabeling
+# rule as every earlier bump.
+SERVING_SCHEMA_VERSION = 13
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
 KINDS_V3 = KINDS_V2 + ("fleet",)
-KINDS = KINDS_V3 + ("serving",)
+KINDS_V12 = KINDS_V3 + ("serving",)
+KINDS = KINDS_V12 + ("trace",)
 
 _REQUIRED = ("schema_version", "kind", "step", "time_unix",
              "session_start_unix", "metrics", "counters", "gauges",
@@ -186,6 +200,10 @@ _V4_FIELDS = ("serving",)
 
 # v5-only top-level objects, forbidden on earlier versions.
 _V5_FIELDS = ("sharding",)
+
+# v13-only top-level objects, forbidden on earlier versions (a line
+# carrying a trace tree without the v13 stamp is mislabeled).
+_V13_FIELDS = ("trace",)
 
 # Required keys of a v5 sharding object (writer: train/loop.py via
 # telemetry/hub.py sharding_info).
@@ -261,13 +279,28 @@ SERVING_KEYS_V12 = ("journal_appends", "takeover_total",
                     "resumed_streams", "dedup_hits",
                     "takeover_latency_s")
 
+# v13-only serving-object keys (ISSUE 18): the router's per-request
+# tracing accounting — traces the tail sampler kept vs dropped, the
+# kept fraction, and how many kept traces were slow for their SLO
+# class. All numeric; stamped by the router only (a replica line
+# carries none), FORBIDDEN on v4-v12 serving lines, same mislabeling
+# rule as every earlier bump.
+SERVING_KEYS_V13 = ("traces_kept", "traces_dropped", "trace_coverage",
+                    "slow_trace_count")
+
+# Required keys of a v13 trace object (writer: telemetry/tracing.py
+# TraceRecorder.finish) and of each entry in its "spans" list.
+TRACE_KEYS = ("trace_id", "slo", "status", "e2e_s", "keep_reason",
+              "spans")
+TRACE_SPAN_KEYS = ("span_id", "name", "start_unix", "dur_s")
+
 # Instrument namespaces of the serving tier whose counter/gauge/
 # histogram registrations the graftlint drift pass cross-checks
 # against the docs catalog (ISSUE 15 satellite: the pass LEARNS this
 # list from here — adding a namespace is a schema-module edit, not a
 # lint-pass edit).
 INSTRUMENT_PREFIXES = ("serving/", "router/", "autoscaler/",
-                       "precision/")
+                       "precision/", "trace/")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -328,7 +361,9 @@ def validate_line(obj: Any) -> list[str]:
             f"schema_version {version!r} not in {SUPPORTED_VERSIONS}"
         )
         return problems
-    kinds = {1: KINDS_V1, 2: KINDS_V2, 3: KINDS_V3}.get(version, KINDS)
+    kinds = {1: KINDS_V1, 2: KINDS_V2, 3: KINDS_V3}.get(
+        version, KINDS_V12 if version < 13 else KINDS
+    )
     if obj["kind"] not in kinds:
         problems.append(f"kind {obj['kind']!r} not in {kinds}")
     if not isinstance(obj["step"], int) or isinstance(obj["step"], bool) \
@@ -364,7 +399,8 @@ def validate_line(obj: Any) -> list[str]:
 
     if version == 1:
         for fields, v in ((_V2_FIELDS, 2), (_V3_FIELDS, 3),
-                          (_V4_FIELDS, 4), (_V5_FIELDS, 5)):
+                          (_V4_FIELDS, 4), (_V5_FIELDS, 5),
+                          (_V13_FIELDS, 13)):
             for key in fields:
                 if key in obj:
                     problems.append(
@@ -427,7 +463,7 @@ def validate_line(obj: Any) -> list[str]:
 
     if version == 2:
         for fields, v in ((_V3_FIELDS, 3), (_V4_FIELDS, 4),
-                          (_V5_FIELDS, 5)):
+                          (_V5_FIELDS, 5), (_V13_FIELDS, 13)):
             for key in fields:
                 if key in obj:
                     problems.append(
@@ -509,6 +545,8 @@ def validate_line(obj: Any) -> list[str]:
             problems.append("v4 field 'serving' on a schema-v3 line")
         if "sharding" in obj:
             problems.append("v5 field 'sharding' on a schema-v3 line")
+        if "trace" in obj:
+            problems.append("v13 field 'trace' on a schema-v3 line")
         return problems
 
     # ------------------------------------------------- v4 additions
@@ -571,8 +609,85 @@ def validate_line(obj: Any) -> list[str]:
                             f"v12 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
+            if version < 13:
+                for key in SERVING_KEYS_V13:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v13 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
     elif "serving" in obj:
         problems.append("serving object on a non-serving line")
+
+    # ------------------------------------------------ v13 trace lines
+    if obj["kind"] == "trace":
+        trace = obj.get("trace")
+        if not isinstance(trace, dict):
+            problems.append("trace line is missing the trace object")
+        else:
+            for key in TRACE_KEYS:
+                if key not in trace:
+                    problems.append(
+                        f"trace object is missing required key {key!r}"
+                    )
+            for key in ("trace_id", "slo", "keep_reason"):
+                v = trace.get(key)
+                if key in trace and not isinstance(v, str):
+                    problems.append(
+                        f"trace[{key!r}] = {v!r} is not a string"
+                    )
+            status = trace.get("status")
+            if "status" in trace and (
+                not isinstance(status, int) or isinstance(status, bool)
+            ):
+                problems.append(
+                    f"trace['status'] = {status!r} is not an int"
+                )
+            if "e2e_s" in trace and not _is_number(trace["e2e_s"]):
+                problems.append(
+                    f"trace['e2e_s'] = {trace['e2e_s']!r} is not a number"
+                )
+            spans = trace.get("spans")
+            if "spans" in trace and not isinstance(spans, list):
+                problems.append(
+                    f"trace['spans'] = {spans!r} is not a list"
+                )
+            for i, sp in enumerate(spans if isinstance(spans, list)
+                                   else ()):
+                if not isinstance(sp, dict):
+                    problems.append(f"trace['spans'][{i}] is not an object")
+                    continue
+                for key in TRACE_SPAN_KEYS:
+                    if key not in sp:
+                        problems.append(
+                            f"trace['spans'][{i}] is missing {key!r}"
+                        )
+                for key in ("span_id", "name"):
+                    if key in sp and not isinstance(sp[key], str):
+                        problems.append(
+                            f"trace['spans'][{i}][{key!r}] = "
+                            f"{sp[key]!r} is not a string"
+                        )
+                for key in ("start_unix", "dur_s"):
+                    if key in sp and not _is_number(sp[key]):
+                        problems.append(
+                            f"trace['spans'][{i}][{key!r}] = "
+                            f"{sp[key]!r} is not a number"
+                        )
+                parent = sp.get("parent_id")
+                if parent is not None and not isinstance(parent, str):
+                    problems.append(
+                        f"trace['spans'][{i}]['parent_id'] = {parent!r} "
+                        "is not a string or null"
+                    )
+                tags = sp.get("tags")
+                if tags is not None and not isinstance(tags, dict):
+                    problems.append(
+                        f"trace['spans'][{i}]['tags'] = {tags!r} is not "
+                        "an object"
+                    )
+    elif "trace" in obj:
+        problems.append("trace object on a non-trace line")
 
     if version == 4:
         if "sharding" in obj:

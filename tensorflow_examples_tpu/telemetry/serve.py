@@ -88,9 +88,23 @@ def _fmt_value(v: float) -> str:
     return repr(f)
 
 
-def render_prometheus(registry, *, host: int = 0) -> str:
+# Latency histograms that additionally render per-quantile GAUGE
+# samples (ISSUE 18 satellite): a summary's quantile label is easy to
+# misuse in alert expressions, so the per-SLO-class serving latencies
+# also surface as plain ``<name>_seconds_p99``-style gauges an operator
+# can threshold directly. Matched by prefix so new SLO classes appear
+# without touching this module.
+_CLASS_GAUGE_PREFIXES = ("serving/ttft_", "serving/e2e_")
+
+
+def render_prometheus(registry, *, host: int = 0, exemplars=None) -> str:
     """The registry as Prometheus text exposition format (version 0.0.4:
-    ``# TYPE`` comments + ``name{labels} value`` samples)."""
+    ``# TYPE`` comments + ``name{labels} value`` samples).
+
+    ``exemplars`` (ISSUE 18): an ``ExemplarStore`` whose worst recent
+    observation per histogram renders as a ``<name>_seconds_worst``
+    gauge carrying a ``trace_id`` label — the scrape-time bridge from
+    "p99 spiked" to the exact trace to pull from ``/trace/{id}``."""
     label = f'{{host="{int(host)}"}}'
     lines: list[str] = []
     for name, value in sorted(registry.counter_values().items()):
@@ -115,6 +129,22 @@ def render_prometheus(registry, *, host: int = 0) -> str:
                 )
         lines.append(f"{n}_sum{label} {_fmt_value(summary['total'])}")
         lines.append(f"{n}_count{label} {_fmt_value(summary['count'])}")
+        if name.startswith(_CLASS_GAUGE_PREFIXES):
+            for q, _ in _QUANTILES:
+                v = summary[f"p{q}"]
+                if v is not None:
+                    lines.append(f"# TYPE {n}_p{q} gauge")
+                    lines.append(
+                        f"{n}_p{q}{label} {_fmt_value(v)}"
+                    )
+    if exemplars is not None:
+        for name, (value, trace_id) in sorted(exemplars.worst().items()):
+            n = sanitize_metric_name(name) + "_seconds_worst"
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(
+                f'{n}{{host="{int(host)}",trace_id="{trace_id}"}} '
+                f"{_fmt_value(value)}"
+            )
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,524 @@
+"""Per-request distributed tracing for the serving tier (ISSUE 18).
+
+The PR-2 span machinery (telemetry/spans.py) answers "where did the
+HOST loop's time go" in aggregate; this module answers it for ONE
+request as it crosses processes: a ``traceparent``-style
+:class:`TraceContext` (trace_id, parent span_id, sampled flag) is
+minted at the router — or accepted from the client — and rides the
+HTTP body of every internal leg (``/generate`` ``/prefill``
+``/resume``) under the ``"trace"`` key. Each hop contributes **span
+dicts** (span_id / name / start_unix / dur_s / parent_id / tags):
+
+* the router records a root ``request`` span plus one span per
+  dispatch attempt (retries, hedges, failovers — outcome-tagged) and
+  per disaggregated handoff leg;
+* a replica collects its per-request spans (queue_wait, prefill
+  chunks, resume import, decode segments, preemptions) on the
+  in-flight record and RETURNS them in the HTTP reply under
+  ``"trace_spans"`` — no shared-memory assumption, so in-proc and
+  process fleets stitch identically;
+* the engine's compiled-step dispatches are host-side wall-clock
+  spans (no device sync — the zero-recompile/zero-sync contract is
+  golden-pinned).
+
+The router's :class:`TraceRecorder` assembles the tree and applies
+**tail-based sampling** at finish: every trace that is slow for its
+SLO class, errored, retried, failed-over, hedged, preempted, deduped,
+resumed, or brownout-capped is kept, plus a seeded deterministic
+fraction of normal traffic. Kept traces land as schema-v13
+``kind="trace"`` JSONL lines (one line per trace, flushed+fsynced per
+append, torn-tail-tolerant read — the PR-2 sink discipline) and every
+finished trace stays queryable in a bounded LRU (``GET /trace/{id}``
+on the router frontend). Finishing an already-finished trace_id
+MERGES spans into the stored tree — that is how a takeover-survived
+request's dedupe fast path on the successor router stitches onto the
+original trace via the journal-stamped trace_id.
+
+:class:`ExemplarStore` links the aggregate view back to the causal
+one: TTFT/e2e histogram observations record their trace_id, and
+``/metrics`` exposes each metric's worst recent observation with its
+trace_id label — from a p99 bump straight to the span tree.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+import zlib
+
+# HTTP wire keys: the trace context rides request bodies under
+# TRACE_WIRE_FIELD; a replica returns its per-request spans under
+# REPLY_SPANS_FIELD (the router pops them into its recorder).
+TRACE_WIRE_FIELD = "trace"
+REPLY_SPANS_FIELD = "trace_spans"
+
+# Forced-keep flags in keep_reason priority order (first present flag
+# names the reason); "slow" and "seeded" are computed at finish.
+KEEP_FLAGS = ("error", "failover", "retried", "hedged", "preempted",
+              "deduped", "resumed", "brownout", "slow", "seeded")
+
+# Tail-sampling slow thresholds per SLO class (seconds, client-visible
+# e2e). Anything at/over its class threshold is kept.
+DEFAULT_SLOW_S = {"interactive": 1.0, "batch": 30.0}
+
+# Per-trace span cap: a runaway decode cannot grow a trace without
+# bound; overflow is counted, never silently lost.
+MAX_SPANS_PER_TRACE = 512
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(4).hex()
+
+
+def make_span(name: str, *, start_unix: float, dur_s: float,
+              parent_id: str | None = None, span_id: str | None = None,
+              tags: dict | None = None) -> dict:
+    span = {
+        "span_id": span_id or new_span_id(),
+        "parent_id": parent_id,
+        "name": str(name),
+        "start_unix": float(start_unix),
+        "dur_s": float(dur_s),
+    }
+    if tags:
+        span["tags"] = dict(tags)
+    return span
+
+
+def close_span(name: str, t0_monotonic: float, *,
+               parent_id: str | None = None, span_id: str | None = None,
+               tags: dict | None = None) -> dict:
+    """Span from a ``time.monotonic()`` start mark, ending NOW. The
+    epoch placement back-dates ``time.time()`` by the measured
+    duration, so hot paths need only the one monotonic read they
+    already take."""
+    dur = max(0.0, time.monotonic() - t0_monotonic)
+    return make_span(name, start_unix=time.time() - dur, dur_s=dur,
+                     parent_id=parent_id, span_id=span_id, tags=tags)
+
+
+class TraceContext:
+    """What crosses the wire: which trace, which parent span, and the
+    head-sampling hint (the tail sampler has the final word)."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = bool(sampled)
+
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "parent_span_id": self.span_id,
+                "sampled": self.sampled}
+
+    def child(self, span_id: str) -> "TraceContext":
+        """The context a callee sees: same trace, parented under the
+        caller-side span that covers the call."""
+        return TraceContext(self.trace_id, span_id, self.sampled)
+
+    @classmethod
+    def from_wire(cls, obj) -> "TraceContext | None":
+        """Parse a body's ``"trace"`` value; None on anything malformed
+        (an unparseable context must never fail the request)."""
+        if not isinstance(obj, dict):
+            return None
+        trace_id = obj.get("trace_id")
+        if not isinstance(trace_id, str) or not trace_id:
+            return None
+        parent = obj.get("parent_span_id")
+        if parent is not None and not isinstance(parent, str):
+            return None
+        return cls(trace_id, parent or new_span_id(),
+                   bool(obj.get("sampled", True)))
+
+
+class ExemplarStore:
+    """Worst-recent exemplars: per metric name, a bounded ring of
+    (value, trace_id) observations; ``worst()`` is the max over the
+    ring — "the slowest TTFT lately, and the trace that explains it"."""
+
+    def __init__(self, keep: int = 128):
+        self._keep = int(keep)
+        self._lock = threading.Lock()
+        self._recent: dict[str, collections.deque] = {}
+
+    def record(self, name: str, value: float, trace_id: str) -> None:
+        with self._lock:
+            ring = self._recent.get(name)
+            if ring is None:
+                ring = self._recent[name] = collections.deque(
+                    maxlen=self._keep
+                )
+            ring.append((float(value), str(trace_id)))
+
+    def worst(self) -> dict:
+        """{metric name: (value, trace_id)} — each name's worst recent
+        observation."""
+        with self._lock:
+            return {
+                name: max(ring)
+                for name, ring in self._recent.items() if ring
+            }
+
+
+class TraceRecorder:
+    """Per-process trace assembly + tail sampling + the v13 sink.
+
+    One recorder lives wherever traces FINISH (the router; serve_bench
+    when it drives replicas directly). Replicas don't need one — they
+    return span dicts in their replies.
+    """
+
+    def __init__(self, *, registry=None, path: str | None = None,
+                 sample_fraction: float = 0.01, slow_s: dict | None = None,
+                 seed: int = 0, keep_traces: int = 256,
+                 max_spans: int = MAX_SPANS_PER_TRACE):
+        # None = resolve default_registry() per record (Tracer's rule),
+        # so a recorder made before reset_default_registry() still
+        # lands in the live one.
+        self._registry = registry
+        self.sample_fraction = float(sample_fraction)
+        self.slow_s = dict(DEFAULT_SLOW_S)
+        if slow_s:
+            self.slow_s.update(slow_s)
+        self.seed = int(seed)
+        self._max_spans = int(max_spans)
+        self._lock = threading.Lock()
+        # open traces: trace_id -> {"spans": [...], "dropped": n}
+        self._open: dict[str, dict] = {}
+        # finished traces, merged by trace_id (the /trace/{id} window
+        # and the dedupe/takeover stitch point).
+        self._done: collections.OrderedDict[str, dict] = (
+            collections.OrderedDict()
+        )
+        self._keep_traces = int(keep_traces)
+        self.exemplars = ExemplarStore()
+        self._t_session = time.time()
+        self.path = path
+        self._file = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._file = open(path, "a")
+
+    # ------------------------------------------------------------ registry
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from tensorflow_examples_tpu.telemetry import registry as _reg
+
+        return _reg.default_registry()
+
+    # ------------------------------------------------------------- record
+
+    def new_context(self, wire=None) -> TraceContext:
+        """Accept a client-supplied wire context, or mint a fresh one;
+        either way the trace is now OPEN here and the returned
+        context's span_id is the root ``request`` span's id."""
+        ctx = TraceContext.from_wire(wire) if wire is not None else None
+        if ctx is None:
+            ctx = TraceContext(new_trace_id(), new_span_id(), True)
+        with self._lock:
+            self._open.setdefault(
+                ctx.trace_id, {"spans": [], "dropped": 0}
+            )
+        self._reg().counter("trace/started_total").inc(1)
+        return ctx
+
+    def add_span(self, trace_id: str, span: dict) -> None:
+        with self._lock:
+            rec = self._open.setdefault(
+                trace_id, {"spans": [], "dropped": 0}
+            )
+            if len(rec["spans"]) >= self._max_spans:
+                rec["dropped"] += 1
+                overflowed = True
+            else:
+                rec["spans"].append(span)
+                overflowed = False
+        if overflowed:
+            self._reg().counter("trace/spans_dropped_total").inc(1)
+
+    @contextlib.contextmanager
+    def span(self, trace_id: str, name: str, *,
+             parent_id: str | None = None, tags: dict | None = None):
+        """Measure a router-side leg; yields the span dict so the body
+        can set outcome tags (``span['tags']['status'] = ...``) before
+        it is recorded."""
+        span = {
+            "span_id": new_span_id(),
+            "parent_id": parent_id,
+            "name": str(name),
+            "start_unix": time.time(),
+            "dur_s": 0.0,
+            "tags": dict(tags or {}),
+        }
+        t0 = time.monotonic()
+        try:
+            yield span
+        finally:
+            span["dur_s"] = max(0.0, time.monotonic() - t0)
+            if not span["tags"]:
+                span.pop("tags")
+            self.add_span(trace_id, span)
+
+    def adopt(self, old_id: str, new_id: str) -> None:
+        """Re-key an OPEN trace: move its collected spans under
+        ``new_id`` and drop the old entry. The dedupe fast path uses
+        this — a duplicate request opened its own fresh trace before
+        the journal revealed the original's trace_id, and its spans
+        belong on the ORIGINAL tree, not a fork."""
+        if old_id == new_id:
+            return
+        with self._lock:
+            rec = self._open.pop(old_id, None)
+            if rec is None:
+                return
+            dst = self._open.setdefault(
+                new_id, {"spans": [], "dropped": 0}
+            )
+            dst["spans"].extend(rec["spans"])
+            dst["dropped"] += rec["dropped"]
+
+    def ingest(self, trace_id: str, spans, *,
+               parent_id: str | None = None) -> int:
+        """Adopt span dicts returned by a replica reply; top-level ones
+        (no parent) are parented under the dispatch span that carried
+        them. Malformed entries are dropped, never raised — a bad
+        reply field must not fail the request."""
+        added = 0
+        for span in spans if isinstance(spans, (list, tuple)) else ():
+            if not isinstance(span, dict):
+                continue
+            if not isinstance(span.get("span_id"), str) \
+                    or not isinstance(span.get("name"), str):
+                continue
+            try:
+                start = float(span["start_unix"])
+                dur = float(span["dur_s"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            adopted = make_span(
+                span["name"], start_unix=start, dur_s=dur,
+                parent_id=span.get("parent_id") or parent_id,
+                span_id=span["span_id"],
+                tags=span.get("tags")
+                if isinstance(span.get("tags"), dict) else None,
+            )
+            self.add_span(trace_id, adopted)
+            added += 1
+        return added
+
+    # ------------------------------------------------------------- finish
+
+    def _seeded_keep(self, trace_id: str) -> bool:
+        """Deterministic head-fraction: hash of (trace_id, seed) — the
+        same trace keeps or drops identically on every router that
+        finishes it."""
+        if self.sample_fraction >= 1.0:
+            return True
+        if self.sample_fraction <= 0.0:
+            return False
+        h = zlib.crc32(f"{trace_id}:{self.seed}".encode()) % 1_000_000
+        return h < self.sample_fraction * 1_000_000
+
+    def finish(self, trace_id: str, *, slo: str = "interactive",
+               status: int = 200, e2e_s: float = 0.0, flags=()) -> dict:
+        """Close the trace: tail-sample, merge into any earlier
+        finish of the same trace_id (the stitch), bank the v13 line
+        when kept, and return the merged trace doc."""
+        with self._lock:
+            rec = self._open.pop(trace_id, None)
+        spans = list(rec["spans"]) if rec else []
+        dropped_spans = rec["dropped"] if rec else 0
+        flags = set(flags)
+        if status != 200:
+            flags.add("error")
+        for span in spans:
+            tags = span.get("tags") or {}
+            if tags.get("preempted"):
+                flags.add("preempted")
+            if tags.get("brownout_level"):
+                flags.add("brownout")
+        slow_at = self.slow_s.get(slo, max(self.slow_s.values()))
+        if e2e_s >= slow_at:
+            flags.add("slow")
+        if self._seeded_keep(trace_id):
+            flags.add("seeded")
+        keep = bool(flags)
+        keep_reason = next(
+            (f for f in KEEP_FLAGS if f in flags), "sampled_out"
+        )
+        with self._lock:
+            prior = self._done.pop(trace_id, None)
+            if prior is not None:
+                # The stitch: a later finish of the same trace_id (a
+                # dedupe hit on the successor router, a resumed
+                # stream) joins the stored tree instead of forking it.
+                seen = {s["span_id"] for s in prior["spans"]}
+                spans = prior["spans"] + [
+                    s for s in spans if s["span_id"] not in seen
+                ]
+                flags |= set(prior.get("flags", ()))
+                e2e_s = max(e2e_s, prior.get("e2e_s", 0.0))
+                status = prior["status"] if prior["status"] != 200 \
+                    else status
+                dropped_spans += prior.get("spans_dropped", 0)
+                keep = keep or prior.get("kept", False)
+                keep_reason = next(
+                    (f for f in KEEP_FLAGS if f in flags), keep_reason
+                )
+            spans.sort(key=lambda s: s["start_unix"])
+            doc = {
+                "trace_id": trace_id,
+                "slo": str(slo),
+                "status": int(status),
+                "e2e_s": float(e2e_s),
+                "keep_reason": keep_reason,
+                "flags": sorted(flags),
+                "kept": keep,
+                "spans": spans,
+            }
+            if dropped_spans:
+                doc["spans_dropped"] = dropped_spans
+            self._done[trace_id] = doc
+            while len(self._done) > self._keep_traces:
+                self._done.popitem(last=False)
+        reg = self._reg()
+        if keep:
+            reg.counter("trace/kept_total").inc(1)
+            self._write_line(doc)
+        else:
+            reg.counter("trace/dropped_total").inc(1)
+        if "slow" in flags:
+            reg.counter("trace/slow_total").inc(1)
+        return doc
+
+    def _write_line(self, doc: dict) -> None:
+        if self._file is None:
+            return
+        from tensorflow_examples_tpu.telemetry import schema
+
+        line = {
+            "schema_version": schema.SERVING_SCHEMA_VERSION,
+            "kind": "trace",
+            "step": 0,
+            "time_unix": time.time(),
+            "session_start_unix": self._t_session,
+            "host": 0,
+            "metrics": {},
+            "counters": {},
+            "gauges": {},
+            "derived": {},
+            "trace": {k: v for k, v in doc.items() if k != "kept"},
+        }
+        with self._lock:
+            if self._file is None:
+                return
+            # One trace per line, flushed and fsynced per append (the
+            # PR-2 sink discipline): a crash tears at most the tail
+            # line, which readers tolerate.
+            self._file.write(json.dumps(line) + "\n")
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    # ------------------------------------------------------------ inspect
+
+    def get(self, trace_id: str) -> dict | None:
+        """The finished (merged) trace doc, or an ``"open": True``
+        partial for a request still in flight, or None."""
+        with self._lock:
+            doc = self._done.get(trace_id)
+            if doc is not None:
+                return json.loads(json.dumps(doc))
+            rec = self._open.get(trace_id)
+            if rec is not None:
+                return {
+                    "trace_id": trace_id,
+                    "open": True,
+                    "spans": json.loads(json.dumps(rec["spans"])),
+                }
+        return None
+
+    def stats(self) -> dict:
+        """The v13 serving-line keys (the router's stats_line stamps
+        exactly these)."""
+        counters = self._reg().counter_values()
+        kept = int(counters.get("trace/kept_total", 0))
+        dropped = int(counters.get("trace/dropped_total", 0))
+        total = kept + dropped
+        return {
+            "traces_kept": kept,
+            "traces_dropped": dropped,
+            "trace_coverage": (kept / total) if total else 0.0,
+            "slow_trace_count": int(counters.get("trace/slow_total", 0)),
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            f, self._file = self._file, None
+        if f is not None:
+            f.close()
+
+
+def read_traces(path: str) -> dict[str, dict]:
+    """Load a traces JSONL file into {trace_id: merged trace doc}.
+
+    Torn-tail tolerant (an unparseable line — the one a crash can
+    tear — is skipped, never raised) and MERGES lines sharing a
+    trace_id: a takeover-survived request leaves one line from each
+    router, and the reader is where they become one tree."""
+    merged: dict[str, dict] = {}
+    try:
+        f = open(path)
+    except OSError:
+        return merged
+    with f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(line, dict) or line.get("kind") != "trace":
+                continue
+            trace = line.get("trace")
+            if not isinstance(trace, dict) or not isinstance(
+                trace.get("trace_id"), str
+            ):
+                continue
+            tid = trace["trace_id"]
+            prior = merged.get(tid)
+            if prior is None:
+                merged[tid] = dict(
+                    trace, spans=list(trace.get("spans") or [])
+                )
+                continue
+            seen = {
+                s.get("span_id") for s in prior["spans"]
+                if isinstance(s, dict)
+            }
+            for span in trace.get("spans") or []:
+                if isinstance(span, dict) \
+                        and span.get("span_id") not in seen:
+                    prior["spans"].append(span)
+            prior["e2e_s"] = max(
+                prior.get("e2e_s", 0.0), trace.get("e2e_s", 0.0)
+            )
+            if prior.get("status", 200) == 200:
+                prior["status"] = trace.get("status", 200)
+            prior["spans"].sort(
+                key=lambda s: s.get("start_unix", 0.0)
+            )
+    return merged

@@ -787,6 +787,147 @@ class TestChaosGolden:
             rfront.close()
             fleet.close()
 
+    @pytest.mark.timeout(480)
+    def test_decode_crash_yields_one_stitched_trace(self, serve_faults):
+        """ISSUE 18 acceptance: a disaggregated fleet (1 prefill + 2
+        decode) under chaos — a decode replica crashes mid-decode —
+        leaves ONE stitched trace for the failed-over request: the
+        dead attempt's leg span (transport status 0) and the answering
+        one side by side under the same root, the replica-side
+        queue/prefill/decode segments nested under the attempt that
+        carried them, root wall ≈ the client-measured e2e, zero
+        post-warmup recompiles, and tools/trace_report.py's critical
+        path walking into the leg that ANSWERED, not the dead one."""
+        import serve_bench
+        import trace_report
+
+        fault_engine = serve_faults("crash@1:3")
+        fleet = ChaosFleet(
+            [_prefill_engine_factory, _decode_engine_factory,
+             _decode_engine_factory],
+            router_cfg=RouterConfig(
+                probe_interval_s=0.1, retry_budget_s=30.0,
+                max_retries=4, eject_after=1, eject_cooldown_s=1.0,
+                # A chaos golden inspects EVERY trace — no sampler coin.
+                trace_sample_fraction=1.0,
+            ),
+            supervisor_kw=dict(
+                poll_s=0.05, health_stall_s=3.0, warm_timeout_s=240.0,
+            ),
+        )
+        fleet.start()
+        assert fleet.role_census() == {"prefill": 1, "decode": 2}
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            deadline = time.monotonic() + 30
+            while (
+                not fleet.router._disagg_ready()
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert fleet.router._disagg_ready()
+            n, max_new = 10, 5
+            prompts = serve_bench.make_prompts(
+                n, vocab=CHAOS_MODEL["vocab_size"],
+                max_len=CHAOS_MODEL["max_len"], max_new=max_new,
+                seed=37, shared_prefix_every=4,
+            )
+            out = serve_bench.drive(
+                None, prompts, concurrency=3, max_new=max_new,
+                temperature=0.7, top_k=0,
+                http_url=rfront.url("/generate"), timeout=60.0,
+            )
+            statuses = [
+                r[0] if r is not None else None for r in out["replies"]
+            ]
+            assert statuses.count(200) == n, statuses
+            # The decode replica died mid-decode and the router failed
+            # the victims over.
+            assert ("crash", 1, 3) in fault_engine.fired
+            counters = fleet.router.registry.counter_values()
+            assert counters.get("router/failovers_total", 0) >= 1
+            assert counters.get("router/handoffs_total", 0) >= 1
+            # Every reply names its trace, and every trace finished.
+            docs = []
+            for i, (status, reply) in enumerate(out["replies"]):
+                doc = fleet.router.recorder.get(reply["trace_id"])
+                assert doc is not None and not doc.get("open"), i
+                docs.append(doc)
+            failed_over = [
+                d for d in docs if "failover" in d["flags"]
+            ]
+            assert failed_over, [d["flags"] for d in docs]
+            doc = failed_over[0]
+            idx = docs.index(doc)
+            names = [s["name"] for s in doc["spans"]]
+            # ONE tree: a single root covering the whole request.
+            assert names.count("request") == 1
+            root = next(
+                s for s in doc["spans"] if s["name"] == "request"
+            )
+            # Both attempts of the interrupted hop are in the tree —
+            # the dead one (transport, status 0) AND the one that
+            # answered — whether the router retried the leg or fell
+            # back to the full path.
+            attempts = [
+                s for s in doc["spans"]
+                if s["name"] in ("prefill_leg", "resume_leg", "dispatch")
+            ]
+            assert len(attempts) >= 2, names
+            att_statuses = [s["tags"]["status"] for s in attempts]
+            assert 0 in att_statuses, att_statuses
+            assert 200 in att_statuses, att_statuses
+            # Replica-side segments crossed the wire and nest under an
+            # attempt span (never float at the root).
+            attempt_ids = {s["span_id"] for s in attempts}
+            segs = [
+                s for s in doc["spans"]
+                if s["name"] in ("queue_wait", "prefill",
+                                 "prefill_chunk", "decode_segment",
+                                 "resume_import")
+            ]
+            assert any(s["name"] == "queue_wait" for s in segs), names
+            assert any(
+                s["name"] == "decode_segment" for s in segs
+            ), names
+            assert all(
+                s["parent_id"] in attempt_ids for s in segs
+            ), names
+            # The span tree accounts for the client's wall: the root
+            # covers (almost all of) the measured e2e — transport
+            # overhead is the only slack.
+            client = out["client_s"][idx]
+            assert root["dur_s"] <= client + 0.05
+            assert root["dur_s"] >= 0.5 * client, (
+                root["dur_s"], client
+            )
+            # The attribution tool walks the path that ANSWERED: the
+            # dead attempt ended early, so the critical path (latest
+            # finisher chain) goes through the 200 leg.
+            path = trace_report.critical_path(doc)
+            assert path and path[0]["name"] == "request"
+            leg_row = next(
+                r for r in path
+                if r["name"] in ("prefill_leg", "resume_leg", "dispatch")
+            )
+            assert leg_row["tags"]["status"] == 200, path
+            # Forced keep: a failed-over trace is never sampled away.
+            assert doc["kept"] is True
+            assert doc["keep_reason"] in ("failover", "retried", "slow")
+            # Fleet restored; zero post-warmup recompiles everywhere.
+            assert fleet.await_fleet_green(3, timeout_s=240)
+            for rep in fleet.replicas:
+                assert rep.engine.post_warmup_recompiles() == 0
+            # The v13 stats line tells the same story and validates.
+            line = json.loads(json.dumps(fleet.router.stats_line()))
+            assert schema.validate_line(line) == []
+            serving = line["serving"]
+            assert serving["traces_kept"] >= n
+            assert serving["trace_coverage"] == 1.0
+        finally:
+            rfront.close()
+            fleet.close()
+
 
 # ------------------------------------- ISSUE 16: the control plane dies
 
@@ -1028,6 +1169,22 @@ class TestTakeoverGolden:
             assert counters.get(
                 "router/dispatched_total", 0
             ) == dispatched_before
+            # Stitched ACROSS routers (ISSUE 18): the journal's done
+            # record carries the original request's trace_id; the
+            # promoted router's dedupe fast path adopts it, so the
+            # duplicate's reply names the ORIGINAL trace and the
+            # pair-shared recorder holds ONE merged tree — the
+            # original pass's spans plus the dedupe hit.
+            orig_tid = pair.journal.lookup("tko-0")["trace_id"]
+            assert isinstance(orig_tid, str) and orig_tid
+            assert dup["trace_id"] == orig_tid
+            tdoc = pair.recorder.get(orig_tid)
+            assert tdoc is not None and not tdoc.get("open")
+            tnames = [s["name"] for s in tdoc["spans"]]
+            assert "dedupe_hit" in tnames
+            assert tnames.count("request") >= 2  # both passes' roots
+            assert "deduped" in tdoc["flags"]
+            assert tdoc["kept"] is True
             # Zero post-warmup recompiles fleet-wide.
             for rep in fleet.replicas:
                 assert rep.engine.post_warmup_recompiles() == 0
@@ -1035,7 +1192,7 @@ class TestTakeoverGolden:
             # the whole story (shared registry survives the switch).
             line = json.loads(json.dumps(pair.standby.stats_line()))
             assert schema.validate_line(line) == []
-            assert line["schema_version"] == 12
+            assert line["schema_version"] == 13
             serving = line["serving"]
             assert serving["takeover_total"] == 1
             assert serving["journal_appends"] >= 2 * n

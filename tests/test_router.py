@@ -809,3 +809,245 @@ class TestFleetLockDiscipline:
         for t in threads:
             t.join(2)
         assert not bad, f"picked drained replica after drain(): {bad}"
+
+
+class TestTracing:
+    """ISSUE 18: per-request trace trees over fake replicas — the wire
+    contract (reply ``trace_id``, ``GET /trace/{id}``), per-attempt
+    dispatch spans under failover, client context adoption, the v13
+    stats keys, /metrics exemplars, and the journal dedupe stitch."""
+
+    @pytest.mark.timeout(120)
+    def test_reply_trace_id_and_trace_endpoint(self):
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{replicas[0][2].port}"]
+        router = Router(urls, cfg=RouterConfig(probe_interval_s=0.05))
+        router.probe_once()
+        rfront = RouterFrontend(router, port=0).start()
+        try:
+            status, reply = _post(
+                rfront.url("/generate"),
+                {"prompt": [7], "max_new_tokens": 3},
+            )
+            assert status == 200 and reply["tokens"] == [8, 9, 10]
+            tid = reply["trace_id"]
+            assert isinstance(tid, str) and tid
+            with urllib.request.urlopen(
+                rfront.url(f"/trace/{tid}"), timeout=10
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["trace_id"] == tid
+            names = [s["name"] for s in doc["spans"]]
+            # Router-side spans plus the replica's own, stitched via
+            # the reply's trace_spans — one tree, no shared memory.
+            assert "request" in names and "dispatch" in names
+            assert "queue_wait" in names, names
+            # The replica spans nest under the dispatch attempt.
+            by_id = {s["span_id"]: s for s in doc["spans"]}
+            disp = next(s for s in doc["spans"] if s["name"] == "dispatch")
+            qw = next(s for s in doc["spans"] if s["name"] == "queue_wait")
+            assert qw["parent_id"] == disp["span_id"]
+            assert by_id[disp["parent_id"]]["name"] == "request"
+            # Unknown id -> 404, not a crash.
+            try:
+                with urllib.request.urlopen(
+                    rfront.url("/trace/nope"), timeout=10
+                ) as resp:
+                    assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                assert "unknown trace" in json.loads(e.read())["error"]
+        finally:
+            rfront.close()
+            router.close()
+            _close(replicas)
+
+    @pytest.mark.timeout(120)
+    def test_failover_trace_shows_both_dispatch_attempts(self):
+        """A transport-failure failover leaves BOTH attempts in the
+        tree: the dead replica's dispatch span (outcome=transport) and
+        the survivor's (outcome=ok), each with its own span_id — plus
+        the failover/retried flags that force the tail sampler to
+        keep the trace."""
+        replicas = [_replica()]
+        live_url = f"http://127.0.0.1:{replicas[0][2].port}"
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead_url = f"http://127.0.0.1:{s.getsockname()[1]}"
+        router = Router(
+            [dead_url, live_url],
+            cfg=RouterConfig(retry_backoff_s=0.01, eject_after=1),
+        )
+        router.probe_once()
+        try:
+            router.replicas[1].dispatched = 5  # force the dead pick
+            status, reply = router.handle(
+                {"prompt": [7], "max_new_tokens": 2}, kind="generate"
+            )
+            assert status == 200 and reply["tokens"] == [8, 9]
+            doc = router.recorder.get(reply["trace_id"])
+            assert doc is not None and not doc.get("open")
+            assert "failover" in doc["flags"]
+            assert "retried" in doc["flags"]
+            assert doc["kept"] is True  # forced keep, not seeded luck
+            dispatches = [
+                s for s in doc["spans"] if s["name"] == "dispatch"
+            ]
+            assert len(dispatches) == 2
+            outcomes = {
+                s["tags"]["replica"]: s["tags"]["outcome"]
+                for s in dispatches
+            }
+            assert outcomes[dead_url] == "transport"
+            assert outcomes[live_url] == "ok"
+            assert (
+                dispatches[0]["span_id"] != dispatches[1]["span_id"]
+            )
+            # Replica spans hang off the attempt that answered, never
+            # the dead one.
+            qw = [s for s in doc["spans"] if s["name"] == "queue_wait"]
+            live_span = next(
+                s for s in dispatches if s["tags"]["replica"] == live_url
+            )
+            assert qw and all(
+                s["parent_id"] == live_span["span_id"] for s in qw
+            )
+        finally:
+            router.close()
+            _close(replicas)
+
+    @pytest.mark.timeout(120)
+    def test_client_wire_context_is_adopted(self):
+        """A client-minted traceparent wins: the reply carries the
+        client's trace_id and the root request span parents under the
+        client's span — the client can stitch the router's tree into
+        its own."""
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{replicas[0][2].port}"]
+        router = Router(urls)
+        router.probe_once()
+        try:
+            status, reply = router.handle(
+                {
+                    "prompt": [3], "max_new_tokens": 2,
+                    "trace": {
+                        "trace_id": "cafe" * 4,
+                        "parent_span_id": "feed0123",
+                        "sampled": True,
+                    },
+                },
+                kind="generate",
+            )
+            assert status == 200
+            assert reply["trace_id"] == "cafe" * 4
+            doc = router.recorder.get("cafe" * 4)
+            root = next(
+                s for s in doc["spans"] if s["name"] == "request"
+            )
+            assert root["parent_id"] == "feed0123"
+        finally:
+            router.close()
+            _close(replicas)
+
+    @pytest.mark.timeout(120)
+    def test_stats_line_carries_v13_keys_and_validates(self):
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{replicas[0][2].port}"]
+        # sample_fraction=1.0: this test is about the keys, not the
+        # sampler's coin.
+        router = Router(
+            urls, cfg=RouterConfig(trace_sample_fraction=1.0)
+        )
+        router.probe_once()
+        try:
+            status, _ = router.handle(
+                {"prompt": [2], "max_new_tokens": 2}, kind="generate"
+            )
+            assert status == 200
+            line = json.loads(json.dumps(router.stats_line()))
+            assert schema.validate_line(line) == []
+            serving = line["serving"]
+            for key in schema.SERVING_KEYS_V13:
+                assert key in serving, key
+            assert serving["traces_kept"] == 1
+            assert serving["traces_dropped"] == 0
+            assert serving["trace_coverage"] == 1.0
+            # v13 keys on an older version label must flag.
+            v12 = dict(line, schema_version=12)
+            assert any(
+                "v13 serving key" in p for p in schema.validate_line(v12)
+            )
+        finally:
+            router.close()
+            _close(replicas)
+
+    @pytest.mark.timeout(120)
+    def test_metrics_exposes_e2e_exemplar_with_trace_id(self):
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{replicas[0][2].port}"]
+        router = Router(urls)
+        router.probe_once()
+        rfront = RouterFrontend(router, port=0).start()
+        try:
+            status, reply = _post(
+                rfront.url("/generate"),
+                {"prompt": [5], "max_new_tokens": 2},
+            )
+            assert status == 200
+            with urllib.request.urlopen(
+                rfront.url("/metrics"), timeout=10
+            ) as resp:
+                text = resp.read().decode()
+            line = next(
+                ln for ln in text.splitlines()
+                if ln.startswith("router_e2e_seconds_worst{")
+            )
+            # The exemplar names the trace that explains the worst
+            # observation — here the only one there is.
+            assert f'trace_id="{reply["trace_id"]}"' in line
+        finally:
+            rfront.close()
+            router.close()
+            _close(replicas)
+
+    @pytest.mark.timeout(120)
+    def test_journal_dedupe_stitches_into_original_trace(self, tmp_path):
+        """A duplicated request_id answers from the journal — and its
+        spans JOIN the original trace (journal-stamped trace_id +
+        recorder merge), instead of forking a second tree."""
+        from tensorflow_examples_tpu.serving.journal import (
+            RequestJournal,
+        )
+
+        replicas = [_replica()]
+        urls = [f"http://127.0.0.1:{replicas[0][2].port}"]
+        journal = RequestJournal(str(tmp_path / "j.jsonl"))
+        router = Router(urls, journal=journal)
+        router.probe_once()
+        try:
+            body = {
+                "prompt": [9], "max_new_tokens": 2,
+                "request_id": "rid-1",
+            }
+            status, first = router.handle(body, kind="generate")
+            assert status == 200 and not first.get("dedup")
+            tid = first["trace_id"]
+            assert journal.lookup("rid-1")["trace_id"] == tid
+            status, second = router.handle(body, kind="generate")
+            assert status == 200 and second["dedup"] is True
+            assert second["tokens"] == first["tokens"]
+            # The stitch: the duplicate's reply names the ORIGINAL
+            # trace, and the merged doc holds both passes' spans.
+            assert second["trace_id"] == tid
+            doc = router.recorder.get(tid)
+            names = [s["name"] for s in doc["spans"]]
+            assert "dispatch" in names  # original pass
+            assert "dedupe_hit" in names  # duplicate's fast path
+            assert names.count("request") == 2  # one root per pass
+            assert "deduped" in doc["flags"]
+            assert doc["kept"] is True
+        finally:
+            router.close()
+            _close(replicas)

@@ -1580,6 +1580,52 @@ class TestServeBench:
             assert isinstance(rec[key], (int, float)) and rec[key] > 0, key
 
     @pytest.mark.timeout(300)
+    def test_smoke_trace_out_validates_and_renders(self, tmp_path, capsys):
+        """ISSUE 18 CI satellite: ``--smoke --trace-out`` banks >= 1
+        ``kind="trace"`` line that validates against schema v13, the
+        record carries full coverage (bench drivers keep EVERY trace),
+        and ``tools/trace_report.py --trace-id`` renders the span tree
+        with its critical path."""
+        import serve_bench
+        import trace_report
+
+        from tensorflow_examples_tpu.telemetry import schema
+
+        traces = tmp_path / "traces.jsonl"
+        out = tmp_path / "rec.json"
+        rc = serve_bench.main([
+            "--smoke", "--requests", "8", "--out", str(out),
+            "--trace-out", str(traces),
+        ])
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        # A measuring run samples nothing out: coverage is 1.0 and
+        # every request left a trace.
+        assert rec["traces_kept"] == 8
+        assert rec["trace_coverage"] == 1.0
+        with open(traces) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        assert len(lines) >= 1
+        for line in lines:
+            assert line["kind"] == "trace"
+            assert (
+                line["schema_version"] == schema.SERVING_SCHEMA_VERSION
+            )
+            problems = schema.validate_line(line)
+            assert problems == [], problems
+        tid = lines[0]["trace"]["trace_id"]
+        capsys.readouterr()  # drop the bench's own stdout
+        rc = trace_report.main(["--trace-id", tid, str(traces)])
+        rendered = capsys.readouterr().out
+        assert rc == 0
+        assert tid in rendered
+        assert "request" in rendered and "critical path:" in rendered
+        # The replica's engine-phase spans made it across the wire
+        # into the rendered tree.
+        assert "decode_segment" in rendered
+
+    @pytest.mark.timeout(300)
     def test_spec_decode_smoke_banks_ab_record(self, tmp_path):
         """ISSUE 11 satellite: ``--smoke --spec-decode K`` drives the
         SAME prompt-like prompts speculation-off then -on, banks a
@@ -1888,6 +1934,55 @@ class TestServeBench:
             serve_bench.main([])
 
 
+class TestTpuWatchMetrics:
+    """ISSUE 18 satellite: ``tools/tpu_watch.sh --metrics`` against a
+    ROUTER endpoint — the router serves the same /health //window
+    //fleet surface as a replica, so the one watcher script covers
+    both. Pinned: healthy polls print the health body and the
+    kind=serving window summary; a gone endpoint after a healthy last
+    probe means "run ended", exit 0."""
+
+    @pytest.mark.timeout(120)
+    def test_watch_polls_router_then_exits_zero_on_endpoint_gone(self):
+        import time
+
+        from tensorflow_examples_tpu.serving.router import (
+            Router,
+            RouterFrontend,
+        )
+
+        # No probe loop (start() not called): the hand-probed replica
+        # stays eligible, so /health answers "ok": true. The watcher
+        # only GETs — no engine needed behind the fake URL.
+        router = Router(["http://127.0.0.1:9/"])
+        router.replicas[0].probed = True
+        rfront = RouterFrontend(router, port=0).start()
+        proc = subprocess.Popen(
+            ["bash", os.path.join(REPO, "tools", "tpu_watch.sh"),
+             "--metrics", f"127.0.0.1:{rfront.port}",
+             "--interval", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            time.sleep(3.5)  # a few healthy polls land
+        finally:
+            rfront.close()
+            router.close()
+        try:
+            out, _ = proc.communicate(timeout=60)
+        finally:
+            proc.kill()
+        assert proc.returncode == 0, out
+        assert '"ok": true' in out  # health body echoed
+        assert "kind=serving" in out  # /window summarized
+        assert "endpoint gone: run ended" in out
+        # Healthy-then-gone is a NORMAL end: the exit-reason pointer,
+        # not a stall verdict.
+        assert "exit reason is in the run dir" in out
+        assert "STALLED" not in out
+
+
 def test_readme_test_count_is_current():
     """README's `tests/` line states the suite size; keep it honest
     mechanically (VERDICT r4 weak #6) by comparing against pytest's own
@@ -1930,6 +2025,7 @@ class TestTier1Budget:
         "test_chaos.py::TestChaosGolden::test_kill_one_of_three_zero_failed_requests",
         "test_chaos.py::TestChaosGolden::test_kill_one_of_three_with_speculation_on",
         "test_chaos.py::TestChaosGolden::test_kill_prefill_replica_mid_handoff",
+        "test_chaos.py::TestChaosGolden::test_decode_crash_yields_one_stitched_trace",
         "test_chaos.py::TestTakeoverGolden::test_killrouter_mid_stream_zero_lost_token_identical",
         "test_distributed.py::test_two_process_tp_matches_single_process",
         "test_resilience.py::test_fault_inject_tool_standalone",

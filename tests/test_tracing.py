@@ -1,0 +1,473 @@
+"""Distributed-tracing units (ISSUE 18): the trace-context wire
+round-trip, span adoption from replica replies, the tail sampler's
+forced-keep/slow/seeded decisions, merge-on-finish stitching (the
+dedupe/takeover join), the flush-per-line v13 trace sink and its
+torn-tail-tolerant reader, exemplar bookkeeping, and the schema-v13
+ritual pin (kind="trace" and the v13 serving keys forbidden on every
+version that predates them).
+
+Everything here is device-free and O(ms) — the stitched-trace chaos
+golden lives in tests/test_chaos.py, the CI smoke in tests/test_tools.
+"""
+
+import json
+
+import pytest
+
+from tensorflow_examples_tpu.telemetry import schema, tracing
+from tensorflow_examples_tpu.telemetry.registry import MetricsRegistry
+from tensorflow_examples_tpu.telemetry.tracing import (
+    ExemplarStore,
+    TraceContext,
+    TraceRecorder,
+    close_span,
+    make_span,
+    read_traces,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _recorder(tmp_path=None, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    if tmp_path is not None:
+        kw.setdefault("path", str(tmp_path / "traces.jsonl"))
+    kw.setdefault("sample_fraction", 0.0)
+    return TraceRecorder(**kw)
+
+
+class TestTraceContext:
+    def test_wire_round_trip(self):
+        ctx = TraceContext("a" * 16, "b" * 8, sampled=True)
+        wire = ctx.to_wire()
+        assert wire == {
+            "trace_id": "a" * 16, "parent_span_id": "b" * 8,
+            "sampled": True,
+        }
+        back = TraceContext.from_wire(json.loads(json.dumps(wire)))
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled is True
+
+    def test_child_reparents_same_trace(self):
+        ctx = TraceContext("t" * 16, "p" * 8)
+        kid = ctx.child("c" * 8)
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id == "c" * 8
+
+    @pytest.mark.parametrize("wire", [
+        None, 7, "tid", [], {}, {"trace_id": 3},
+        {"trace_id": ""}, {"trace_id": "t", "parent_span_id": 9},
+    ])
+    def test_malformed_wire_is_rejected_not_raised(self, wire):
+        assert TraceContext.from_wire(wire) is None
+
+    def test_missing_parent_gets_fresh_span_id(self):
+        ctx = TraceContext.from_wire({"trace_id": "t" * 16})
+        assert ctx is not None
+        assert isinstance(ctx.span_id, str) and ctx.span_id
+
+    def test_ids_are_hex_and_distinct(self):
+        tids = {tracing.new_trace_id() for _ in range(64)}
+        sids = {tracing.new_span_id() for _ in range(64)}
+        assert len(tids) == 64 and len(sids) == 64
+        for t in tids:
+            int(t, 16)
+        for s in sids:
+            int(s, 16)
+
+
+class TestSpanHelpers:
+    def test_make_span_shape(self):
+        sp = make_span("leg", start_unix=5.0, dur_s=0.25,
+                       parent_id="p", tags={"status": 200})
+        assert sp["name"] == "leg"
+        assert sp["parent_id"] == "p"
+        assert sp["start_unix"] == 5.0 and sp["dur_s"] == 0.25
+        assert sp["tags"] == {"status": 200}
+        assert isinstance(sp["span_id"], str)
+
+    def test_make_span_omits_empty_tags(self):
+        assert "tags" not in make_span("x", start_unix=0.0, dur_s=0.0)
+
+    def test_close_span_backdates_start(self):
+        import time
+
+        t0 = time.monotonic()
+        sp = close_span("work", t0)
+        assert sp["dur_s"] >= 0.0
+        # start_unix + dur_s lands at "now" (back-dated start).
+        assert abs((sp["start_unix"] + sp["dur_s"]) - time.time()) < 1.0
+
+
+class TestExemplarStore:
+    def test_worst_is_max_with_trace_id(self):
+        store = ExemplarStore(keep=4)
+        store.record("serving/ttft_s", 0.1, "t1")
+        store.record("serving/ttft_s", 0.9, "t2")
+        store.record("serving/ttft_s", 0.5, "t3")
+        assert store.worst()["serving/ttft_s"] == (0.9, "t2")
+
+    def test_ring_is_bounded_and_evicts_old_worst(self):
+        store = ExemplarStore(keep=2)
+        store.record("m", 9.0, "old")
+        store.record("m", 1.0, "a")
+        store.record("m", 2.0, "b")
+        assert store.worst()["m"] == (2.0, "b")
+
+    def test_empty_store(self):
+        assert ExemplarStore().worst() == {}
+
+
+class TestTailSampling:
+    def test_sampled_out_when_boring(self):
+        rec = _recorder()
+        ctx = rec.new_context()
+        doc = rec.finish(ctx.trace_id, e2e_s=0.01)
+        assert doc["kept"] is False
+        assert doc["keep_reason"] == "sampled_out"
+
+    @pytest.mark.parametrize("flag", [
+        "error", "failover", "retried", "hedged", "preempted",
+        "deduped", "resumed", "brownout",
+    ])
+    def test_forced_keep_flags(self, flag):
+        rec = _recorder()
+        ctx = rec.new_context()
+        doc = rec.finish(ctx.trace_id, flags=[flag])
+        assert doc["kept"] is True
+        assert doc["keep_reason"] == flag
+
+    def test_non_200_status_forces_error_keep(self):
+        rec = _recorder()
+        ctx = rec.new_context()
+        doc = rec.finish(ctx.trace_id, status=503)
+        assert doc["kept"] is True and doc["keep_reason"] == "error"
+
+    def test_slow_threshold_is_per_class(self):
+        rec = _recorder(slow_s={"interactive": 0.5, "batch": 10.0})
+        fast = rec.finish(rec.new_context().trace_id,
+                          slo="interactive", e2e_s=0.4)
+        slow = rec.finish(rec.new_context().trace_id,
+                          slo="interactive", e2e_s=0.6)
+        batch = rec.finish(rec.new_context().trace_id,
+                           slo="batch", e2e_s=0.6)
+        assert fast["kept"] is False
+        assert slow["kept"] is True and slow["keep_reason"] == "slow"
+        assert batch["kept"] is False
+
+    def test_preempted_span_tag_forces_keep(self):
+        rec = _recorder()
+        ctx = rec.new_context()
+        rec.add_span(ctx.trace_id, make_span(
+            "decode", start_unix=0.0, dur_s=0.1,
+            tags={"preempted": True}))
+        doc = rec.finish(ctx.trace_id)
+        assert doc["kept"] is True and doc["keep_reason"] == "preempted"
+
+    def test_seeded_fraction_is_deterministic(self):
+        a = _recorder(sample_fraction=0.5, seed=7)
+        b = _recorder(sample_fraction=0.5, seed=7)
+        ids = [tracing.new_trace_id() for _ in range(64)]
+        kept_a = {t for t in ids if a.finish(t)["kept"]}
+        kept_b = {t for t in ids if b.finish(t)["kept"]}
+        assert kept_a == kept_b
+        assert 0 < len(kept_a) < len(ids)
+        for t in kept_a:
+            assert a.get(t)["keep_reason"] == "seeded"
+
+    def test_fraction_one_keeps_everything(self):
+        rec = _recorder(sample_fraction=1.0)
+        doc = rec.finish(rec.new_context().trace_id)
+        assert doc["kept"] is True and doc["keep_reason"] == "seeded"
+
+    def test_stats_tracks_coverage_and_slow(self):
+        rec = _recorder(slow_s={"interactive": 0.5})
+        rec.finish(rec.new_context().trace_id, e2e_s=0.01)
+        rec.finish(rec.new_context().trace_id, e2e_s=0.9)
+        rec.finish(rec.new_context().trace_id, flags=["failover"])
+        stats = rec.stats()
+        assert stats["traces_kept"] == 2
+        assert stats["traces_dropped"] == 1
+        assert stats["trace_coverage"] == pytest.approx(2 / 3)
+        assert stats["slow_trace_count"] == 1
+
+
+class TestRecorderSpans:
+    def test_span_contextmanager_records_outcome_tags(self):
+        rec = _recorder()
+        ctx = rec.new_context()
+        with rec.span(ctx.trace_id, "dispatch",
+                      parent_id=ctx.span_id) as sp:
+            sp["tags"]["status"] = 200
+        doc = rec.finish(ctx.trace_id, flags=["retried"])
+        (span,) = doc["spans"]
+        assert span["name"] == "dispatch"
+        assert span["parent_id"] == ctx.span_id
+        assert span["tags"]["status"] == 200
+        assert span["dur_s"] >= 0.0
+
+    def test_ingest_parents_orphans_under_dispatch_span(self):
+        rec = _recorder()
+        ctx = rec.new_context()
+        replica_spans = [
+            {"span_id": "aa", "parent_id": None, "name": "queue_wait",
+             "start_unix": 1.0, "dur_s": 0.1},
+            {"span_id": "bb", "parent_id": "aa", "name": "prefill",
+             "start_unix": 1.1, "dur_s": 0.2, "tags": {"chunks": 2}},
+        ]
+        n = rec.ingest(ctx.trace_id,
+                       json.loads(json.dumps(replica_spans)),
+                       parent_id="dispatch0")
+        assert n == 2
+        doc = rec.finish(ctx.trace_id, flags=["retried"])
+        by_id = {s["span_id"]: s for s in doc["spans"]}
+        assert by_id["aa"]["parent_id"] == "dispatch0"
+        assert by_id["bb"]["parent_id"] == "aa"
+        assert by_id["bb"]["tags"] == {"chunks": 2}
+
+    def test_ingest_tolerates_garbage(self):
+        rec = _recorder()
+        ctx = rec.new_context()
+        bad = [7, "x", {}, {"span_id": "a", "name": "n"},
+               {"span_id": "a", "name": "n", "start_unix": "z",
+                "dur_s": 0.0}, None]
+        assert rec.ingest(ctx.trace_id, bad) == 0
+        assert rec.ingest(ctx.trace_id, "not-a-list") == 0
+
+    def test_span_cap_counts_overflow(self):
+        reg = MetricsRegistry()
+        rec = _recorder(registry=reg, max_spans=3)
+        ctx = rec.new_context()
+        for i in range(5):
+            rec.add_span(ctx.trace_id, make_span(
+                f"s{i}", start_unix=float(i), dur_s=0.0))
+        doc = rec.finish(ctx.trace_id, flags=["retried"])
+        assert len(doc["spans"]) == 3
+        assert doc["spans_dropped"] == 2
+        assert reg.counter_values()["trace/spans_dropped_total"] == 2
+
+    def test_get_open_then_finished(self):
+        rec = _recorder()
+        ctx = rec.new_context()
+        rec.add_span(ctx.trace_id, make_span(
+            "queue", start_unix=0.0, dur_s=0.1))
+        open_doc = rec.get(ctx.trace_id)
+        assert open_doc["open"] is True
+        assert len(open_doc["spans"]) == 1
+        rec.finish(ctx.trace_id, flags=["retried"])
+        done = rec.get(ctx.trace_id)
+        assert "open" not in done and done["kept"] is True
+        assert rec.get("nope") is None
+
+    def test_done_lru_is_bounded(self):
+        rec = _recorder(keep_traces=2)
+        tids = [rec.new_context().trace_id for _ in range(3)]
+        for t in tids:
+            rec.finish(t, flags=["retried"])
+        assert rec.get(tids[0]) is None
+        assert rec.get(tids[1]) is not None
+        assert rec.get(tids[2]) is not None
+
+
+class TestMergeOnFinish:
+    def test_second_finish_stitches_spans(self):
+        """The takeover/dedupe join: finishing an already-finished
+        trace_id merges span sets instead of forking the tree."""
+        rec = _recorder()
+        t = rec.new_context().trace_id
+        rec.add_span(t, make_span("request", start_unix=1.0, dur_s=1.0,
+                                  span_id="root"))
+        rec.finish(t, e2e_s=1.0, flags=["failover"])
+        # Same trace_id arrives again (dedupe hit on a successor).
+        rec.new_context({"trace_id": t})
+        rec.add_span(t, make_span("dedupe_hit", start_unix=2.0,
+                                  dur_s=0.01, span_id="dd"))
+        doc = rec.finish(t, e2e_s=0.01, flags=["deduped"])
+        names = [s["name"] for s in doc["spans"]]
+        assert names == ["request", "dedupe_hit"]
+        assert set(doc["flags"]) >= {"failover", "deduped"}
+        assert doc["e2e_s"] == 1.0
+        assert doc["kept"] is True
+
+    def test_merge_dedupes_span_ids(self):
+        rec = _recorder()
+        t = rec.new_context().trace_id
+        rec.add_span(t, make_span("request", start_unix=1.0, dur_s=1.0,
+                                  span_id="root"))
+        rec.finish(t, flags=["retried"])
+        rec.new_context({"trace_id": t})
+        rec.add_span(t, make_span("request", start_unix=1.0, dur_s=1.0,
+                                  span_id="root"))
+        doc = rec.finish(t, flags=["retried"])
+        assert len(doc["spans"]) == 1
+
+    def test_kept_survives_a_sampled_out_second_finish(self):
+        rec = _recorder()
+        t = rec.new_context().trace_id
+        rec.finish(t, flags=["failover"])
+        rec.new_context({"trace_id": t})
+        doc = rec.finish(t)
+        assert doc["kept"] is True
+        assert doc["keep_reason"] == "failover"
+
+    def test_error_status_sticks_through_merge(self):
+        rec = _recorder()
+        t = rec.new_context().trace_id
+        rec.finish(t, status=504)
+        rec.new_context({"trace_id": t})
+        doc = rec.finish(t, status=200)
+        assert doc["status"] == 504
+
+
+class TestTraceSink:
+    def test_kept_traces_land_as_valid_v13_lines(self, tmp_path):
+        rec = _recorder(tmp_path)
+        ctx = rec.new_context()
+        rec.add_span(ctx.trace_id, make_span(
+            "request", start_unix=1.0, dur_s=0.5, tags={"slo": "i"}))
+        rec.finish(ctx.trace_id, e2e_s=0.5, flags=["failover"])
+        rec.finish(rec.new_context().trace_id)  # sampled out: no line
+        rec.close()
+        lines = [json.loads(x) for x in
+                 open(tmp_path / "traces.jsonl") if x.strip()]
+        assert len(lines) == 1
+        (line,) = lines
+        assert line["schema_version"] == 13
+        assert line["kind"] == "trace"
+        assert schema.validate_line(line) == []
+        assert line["trace"]["trace_id"] == ctx.trace_id
+        assert line["trace"]["keep_reason"] == "failover"
+        assert "kept" not in line["trace"]
+
+    def test_read_traces_merges_and_tolerates_torn_tail(self, tmp_path):
+        rec = _recorder(tmp_path)
+        t = rec.new_context().trace_id
+        rec.add_span(t, make_span("request", start_unix=1.0, dur_s=1.0,
+                                  span_id="root"))
+        rec.finish(t, e2e_s=1.0, flags=["failover"])
+        # A successor router writes its OWN line for the same trace
+        # (separate recorder, same file — the takeover shape).
+        rec2 = TraceRecorder(registry=MetricsRegistry(),
+                             path=str(tmp_path / "traces.jsonl"),
+                             sample_fraction=0.0)
+        rec2.new_context({"trace_id": t})
+        rec2.add_span(t, make_span("dedupe_hit", start_unix=2.0,
+                                   dur_s=0.01, span_id="dd"))
+        rec2.finish(t, e2e_s=0.01, flags=["deduped"])
+        rec.close()
+        rec2.close()
+        with open(tmp_path / "traces.jsonl", "a") as f:
+            f.write('{"kind": "trace", "torn')  # crash-torn tail
+        merged = read_traces(str(tmp_path / "traces.jsonl"))
+        assert set(merged) == {t}
+        names = [s["name"] for s in merged[t]["spans"]]
+        assert names == ["request", "dedupe_hit"]
+        assert merged[t]["e2e_s"] == 1.0
+
+    def test_read_traces_missing_file(self, tmp_path):
+        assert read_traces(str(tmp_path / "absent.jsonl")) == {}
+
+
+class TestSchemaV13Ritual:
+    """The versioning ritual: the v13 additions exist, and both the
+    kind and the serving keys are forbidden on every line that
+    predates them."""
+
+    def test_v13_pins(self):
+        assert schema.SERVING_SCHEMA_VERSION == 13
+        assert schema.SERVING_KEYS_V13 == (
+            "traces_kept", "traces_dropped", "trace_coverage",
+            "slow_trace_count",
+        )
+        assert schema.KINDS_V12 == schema.KINDS_V3 + ("serving",)
+        assert schema.KINDS == schema.KINDS_V12 + ("trace",)
+        assert "trace/" in schema.INSTRUMENT_PREFIXES
+
+    def _trace_line(self, **over):
+        line = {
+            "schema_version": 13, "kind": "trace", "step": 0,
+            "time_unix": 2.0, "session_start_unix": 1.0, "host": 0,
+            "metrics": {}, "counters": {}, "gauges": {}, "derived": {},
+            "trace": {
+                "trace_id": "t" * 16, "slo": "interactive",
+                "status": 200, "e2e_s": 0.5, "keep_reason": "slow",
+                "spans": [
+                    {"span_id": "a", "parent_id": None,
+                     "name": "request", "start_unix": 1.5,
+                     "dur_s": 0.5},
+                    {"span_id": "b", "parent_id": "a",
+                     "name": "dispatch", "start_unix": 1.6,
+                     "dur_s": 0.4, "tags": {"status": 200}},
+                ],
+            },
+        }
+        line.update(over)
+        return line
+
+    def test_valid_trace_line_passes(self):
+        assert schema.validate_line(self._trace_line()) == []
+
+    def test_trace_kind_forbidden_before_v13(self):
+        for version in (4, 5, 6, 7, 8, 9, 10, 11, 12):
+            problems = schema.validate_line(
+                self._trace_line(schema_version=version))
+            assert any("kind 'trace'" in p for p in problems), (
+                version, problems)
+
+    def test_v13_serving_keys_forbidden_before_v13(self):
+        base = {
+            "schema_version": 13, "kind": "serving", "step": 1,
+            "time_unix": 1.0, "session_start_unix": 1.0, "host": 0,
+            "metrics": {}, "counters": {}, "gauges": {}, "derived": {},
+            "serving": {
+                "active_requests": 0, "queue_depth": 0, "slots": 4,
+                "kv_occupancy": 0.0, "post_warmup_recompiles": 0,
+                "draining": 0, "traces_kept": 2, "traces_dropped": 1,
+                "trace_coverage": 0.66, "slow_trace_count": 1,
+            },
+        }
+        assert schema.validate_line(base) == []
+        for version in (4, 5, 6, 7, 8, 9, 10, 11, 12):
+            stale = dict(base, schema_version=version)
+            problems = schema.validate_line(stale)
+            for key in schema.SERVING_KEYS_V13:
+                assert any(
+                    f"v13 serving key '{key}'" in p for p in problems
+                ), (version, key, problems)
+
+    def test_trace_object_forbidden_on_non_trace_lines(self):
+        line = self._trace_line(kind="window")
+        line["metrics"] = {"loss": 1.0}
+        problems = schema.validate_line(line)
+        assert any("trace object on a non-trace line" in p
+                   for p in problems)
+
+    def test_missing_trace_object_flagged(self):
+        line = self._trace_line()
+        del line["trace"]
+        problems = schema.validate_line(line)
+        assert any("missing the trace object" in p for p in problems)
+
+    def test_span_shape_enforced(self):
+        line = self._trace_line()
+        line["trace"]["spans"] = [
+            {"span_id": 7, "name": "x", "start_unix": 1.0,
+             "dur_s": "z", "parent_id": 3, "tags": []},
+            {"name": "y"},
+            "not-a-span",
+        ]
+        problems = schema.validate_line(line)
+        blob = "\n".join(problems)
+        assert "['span_id'] = 7 is not a string" in blob
+        assert "['dur_s'] = 'z' is not a number" in blob
+        assert "['parent_id'] = 3 is not a string or null" in blob
+        assert "['tags'] = [] is not an object" in blob
+        assert "missing 'span_id'" in blob
+        assert "trace['spans'][2] is not an object" in blob
+
+    def test_status_bool_rejected(self):
+        line = self._trace_line()
+        line["trace"]["status"] = True
+        problems = schema.validate_line(line)
+        assert any("is not an int" in p for p in problems)

@@ -126,6 +126,14 @@ RECORD_KEYS: dict[str, str] = {
     # Floorless: the record's own ok already gates lost_requests at 0
     # and dedup_hits >= 1, so only the latency needs a floor file.
     "takeover_latency_s": "max",
+    # Distributed tracing (ISSUE 18): serve_bench banks the recorder's
+    # tail-sampling summary — coverage (kept / finished) pinned as a
+    # minimum so a sampler regression that quietly stops keeping the
+    # interesting traces fails CI, and the slow-trace count as a
+    # maximum (a latency regression surfaces here as MORE traces
+    # crossing their class threshold, before any p95 floor moves).
+    "trace_coverage": "min",
+    "slow_trace_count": "max",
 }
 
 
@@ -432,9 +440,15 @@ def report_lint_baseline(
     return 0
 
 
-def report_floorless(floors_path: str | None = None) -> int:
+def report_floorless(floors_path: str | None = None,
+                     out_path: str | None = None) -> int:
     """WARN (never fail) for every floorless gate key; exit 0 always —
-    this is a to-harvest list, not a regression."""
+    this is a to-harvest list, not a regression.
+
+    ``out_path`` (ISSUE 18 satellite) banks the list INTO a record:
+    a JSON doc carrying the floorless keys and the full gate-key
+    census, so the first real-rig session reads its harvest list from
+    an artifact instead of scraping WARN lines out of CI logs."""
     missing = floorless_keys(floors_path)
     for key in missing:
         print(
@@ -446,6 +460,19 @@ def report_floorless(floors_path: str | None = None) -> int:
         f"bench_gate floorless: {len(missing)} gate key(s) await a "
         "banked floor"
     )
+    if out_path:
+        doc = {
+            "kind": "bench_gate_floorless",
+            "floorless": missing,
+            "floorless_count": len(missing),
+            "gate_keys": {
+                k: RECORD_KEYS[k] for k in sorted(RECORD_KEYS)
+            },
+        }
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"banked floorless record -> {out_path}")
     return 0
 
 
@@ -549,6 +576,11 @@ def main(argv=None) -> int:
         "appended to every trajectory gate",
     )
     ap.add_argument(
+        "--out", metavar="OUT_JSON",
+        help="with --floorless-report: also bank the floorless list "
+        "(plus the full gate-key census) as a JSON record",
+    )
+    ap.add_argument(
         "--lint-baseline-report", action="store_true",
         help="report the graftlint suppression-baseline size vs its "
         "tracked count (WARN on growth, exit 0 always; also appended "
@@ -557,7 +589,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.floorless_report:
-        return report_floorless(args.floors)
+        return report_floorless(args.floors, args.out)
     if args.lint_baseline_report:
         return report_lint_baseline()
     if args.stamp:

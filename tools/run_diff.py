@@ -100,6 +100,9 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     ("lost_requests", "lower", "", 1.0),
     ("resumed_streams", "higher", "", 1.0),
     ("dedup_hits", "higher", "", 1.0),
+    # ---- distributed-tracing records (ISSUE 18) ----
+    ("trace_coverage", "higher", "", 1.0),
+    ("slow_trace_count", "lower", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -137,6 +140,9 @@ GATE_KEYS = (
     "hbm_bytes_per_replica",
     # control-plane takeover gate keys (ISSUE 16)
     "takeover_latency_s",
+    # distributed-tracing gate keys (ISSUE 18)
+    "trace_coverage",
+    "slow_trace_count",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

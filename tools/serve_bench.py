@@ -315,12 +315,21 @@ def _post_json(url: str, body: dict, timeout: float) -> tuple[int, dict]:
 
 def drive(frontend, prompts, *, concurrency: int, max_new: int,
           temperature: float, top_k: int, http_url: str | None,
-          timeout: float) -> dict:
+          timeout: float, trace_recorder=None) -> dict:
     """Closed loop: workers pull the next prompt off a shared list the
     moment their current request resolves. Returns per-request replies
     (index-aligned with ``prompts``), per-request CLIENT wall times
     (``client_s`` — includes every router retry/failover, which the
-    replica-measured ``total_s`` cannot see), + wall time."""
+    replica-measured ``total_s`` cannot see), + wall time.
+
+    ``trace_recorder`` (ISSUE 18): a ``tracing.TraceRecorder`` makes
+    the bench the CLIENT-side trace originator for replica-direct
+    runs — each request ships a wire context, the reply's
+    ``trace_spans`` ingest under a client root span, and the trace
+    finishes with the client wall. Router runs leave this None: the
+    router mints and owns the trace there."""
+    from tensorflow_examples_tpu.telemetry import tracing
+
     replies: list[tuple[int, dict] | None] = [None] * len(prompts)
     client_s: list[float | None] = [None] * len(prompts)
     next_i = [0]
@@ -340,12 +349,43 @@ def drive(frontend, prompts, *, concurrency: int, max_new: int,
                 "top_k": top_k,
                 "seed": i,  # per-request stream: replayable
             }
+            root_id = None
+            ctx = None
+            if trace_recorder is not None:
+                ctx = trace_recorder.new_context()
+                root_id = tracing.new_span_id()
+                body["trace"] = {
+                    "trace_id": ctx.trace_id,
+                    "parent_span_id": root_id,
+                    "sampled": True,
+                }
+            t_mono = time.monotonic()
             t_req = time.perf_counter()
             if http_url is not None:
                 replies[i] = _post_json(http_url, body, timeout)
             else:
                 replies[i] = frontend.handle_request(body, kind="generate")
             client_s[i] = time.perf_counter() - t_req
+            if trace_recorder is not None:
+                status, reply = replies[i] or (0, {})
+                spans = (
+                    reply.pop("trace_spans", None)
+                    if isinstance(reply, dict) else None
+                )
+                if spans:
+                    trace_recorder.ingest(
+                        ctx.trace_id, spans, parent_id=root_id
+                    )
+                trace_recorder.add_span(
+                    ctx.trace_id, tracing.close_span(
+                        "request", t_mono, span_id=root_id,
+                        tags={"status": int(status)},
+                    )
+                )
+                trace_recorder.finish(
+                    ctx.trace_id, slo="interactive",
+                    status=int(status), e2e_s=client_s[i],
+                )
 
     t0 = time.perf_counter()
     threads = [
@@ -546,7 +586,12 @@ def run_router_bench(args) -> dict:
         cfg=RouterConfig(
             probe_interval_s=0.2, request_timeout_s=args.timeout,
             prefix_affinity=(args.affinity != "off"),
+            # Bench runs keep every trace (ISSUE 18): coverage banks
+            # at 1.0 on a healthy tier, and the kept set is the full
+            # population the attribution tool reads.
+            trace_sample_fraction=1.0,
         ),
+        trace_path=(args.trace_out or None),
     ).start()
     rfront = RouterFrontend(router, port=0).start()
 
@@ -680,6 +725,10 @@ def run_router_bench(args) -> dict:
         "affinity": args.affinity != "off",
         "transport": "router-http",
     }
+    # The router owns the traces in this mode; its recorder's summary
+    # is the record's tracing claim (ISSUE 18). stats() only reads
+    # registry counters, so the closed router is safe to ask.
+    rec.update(router.recorder.stats())
     rec["ok"] = bool(
         len(done) == len(replies) and verify_ok and recompiles == 0
     )
@@ -2361,6 +2410,11 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="per-request client timeout (seconds)")
     ap.add_argument("--out", default="", help="bank the record here")
+    ap.add_argument("--trace-out", default="",
+                    help="ISSUE 18: land the run's kept traces as "
+                         "schema-v13 kind=\"trace\" JSONL here "
+                         "(plain + --router modes); the record banks "
+                         "trace_coverage / slow_trace_count either way")
     args = ap.parse_args(argv)
     if not args.smoke and not args.workdir:
         ap.error("pick a target: --smoke or --workdir DIR")
@@ -2495,12 +2549,23 @@ def main(argv=None) -> int:
     if not args.inproc:
         frontend.start()
         http_url = frontend.url("/generate")
+    # Client-originated tracing (ISSUE 18): a closed-loop bench keeps
+    # EVERY trace (sample_fraction=1.0 — it is measuring, not
+    # serving production traffic), so trace_coverage banks at 1.0 on
+    # a healthy run and the slow-trace count is exhaustive.
+    from tensorflow_examples_tpu.telemetry import tracing
+
+    recorder = tracing.TraceRecorder(
+        registry=registry, path=args.trace_out or None,
+        sample_fraction=1.0,
+    )
     try:
         outcome = drive(
             frontend, prompts,
             concurrency=args.concurrency, max_new=args.max_new_tokens,
             temperature=args.temperature, top_k=args.top_k,
             http_url=http_url, timeout=args.timeout,
+            trace_recorder=recorder,
         )
         verify_ok = True
         for i in range(min(verify, n)):
@@ -2522,6 +2587,7 @@ def main(argv=None) -> int:
     finally:
         batcher.close(drain=True)
         frontend.close()
+        recorder.close()
 
     rec = bench_record(
         engine, registry, outcome, prompts,
@@ -2530,6 +2596,7 @@ def main(argv=None) -> int:
     )
     rec["warmup_s"] = round(warmup_s, 3)
     rec["transport"] = "inproc" if args.inproc else "http"
+    rec.update(recorder.stats())  # trace_coverage / slow_trace_count
     print(json.dumps(rec))
     if args.out:
         with open(args.out, "w") as f:

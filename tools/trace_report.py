@@ -1,0 +1,282 @@
+#!/usr/bin/env python3
+"""Render tail-sampled trace trees and attribute tail latency (ISSUE 18).
+
+The serving tier's tail sampler (``telemetry/tracing.py``) lands the
+interesting traces — slow, errored, retried, failed-over, preempted,
+deduped, resumed, plus a seeded slice of normal traffic — as
+schema-v13 ``kind="trace"`` JSONL lines. This tool answers the two
+questions an operator actually asks of them:
+
+* ``--trace-id ID`` — ONE request's story: the span tree rendered with
+  per-span wall and tags, plus its critical path (the chain of spans
+  that actually bounds the request's end time — time spent anywhere
+  else was hidden behind it).
+
+* default — WHERE the tail lives: pick the traces at or above the
+  ``--percentile`` e2e (within ``--slo``, default all classes), run
+  each one's critical path, and aggregate SELF time per span name.
+  The top row is the leg your p99 is made of — queue wait vs prefill
+  vs decode vs a failover's burned dispatch — measured, not guessed.
+
+Reads any number of trace JSONL files (multiple routers' sinks merge
+by trace_id — a takeover-survived request stitches here exactly like
+it does in the recorder). Tolerant of torn tails by construction
+(``tracing.read_traces``). Stdlib + repo only; no device, no network.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tensorflow_examples_tpu.telemetry import tracing  # noqa: E402
+
+
+# ----------------------------------------------------------- loading
+
+
+def load_traces(paths: list[str]) -> dict:
+    """{trace_id: merged doc} across every given sink file — the same
+    merge discipline as a takeover stitch (span union by span_id, e2e
+    max, non-200 status sticks)."""
+    merged: dict = {}
+    for path in paths:
+        for tid, doc in tracing.read_traces(path).items():
+            prior = merged.get(tid)
+            if prior is None:
+                merged[tid] = doc
+                continue
+            seen = {s["span_id"] for s in prior["spans"]}
+            prior["spans"].extend(
+                s for s in doc["spans"] if s["span_id"] not in seen
+            )
+            prior["spans"].sort(key=lambda s: s["start_unix"])
+            prior["e2e_s"] = max(prior["e2e_s"], doc["e2e_s"])
+            if doc["status"] != 200:
+                prior["status"] = doc["status"]
+    return merged
+
+
+# ------------------------------------------------------- span algebra
+
+
+def build_tree(doc: dict) -> tuple[list, dict]:
+    """(roots, children-by-span_id), children start-ordered. A span
+    whose parent never landed (dropped by the per-trace cap, or a leg
+    the wire lost) renders as its own root rather than vanishing."""
+    spans = doc.get("spans", [])
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict = {}
+    roots: list = []
+    for s in spans:
+        p = s.get("parent_id")
+        if p and p in by_id and p != s["span_id"]:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: s["start_unix"])
+    roots.sort(key=lambda s: s["start_unix"])
+    return roots, children
+
+
+def critical_path(doc: dict) -> list:
+    """The chain of spans bounding the request's end time: from the
+    longest root, repeatedly descend into the child whose END is
+    latest — everything off that chain overlapped it and could not
+    have delayed the reply. Each step carries ``self_s``: the span's
+    wall MINUS its on-path child's, i.e. the time this leg itself
+    added (the attribution unit)."""
+    roots, children = build_tree(doc)
+    if not roots:
+        return []
+    root = max(roots, key=lambda s: float(s.get("dur_s", 0.0)))
+    path = [root]
+    cur = root
+    while True:
+        kids = children.get(cur["span_id"])
+        if not kids:
+            break
+        cur = max(
+            kids,
+            key=lambda s: float(s["start_unix"]) + float(s["dur_s"]),
+        )
+        path.append(cur)
+    out = []
+    for i, s in enumerate(path):
+        child_dur = (
+            float(path[i + 1]["dur_s"]) if i + 1 < len(path) else 0.0
+        )
+        out.append({
+            "name": s["name"],
+            "dur_s": float(s["dur_s"]),
+            "self_s": max(0.0, float(s["dur_s"]) - child_dur),
+            "tags": s.get("tags", {}),
+        })
+    return out
+
+
+def attribution(docs: list, percentile: float) -> dict:
+    """Aggregate critical-path SELF time per span name over the traces
+    at/above the e2e percentile. Returns the ranked rows plus the
+    threshold and population, so the report says which tail it
+    measured, not just what it found."""
+    if not docs:
+        return {"threshold_s": None, "tail": 0, "total": 0, "rows": []}
+    e2es = sorted(float(d.get("e2e_s", 0.0)) for d in docs)
+    idx = min(
+        len(e2es) - 1,
+        max(0, int(round((percentile / 100.0) * (len(e2es) - 1)))),
+    )
+    threshold = e2es[idx]
+    tail = [d for d in docs if float(d.get("e2e_s", 0.0)) >= threshold]
+    agg: dict = {}
+    for doc in tail:
+        for step in critical_path(doc):
+            row = agg.setdefault(
+                step["name"], {"name": step["name"], "self_s": 0.0,
+                               "count": 0}
+            )
+            row["self_s"] += step["self_s"]
+            row["count"] += 1
+    rows = sorted(agg.values(), key=lambda r: -r["self_s"])
+    return {
+        "threshold_s": threshold,
+        "tail": len(tail),
+        "total": len(docs),
+        "rows": rows,
+    }
+
+
+# ----------------------------------------------------------- rendering
+
+
+def _fmt_tags(tags: dict) -> str:
+    if not tags:
+        return ""
+    inner = " ".join(f"{k}={tags[k]}" for k in sorted(tags))
+    return f"  [{inner}]"
+
+
+def render_tree(doc: dict) -> str:
+    """The span tree as indented text, one span per line:
+    name, wall, start offset from the trace's first span, tags."""
+    roots, children = build_tree(doc)
+    t0 = min(
+        (float(s["start_unix"]) for s in doc.get("spans", [])),
+        default=0.0,
+    )
+    lines = [
+        f"trace {doc['trace_id']}  slo={doc.get('slo')}  "
+        f"status={doc.get('status')}  e2e={doc.get('e2e_s', 0.0):.4f}s  "
+        f"keep={doc.get('keep_reason')}  "
+        f"flags={','.join(doc.get('flags', [])) or '-'}"
+    ]
+
+    def walk(span, depth):
+        off = float(span["start_unix"]) - t0
+        lines.append(
+            f"{'  ' * depth}- {span['name']}  "
+            f"{float(span['dur_s']):.4f}s  (+{off:.4f}s)"
+            f"{_fmt_tags(span.get('tags', {}))}"
+        )
+        for kid in children.get(span["span_id"], ()):
+            walk(kid, depth + 1)
+
+    for root in roots:
+        walk(root, 1)
+    path = critical_path(doc)
+    if path:
+        lines.append("critical path:")
+        for step in path:
+            lines.append(
+                f"  {step['name']}  self={step['self_s']:.4f}s  "
+                f"(span {step['dur_s']:.4f}s)"
+            )
+    return "\n".join(lines)
+
+
+def render_attribution(report: dict, percentile: float) -> str:
+    if not report["total"]:
+        return "no traces loaded"
+    head = (
+        f"p{percentile:g} attribution: {report['tail']} tail trace(s) "
+        f"of {report['total']} at e2e >= {report['threshold_s']:.4f}s"
+    )
+    lines = [head]
+    total_self = sum(r["self_s"] for r in report["rows"]) or 1.0
+    for r in report["rows"]:
+        lines.append(
+            f"  {r['name']:<24} self={r['self_s']:.4f}s  "
+            f"({100.0 * r['self_s'] / total_self:5.1f}%)  "
+            f"spans={r['count']}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "paths", nargs="+",
+        help="trace JSONL sink file(s); multiple files merge by "
+        "trace_id",
+    )
+    ap.add_argument(
+        "--trace-id", default="",
+        help="render ONE trace's span tree + critical path",
+    )
+    ap.add_argument(
+        "--percentile", type=float, default=99.0,
+        help="e2e percentile the attribution report targets "
+        "(default 99)",
+    )
+    ap.add_argument(
+        "--slo", default="",
+        help="restrict the attribution to one SLO class",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the report as JSON instead of text",
+    )
+    args = ap.parse_args(argv)
+
+    traces = load_traces(args.paths)
+    if args.trace_id:
+        doc = traces.get(args.trace_id)
+        if doc is None:
+            print(
+                f"trace_report: unknown trace {args.trace_id!r} "
+                f"({len(traces)} trace(s) loaded)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.json:
+            doc = dict(doc)
+            doc["critical_path"] = critical_path(doc)
+            print(json.dumps(doc, sort_keys=True))
+        else:
+            print(render_tree(doc))
+        return 0
+
+    docs = [
+        d for d in traces.values()
+        if not args.slo or d.get("slo") == args.slo
+    ]
+    report = attribution(docs, args.percentile)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render_attribution(report, args.percentile))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,9 @@ ids as "prompt". A schema-v4
 ``kind="serving"`` stats line is appended to ``workdir/serving.jsonl``
 every ``--stats_every`` seconds (the serving counterpart of training's
 ``metrics.jsonl`` — same JSONL discipline, ``/window`` serves the
-latest line).
+latest line). The same tick samples the in-process time-series store
+(ISSUE 19), so ``GET /series`` serves ring-buffered instrument history
+with p50/p95/p99 rollups.
 """
 
 import json
@@ -296,6 +298,10 @@ def main(argv):
         def stats_loop():
             while not batcher._stop.is_set():
                 time.sleep(FLAGS.stats_every)
+                # One stats tick = one time-series ring sample
+                # (ISSUE 19): GET /series history accrues on exactly
+                # the cadence the stats line does.
+                frontend.series.sample()
                 with open(stats_path, "a") as f:
                     f.write(json.dumps(batcher.stats_line()) + "\n")
 

@@ -41,6 +41,9 @@ Endpoints:
   here the moment the drain starts).
 * ``GET /window`` — the latest schema-v4 ``kind="serving"`` stats line
   (``ContinuousBatcher.stats_line``).
+* ``GET /series`` — the in-process time-series store (ISSUE 19):
+  ring-buffered history of every instrument, sampled on the stats
+  loop's cadence, with p50/p95/p99 rollups per series.
 
 Status mapping (the flow-control contract, outermost first):
 ``QueueFull``/``Draining`` -> 503 (retry elsewhere/later, body says
@@ -74,6 +77,7 @@ from tensorflow_examples_tpu.serving.batcher import (
     Request,
 )
 from tensorflow_examples_tpu.serving.paged_kv import BlockExhausted
+from tensorflow_examples_tpu.telemetry import timeseries as timeseries_mod
 from tensorflow_examples_tpu.telemetry.serve import (
     json_safe,
     render_prometheus,
@@ -164,6 +168,12 @@ def _request_from_body(body: dict, *, kind: str, tokenizer=None) -> Request:
         # request's replica-side spans come back in the reply under
         # "trace_spans"; an untraced body costs nothing.
         "trace",
+        # ISSUE 19: the synthetic canary prober's tag. The router
+        # strips it before dispatch, but the prober also probes
+        # replicas DIRECTLY (per-replica black-box TTFT), so a replica
+        # must tolerate it — accepted and ignored here (a replica has
+        # no journal or organic-vs-probe accounting to protect).
+        "probe",
     }
     if kind == "resume":
         known |= {"pages", "first_token"}
@@ -260,6 +270,12 @@ class ServingFrontend:
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # In-process time-series store (ISSUE 19), served as
+        # GET /series. The frontend owns no cadence of its own — the
+        # serving process's stats loop calls ``series.sample()`` on
+        # its tick (examples/gpt2/serve.py), exactly like the stats
+        # line itself.
+        self.series = timeseries_mod.TimeSeriesStore(batcher.registry)
 
     @property
     def replica_id(self) -> int:
@@ -501,11 +517,17 @@ class ServingFrontend:
                         self._send_json(*server.health_payload())
                     elif path == "/window":
                         self._send_json(200, server.batcher.stats_line())
+                    elif path == "/series":
+                        # Ring-buffered instrument history (ISSUE 19),
+                        # sampled by the stats loop's tick.
+                        self._send_json(
+                            200, server.series.to_payload()
+                        )
                     else:
                         self._send(
                             404,
                             "text/plain; charset=utf-8",
-                            b"GET: /metrics /health /window   "
+                            b"GET: /metrics /health /window /series   "
                             b"POST: /generate /classify /prefill "
                             b"/resume\n",
                         )

@@ -1,0 +1,235 @@
+"""Synthetic canary prober (ISSUE 19).
+
+Organic traffic tells you about the requests users already sent; it is
+silent about the replica that would fail the NEXT one, and on a quiet
+fleet it is silent entirely. The canary prober closes that gap with
+low-rate deterministic known-answer requests driven from the OUTSIDE —
+plain HTTP against the router frontend and against every replica
+frontend directly, exactly the path a client takes — so the fleet's
+availability and black-box TTFT are measured per replica even at zero
+organic load, and a sick replica feeds the :class:`AlertEngine` AHEAD
+of the users who would have discovered it.
+
+**Known-answer.** Generation in this stack is a pure function of
+(params, prompt, seed) — ``temperature=0`` with a fixed prompt and
+seed produces the same token stream on every healthy replica, every
+time. The first successful probe of each target BANKS that stream as
+the expected answer; every later probe compares. A mismatch is a
+failed probe even with a 200 status — the silently-corrupted-replica
+case no status code catches.
+
+**Exclusion.** Every probe body carries ``"probe": true``. The router
+strips the tag and excludes the request from the journal (no
+dedupe-window entry, no tenant intent record), from
+``router/requests_total``, and from its organic AlertEngine feed;
+replica frontends tolerate and ignore the tag (``_request_from_body``).
+Probe traffic is accounted ONLY under the ``probe/`` instruments and
+through :meth:`AlertEngine.observe_probe` — it can never inflate a
+banked bench record or replay after a crash.
+
+**Compiled paths.** Probes are ordinary generate requests over the
+replica's warmed buckets (the default probe prompt is short and the
+token budget tiny), so they ride the compiled serving path — zero
+post-warmup recompiles is part of the chaos acceptance golden.
+
+The prober owns one daemon thread (``canary-prober``); tests call
+:meth:`probe_once` directly for determinism. Firing alerts are the
+advisory signal the autoscaler consumes (``advisory()``).
+
+Stdlib + repo only; no device.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from tensorflow_examples_tpu.serving.router import post_json
+from tensorflow_examples_tpu.telemetry import registry as registry_mod
+
+log = logging.getLogger(__name__)
+
+# The default known-answer request: a short fixed prompt inside every
+# engine's vocab floor (the smoke model's vocab is 211), zero
+# temperature, a fixed seed, and a tiny token budget — cheap enough to
+# run at probe rate forever, deterministic enough to bank.
+DEFAULT_PROBE_PROMPT = (11, 13, 17, 19)
+DEFAULT_PROBE_TOKENS = 4
+
+
+class CanaryProber:
+    """Low-rate black-box prober over a router + its replicas.
+
+    ``targets`` is ``{name: base_url}`` — conventionally the router
+    under ``"router"`` plus each replica under its URL (see
+    :func:`fleet_targets`). Results feed ``alerts.observe_probe`` (the
+    availability budget, per the target's SLO class) and the engine is
+    evaluated after every sweep, so a dead replica's alert fires on
+    the PROBE cadence, not the organic-traffic cadence."""
+
+    def __init__(
+        self,
+        targets: dict,
+        *,
+        alerts=None,
+        registry=None,
+        interval_s: float = 1.0,
+        timeout_s: float = 10.0,
+        prompt=DEFAULT_PROBE_PROMPT,
+        max_new_tokens: int = DEFAULT_PROBE_TOKENS,
+        seed: int = 1234,
+        slo: str = "interactive",
+    ):
+        if not targets:
+            raise ValueError("prober needs at least one target")
+        self.targets = {
+            str(name): url.rstrip("/") for name, url in targets.items()
+        }
+        self.alerts = alerts
+        self.registry = (
+            registry if registry is not None
+            else registry_mod.MetricsRegistry()
+        )
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.seed = int(seed)
+        self.slo = str(slo)
+        self._expected: dict[str, list[int]] = {}  # guard: _lock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.sweeps = 0
+
+    # ------------------------------------------------------------ body
+
+    def probe_body(self) -> dict:
+        return {
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": 0.0,
+            "seed": self.seed,
+            "slo": self.slo,
+            # The exclusion tag (stripped by the router, tolerated by
+            # replicas): synthetic traffic must never enter the
+            # journal dedupe window or the organic counters.
+            "probe": True,
+        }
+
+    # ----------------------------------------------------------- sweep
+
+    def probe_one(self, name: str, url: str) -> dict:
+        """One probe of one target; returns the result doc and feeds
+        the AlertEngine."""
+        reg = self.registry
+        reg.counter("probe/sent_total").inc()
+        t0 = time.monotonic()
+        status, reply = post_json(
+            url + "/generate", self.probe_body(), self.timeout_s
+        )
+        wall = time.monotonic() - t0
+        tokens = reply.get("tokens") if isinstance(reply, dict) else None
+        ok = status == 200 and isinstance(tokens, list) and bool(tokens)
+        mismatch = False
+        if ok:
+            with self._lock:
+                expected = self._expected.get(name)
+                if expected is None:
+                    # First success banks the known answer (generation
+                    # is deterministic by seeding, so any healthy
+                    # target of the same build reproduces it).
+                    self._expected[name] = list(tokens)
+                elif list(tokens) != expected:
+                    mismatch = True
+        if mismatch:
+            ok = False
+            reg.counter("probe/mismatch_total").inc()
+        if not ok:
+            reg.counter("probe/failed_total").inc()
+        # Black-box TTFT: prefer the replica's own measurement when
+        # the reply carries one; the client-observed wall is the
+        # fallback (and is what a router-path probe sees).
+        ttft = reply.get("ttft_s") if isinstance(reply, dict) else None
+        if not isinstance(ttft, (int, float)) or isinstance(ttft, bool):
+            ttft = wall
+        if ok:
+            reg.histogram("probe/ttft").record(float(ttft))
+        result = {
+            "target": name, "ok": ok, "status": status,
+            "mismatch": mismatch, "ttft_s": float(ttft),
+            "wall_s": wall,
+            "trace_id": reply.get("trace_id")
+            if isinstance(reply, dict) else None,
+        }
+        if self.alerts is not None:
+            self.alerts.observe_probe(
+                slo=self.slo, ok=ok, replica=name,
+                ttft_s=float(ttft) if ok else None,
+                trace_id=result["trace_id"],
+            )
+        return result
+
+    def probe_once(self) -> list[dict]:
+        """One synchronous sweep over every target (the background
+        loop's body; tests call it directly), followed by one
+        AlertEngine evaluation — probe failures raise alerts on THIS
+        cadence, ahead of organic traffic."""
+        results = [
+            self.probe_one(name, url)
+            for name, url in self.targets.items()
+        ]
+        self.sweeps += 1
+        if self.alerts is not None:
+            self.alerts.evaluate()
+        failed = [r["target"] for r in results if not r["ok"]]
+        if failed:
+            log.warning("canary probe failures: %s", failed)
+        return results
+
+    # --------------------------------------------------------- advisory
+
+    def advisory(self) -> bool:
+        """True while any alert is firing — the signal the PR-12
+        autoscaler/brownout ladder consumes (``Autoscaler(alerts=...)``
+        treats it as a hot fleet)."""
+        if self.alerts is None:
+            return False
+        return self.alerts.stats()["alerts_firing"] > 0
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> "CanaryProber":
+        self._thread = threading.Thread(
+            target=self._loop, name="canary-prober", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("canary probe sweep failed")
+            self._stop.wait(self.interval_s)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def fleet_targets(router_url: str | None,
+                  replica_urls: list[str]) -> dict:
+    """The conventional target map: the router (end-to-end path) under
+    ``"router"`` plus every replica under its own URL (per-replica
+    black-box availability — a router would mask a single sick replica
+    by failing over around it)."""
+    targets: dict = {}
+    if router_url:
+        targets["router"] = router_url
+    for url in replica_urls:
+        targets[url.rstrip("/")] = url
+    return targets

@@ -111,6 +111,8 @@ import uuid
 
 from tensorflow_examples_tpu.telemetry import registry as registry_mod
 from tensorflow_examples_tpu.telemetry import schema
+from tensorflow_examples_tpu.telemetry import slo as slo_mod
+from tensorflow_examples_tpu.telemetry import timeseries as timeseries_mod
 from tensorflow_examples_tpu.telemetry import tracing as tracing_mod
 from tensorflow_examples_tpu.telemetry.serve import (
     json_safe,
@@ -437,6 +439,8 @@ class Router:
         fencing_token: int = 0,
         recorder=None,
         trace_path: str | None = None,
+        slo_cfg=None,
+        alert_path: str | None = None,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica URL")
@@ -484,6 +488,20 @@ class Router:
                 seed=self.cfg.trace_seed,
             )
         )
+        # SLO alerting (ISSUE 19): always on — a default SLOConfig is
+        # deliberately generous, so the engine is silent until traffic
+        # actually breaches an objective. Every finished ORGANIC
+        # request feeds it (probe-tagged requests feed it through
+        # serving/prober.py instead); firing/resolve transitions land
+        # in ``alert_path`` as v14 ``kind="alert"`` lines and the
+        # summary rides the stats line (the v14 keys).
+        self.alerts = slo_mod.AlertEngine(
+            slo_cfg, registry=self.registry, path=alert_path,
+        )
+        # In-process time-series store (ISSUE 19): sampled once per
+        # stats_line() call — the existing stats cadence — and served
+        # as GET /series by the frontend.
+        self.series = timeseries_mod.TimeSeriesStore(self.registry)
 
     def attach_lease(self, lease, token: int) -> None:
         """(Re)bind this router to the active-router lease at fencing
@@ -639,6 +657,7 @@ class Router:
             # An injected (shared) recorder outlives this router — the
             # RouterPair's successor is still finishing traces into it.
             self.recorder.close()
+        self.alerts.close()
 
     # ------------------------------------------------ elastic fleet (ISSUE 13)
 
@@ -1276,9 +1295,27 @@ class Router:
         re-dispatch is token-identical by seeding, so slicing off the
         committed prefix IS the original stream's tail). A router
         whose lease is fenced (a promoted standby holds a newer token)
-        refuses every dispatch with a retryable 503."""
+        refuses every dispatch with a retryable 503.
+
+        ISSUE 19 probes: a body carrying ``"probe": true`` (the
+        synthetic canary prober's tag, stripped before dispatch) rides
+        the NORMAL dispatch path — same compiled replica code, same
+        retry machinery — but is excluded from the organic request
+        accounting: it never touches the journal (no dedupe-window
+        entry, no tenant intent record), never counts in
+        ``router/requests_total``, and never feeds the AlertEngine's
+        organic rules (the prober reports its own results through
+        ``observe_probe``). Probe traffic counts only under the
+        ``probe/`` instruments."""
         reg = self.registry
-        reg.counter("router/requests_total").inc()
+        is_probe = False
+        if kind == "generate" and "probe" in body:
+            body = dict(body)  # never mutate the caller's dict
+            is_probe = bool(body.pop("probe"))
+        if is_probe:
+            reg.counter("probe/router_requests_total").inc()
+        else:
+            reg.counter("router/requests_total").inc()
         t0 = time.monotonic()
         request_id: str | None = None
         resume_from = 0
@@ -1337,9 +1374,15 @@ class Router:
                 "fenced": True, "retry": True, "shed": True,
             }
             reg.histogram("router/e2e").record(time.monotonic() - t0)
-            self._trace_finish(tr, 503, reply, t0)
+            self._trace_finish(tr, 503, reply, t0, probe=is_probe)
             return 503, reply
-        journal = self.journal if kind == "generate" else None
+        # Probe exclusion (ISSUE 19): a canary probe must never enter
+        # the dedupe window or leave tenant intent records — a fleet
+        # restart would otherwise replay synthetic traffic.
+        journal = (
+            self.journal if kind == "generate" and not is_probe
+            else None
+        )
         if journal is not None and request_id is not None:
             hit = journal.lookup(request_id)
             if hit is not None:
@@ -1375,7 +1418,7 @@ class Router:
                     if isinstance(orig_tid, str) and orig_tid:
                         self.recorder.adopt(tr.trace_id, orig_tid)
                         tr.trace_id = orig_tid
-                self._trace_finish(tr, 200, reply, t0)
+                self._trace_finish(tr, 200, reply, t0, probe=is_probe)
                 return 200, reply
         if self.fleet_down():
             # Fast-fail (ISSUE 13 satellite): a fleet-wide outage
@@ -1390,7 +1433,7 @@ class Router:
             }
             self._set_stats["base"].record(503, reply)
             reg.histogram("router/e2e").record(time.monotonic() - t0)
-            self._trace_finish(tr, 503, reply, t0)
+            self._trace_finish(tr, 503, reply, t0, probe=is_probe)
             return 503, reply
         prompt = self._clean_prompt(body)
         if journal is not None and prompt is None:
@@ -1422,7 +1465,7 @@ class Router:
             reply = {
                 "error": "router killed (injected fault)", "retry": True,
             }
-            self._trace_finish(tr, 503, reply, t0)
+            self._trace_finish(tr, 503, reply, t0, probe=is_probe)
             return 503, reply
         status, reply = self._handle_dispatch(body, kind, t0, prompt, tr)
         if status == 200 and journal is not None and isinstance(
@@ -1453,11 +1496,11 @@ class Router:
                 reply["resume_from"] = resume_from
             if request_id is not None:
                 reply.setdefault("request_id", request_id)
-        self._trace_finish(tr, status, reply, t0)
+        self._trace_finish(tr, status, reply, t0, probe=is_probe)
         return status, reply
 
     def _trace_finish(self, tr, status: int, reply: dict,
-                      t0: float) -> None:
+                      t0: float, *, probe: bool = False) -> None:
         """Close the request's root span and hand the trace to the
         tail sampler (ISSUE 18). Every handle() exit path for a traced
         request funnels through here exactly once — including the
@@ -1482,6 +1525,16 @@ class Router:
         )
         self.recorder.exemplars.record("router/e2e", e2e, tr.trace_id)
         reply.setdefault("trace_id", tr.trace_id)
+        if not probe:
+            # Feed the SLO engine (ISSUE 19): every organic request's
+            # end-to-end latency and error outcome consumes (or
+            # doesn't) its class's error budget; the trace_id rides
+            # along so a firing alert can name its worst offender.
+            # Engine lock is a leaf — no router lock is held here.
+            self.alerts.observe(
+                tr.slo, e2e_s=e2e, error=status >= 500,
+                trace_id=tr.trace_id,
+            )
 
     def _handle_dispatch(self, body: dict, kind: str, t0: float,
                          prompt, tr=None) -> tuple[int, dict]:
@@ -1710,6 +1763,14 @@ class Router:
         # nesting the two would order them router->recorder here while
         # the dispatch path orders recorder-only — keep them disjoint.
         tstats = self.recorder.stats()
+        # Same discipline for the SLO engine (ISSUE 19): evaluate on
+        # the stats cadence (the prober also evaluates on its own
+        # tick), then read the v14 summary — engine lock is a leaf,
+        # never nested inside self._lock. The time-series store
+        # samples here too: one stats tick = one ring sample.
+        self.alerts.evaluate()
+        astats = self.alerts.stats()
+        self.series.sample()
         with self._lock:
             # One consistent fleet snapshot: the probe loop rewrites
             # these fields mid-sweep, and a line aggregated across a
@@ -1801,6 +1862,16 @@ class Router:
                 "traces_dropped": tstats["traces_dropped"],
                 "trace_coverage": tstats["trace_coverage"],
                 "slow_trace_count": tstats["slow_trace_count"],
+                # --- v14 (ISSUE 19): the SLO engine's alerting
+                # summary — rules currently firing, the worst rule's
+                # error budget remaining, the canary prober's rolling
+                # success rate, and cumulative firing transitions.
+                "alerts_firing": astats["alerts_firing"],
+                "error_budget_remaining": astats[
+                    "error_budget_remaining"
+                ],
+                "probe_success_rate": astats["probe_success_rate"],
+                "alert_count": astats["alert_count"],
             }
         return {
             "schema_version": schema.SERVING_SCHEMA_VERSION,
@@ -2009,13 +2080,27 @@ class RouterFrontend:
                         self._send_json(
                             200, {"base": base, "canary": canary}
                         )
+                    elif path == "/alerts":
+                        # Live alert state (ISSUE 19): every rule's
+                        # burn rates and state machine position, plus
+                        # the firing subset with exemplar trace ids —
+                        # what tools/slo_watch.py polls.
+                        self._send_json(200, router.alerts.payload())
+                    elif path == "/series":
+                        # The in-process time-series store (ISSUE 19):
+                        # ring-buffered history of every router
+                        # instrument, sampled on the stats cadence.
+                        self._send_json(
+                            200, router.series.to_payload()
+                        )
                     else:
                         self._send(
                             404,
                             "text/plain; charset=utf-8",
                             b"GET: /metrics /health /replicas /window "
-                            b"/canary /trace/{id}   POST: /generate "
-                            b"/classify /drain /undrain\n",
+                            b"/canary /alerts /series /trace/{id}   "
+                            b"POST: /generate /classify /drain "
+                            b"/undrain\n",
                         )
                 except ConnectionError:
                     pass

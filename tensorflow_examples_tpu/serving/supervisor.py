@@ -439,11 +439,18 @@ class Autoscaler:
         cfg: AutoscalerConfig | None = None,
         registry=None,
         health_timeout_s: float = 2.0,
+        alerts=None,
     ):
         self.router = router
         self.supervisor = supervisor
         self.spawn = spawn
         self.cfg = cfg or AutoscalerConfig()
+        # Advisory alert signal (ISSUE 19): anything with the
+        # AlertEngine ``stats()`` shape. A firing SLO alert marks the
+        # fleet hot (scale up even before queue depth shows it) and
+        # vetoes scale-down — the brownout ladder's cousin, fed by the
+        # canary prober and organic burn rates instead of queue state.
+        self.alerts = alerts
         self.registry = (
             registry if registry is not None else router.registry
         )
@@ -498,6 +505,10 @@ class Autoscaler:
                 (r.brownout_level for r in eligible), default=0
             ),
             "ttft_p95_s": ttft,
+            "alerts_firing": (
+                int(self.alerts.stats()["alerts_firing"])
+                if self.alerts is not None else 0
+            ),
         }
 
     # --------------------------------------------------------- decision
@@ -516,10 +527,13 @@ class Autoscaler:
         sig = self.fleet_signals()
         reg.gauge("autoscaler/replicas").set(sig["replicas"])
         now = time.monotonic()
+        if sig["alerts_firing"] > 0:
+            reg.counter("autoscaler/alert_advisory_total").inc()
         hot = (
             sig["queue_depth_mean"] >= cfg.target_queue_depth
             or sig["kv_occupancy_mean"] >= cfg.target_kv_occupancy
             or sig["brownout_max"] > 0
+            or sig["alerts_firing"] > 0
             or (
                 cfg.target_ttft_p95_s > 0
                 and sig["ttft_p95_s"] is not None
@@ -533,6 +547,7 @@ class Autoscaler:
             and sig["kv_occupancy_mean"]
             <= cfg.scale_down_frac * cfg.target_kv_occupancy
             and sig["brownout_max"] == 0
+            and sig["alerts_firing"] == 0
             and (
                 cfg.target_ttft_p95_s <= 0
                 or sig["ttft_p95_s"] is None

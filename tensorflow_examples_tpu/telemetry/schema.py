@@ -174,15 +174,29 @@ SCHEMA_VERSION = 5
 # traces_dropped / trace_coverage / slow_trace_count — stamped by the
 # router only), FORBIDDEN on v4-v12 serving lines, same mislabeling
 # rule as every earlier bump.
-SERVING_SCHEMA_VERSION = 13
+#
+# Version 14 (ISSUE 19): a new line KIND — ``kind="alert"`` carries one
+# SLO alert transition (top-level "alert" object: rule name, SLO
+# class, state — firing or resolved — severity, the burn rate and
+# error budget remaining at transition time, and optionally the
+# offending replica, the observed value vs objective, and the
+# worst-offender exemplar ``trace_id`` that joins the alert to its
+# ISSUE-18 trace). Written by telemetry/slo.py with the PR-2 sink
+# discipline. Both the kind and the object are FORBIDDEN on v4-v13
+# lines. The serving object gains the alerting summary keys
+# (alerts_firing / error_budget_remaining / probe_success_rate /
+# alert_count — stamped by the router only), FORBIDDEN on v4-v13
+# serving lines, same mislabeling rule as every earlier bump.
+SERVING_SCHEMA_VERSION = 14
 
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
 
 KINDS_V1 = ("window", "eval", "final")
 KINDS_V2 = KINDS_V1 + ("memory", "compile_warning")
 KINDS_V3 = KINDS_V2 + ("fleet",)
 KINDS_V12 = KINDS_V3 + ("serving",)
-KINDS = KINDS_V12 + ("trace",)
+KINDS_V13 = KINDS_V12 + ("trace",)
+KINDS = KINDS_V13 + ("alert",)
 
 _REQUIRED = ("schema_version", "kind", "step", "time_unix",
              "session_start_unix", "metrics", "counters", "gauges",
@@ -204,6 +218,9 @@ _V5_FIELDS = ("sharding",)
 # v13-only top-level objects, forbidden on earlier versions (a line
 # carrying a trace tree without the v13 stamp is mislabeled).
 _V13_FIELDS = ("trace",)
+
+# v14-only top-level objects, same mislabeling rule.
+_V14_FIELDS = ("alert",)
 
 # Required keys of a v5 sharding object (writer: train/loop.py via
 # telemetry/hub.py sharding_info).
@@ -288,11 +305,29 @@ SERVING_KEYS_V12 = ("journal_appends", "takeover_total",
 SERVING_KEYS_V13 = ("traces_kept", "traces_dropped", "trace_coverage",
                     "slow_trace_count")
 
+# v14-only serving-object keys (ISSUE 19): the SLO engine's summary —
+# alerts currently firing, the worst rule's error budget remaining
+# (fraction, 1.0 = untouched), the synthetic canary prober's rolling
+# success rate, and the cumulative firing-transition count. All
+# numeric; stamped by the router only (a replica line carries none),
+# FORBIDDEN on v4-v13 serving lines, same mislabeling rule as every
+# earlier bump.
+SERVING_KEYS_V14 = ("alerts_firing", "error_budget_remaining",
+                    "probe_success_rate", "alert_count")
+
 # Required keys of a v13 trace object (writer: telemetry/tracing.py
 # TraceRecorder.finish) and of each entry in its "spans" list.
 TRACE_KEYS = ("trace_id", "slo", "status", "e2e_s", "keep_reason",
               "spans")
 TRACE_SPAN_KEYS = ("span_id", "name", "start_unix", "dur_s")
+
+# Required keys of a v14 alert object (writer: telemetry/slo.py
+# AlertEngine). Optional extras — "replica" (string), "value" /
+# "threshold" / "window_s" (numbers), "trace_id" (the worst-offender
+# exemplar, string) — are typed-checked when present.
+ALERT_KEYS = ("name", "slo", "state", "severity", "burn_rate",
+              "budget_remaining", "since_unix")
+ALERT_STATES = ("firing", "resolved")
 
 # Instrument namespaces of the serving tier whose counter/gauge/
 # histogram registrations the graftlint drift pass cross-checks
@@ -300,7 +335,7 @@ TRACE_SPAN_KEYS = ("span_id", "name", "start_unix", "dur_s")
 # list from here — adding a namespace is a schema-module edit, not a
 # lint-pass edit).
 INSTRUMENT_PREFIXES = ("serving/", "router/", "autoscaler/",
-                       "precision/", "trace/")
+                       "precision/", "trace/", "alert/", "probe/")
 
 # The per-host entry of a fleet line's "hosts" list: "host" is a
 # required int, and each of these is required numeric-or-null (the
@@ -362,7 +397,9 @@ def validate_line(obj: Any) -> list[str]:
         )
         return problems
     kinds = {1: KINDS_V1, 2: KINDS_V2, 3: KINDS_V3}.get(
-        version, KINDS_V12 if version < 13 else KINDS
+        version,
+        KINDS_V12 if version < 13
+        else (KINDS_V13 if version < 14 else KINDS),
     )
     if obj["kind"] not in kinds:
         problems.append(f"kind {obj['kind']!r} not in {kinds}")
@@ -400,7 +437,7 @@ def validate_line(obj: Any) -> list[str]:
     if version == 1:
         for fields, v in ((_V2_FIELDS, 2), (_V3_FIELDS, 3),
                           (_V4_FIELDS, 4), (_V5_FIELDS, 5),
-                          (_V13_FIELDS, 13)):
+                          (_V13_FIELDS, 13), (_V14_FIELDS, 14)):
             for key in fields:
                 if key in obj:
                     problems.append(
@@ -463,7 +500,8 @@ def validate_line(obj: Any) -> list[str]:
 
     if version == 2:
         for fields, v in ((_V3_FIELDS, 3), (_V4_FIELDS, 4),
-                          (_V5_FIELDS, 5), (_V13_FIELDS, 13)):
+                          (_V5_FIELDS, 5), (_V13_FIELDS, 13),
+                          (_V14_FIELDS, 14)):
             for key in fields:
                 if key in obj:
                     problems.append(
@@ -547,6 +585,8 @@ def validate_line(obj: Any) -> list[str]:
             problems.append("v5 field 'sharding' on a schema-v3 line")
         if "trace" in obj:
             problems.append("v13 field 'trace' on a schema-v3 line")
+        if "alert" in obj:
+            problems.append("v14 field 'alert' on a schema-v3 line")
         return problems
 
     # ------------------------------------------------- v4 additions
@@ -614,6 +654,13 @@ def validate_line(obj: Any) -> list[str]:
                     if key in obj["serving"]:
                         problems.append(
                             f"v13 serving key {key!r} on a schema-v"
+                            f"{version} line"
+                        )
+            if version < 14:
+                for key in SERVING_KEYS_V14:
+                    if key in obj["serving"]:
+                        problems.append(
+                            f"v14 serving key {key!r} on a schema-v"
                             f"{version} line"
                         )
     elif "serving" in obj:
@@ -688,6 +735,43 @@ def validate_line(obj: Any) -> list[str]:
                     )
     elif "trace" in obj:
         problems.append("trace object on a non-trace line")
+
+    # ------------------------------------------------ v14 alert lines
+    if obj["kind"] == "alert":
+        alert = obj.get("alert")
+        if not isinstance(alert, dict):
+            problems.append("alert line is missing the alert object")
+        else:
+            for key in ALERT_KEYS:
+                if key not in alert:
+                    problems.append(
+                        f"alert object is missing required key {key!r}"
+                    )
+            for key in ("name", "slo", "severity"):
+                v = alert.get(key)
+                if key in alert and not isinstance(v, str):
+                    problems.append(
+                        f"alert[{key!r}] = {v!r} is not a string"
+                    )
+            state = alert.get("state")
+            if "state" in alert and state not in ALERT_STATES:
+                problems.append(
+                    f"alert['state'] = {state!r} not in {ALERT_STATES}"
+                )
+            for key in ("burn_rate", "budget_remaining", "since_unix",
+                        "value", "threshold", "window_s"):
+                if key in alert and not _is_number(alert[key]):
+                    problems.append(
+                        f"alert[{key!r}] = {alert[key]!r} is not a number"
+                    )
+            for key in ("replica", "trace_id"):
+                v = alert.get(key)
+                if v is not None and not isinstance(v, str):
+                    problems.append(
+                        f"alert[{key!r}] = {v!r} is not a string or null"
+                    )
+    elif "alert" in obj:
+        problems.append("alert object on a non-alert line")
 
     if version == 4:
         if "sharding" in obj:

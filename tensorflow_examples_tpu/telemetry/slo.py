@@ -1,0 +1,629 @@
+"""SLO objectives, error-budget accounting, burn-rate alerting (ISSUE 19).
+
+PRs 2/3/4/18 built the raw signal — instruments, stats lines, scrape
+surfaces, trace trees. This module is the layer that *evaluates* it:
+
+* :class:`SLOObjective` / :class:`SLOConfig` — the declarative rules
+  table (the ShardingConfig/PrecisionConfig precedent: a frozen,
+  validated, serializable config the fleet loads from ``slo.json``).
+  Each objective names an SLO class and its ceilings — TTFT/TPOT/e2e
+  latency, an error budget, a probe availability floor.
+
+* :class:`AlertEngine` — good/bad-event SLO accounting. Every request
+  outcome (and every synthetic probe) is classified against its
+  class's objectives; a request slower than the objective, or errored,
+  *consumes error budget*. Each rule is evaluated over TWO windows
+  (the multi-window burn-rate method: a fast window for detection
+  speed, a slow window so a single spike cannot page) and walks a
+  pending → firing → resolved state machine with dwell times on both
+  edges — the hysteresis that suppresses flapping. Firing and resolve
+  transitions land as schema-v14 ``kind="alert"`` JSONL lines with the
+  PR-2 sink discipline (one line per transition, flush + fsync per
+  append, torn-tail-tolerant read), and every firing alert embeds the
+  worst-offender ``trace_id`` observed in the window — from the alert
+  to ``trace_report --trace-id`` is one copy-paste.
+
+The engine owns no thread and no clock loop: ``observe*`` is called
+from the serving path, ``evaluate()`` from the existing stats cadence
+(and the prober's tick), and ``now`` is injectable everywhere so the
+unit matrix drives time deterministically. The engine's lock is a
+leaf — no callback ever runs under it.
+
+Stdlib only; no device, no network.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import threading
+import time
+
+from tensorflow_examples_tpu.telemetry.registry import default_registry
+
+__all__ = [
+    "SLOObjective", "SLOConfig", "AlertEngine", "read_alerts",
+    "SLO_JSON_VERSION",
+]
+
+SLO_JSON_VERSION = 1
+
+# Per-rule event rings are bounded twice over: by wall clock (pruned
+# past 2x the slow window) and by count (a deque cap), so a traffic
+# flood cannot grow the engine without limit.
+_MAX_EVENTS_PER_RULE = 8192
+
+
+# --------------------------------------------------------------- config
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOObjective:
+    """One SLO class's ceilings. A latency field of 0 disables that
+    rule; ``error_budget`` is the allowed bad-event fraction (latency
+    breaches and errors both consume it); ``availability`` is the
+    synthetic-probe success floor (probe failures burn the budget
+    ``1 - availability``)."""
+
+    slo: str
+    ttft_p95_s: float = 0.0
+    tpot_p95_s: float = 0.0
+    e2e_p95_s: float = 0.0
+    error_budget: float = 0.05
+    availability: float = 0.95
+
+    def __post_init__(self):
+        if not isinstance(self.slo, str) or not self.slo:
+            raise ValueError(f"slo must be a non-empty string, got "
+                             f"{self.slo!r}")
+        for name in ("ttft_p95_s", "tpot_p95_s", "e2e_p95_s"):
+            v = getattr(self, name)
+            object.__setattr__(self, name, float(v))
+            if float(v) < 0:
+                raise ValueError(f"{name} must be >= 0, got {v!r}")
+        for name in ("error_budget", "availability"):
+            v = float(getattr(self, name))
+            object.__setattr__(self, name, v)
+            if not 0.0 < v <= 1.0:
+                raise ValueError(
+                    f"{name} must be in (0, 1], got {v!r}"
+                )
+
+    def to_json_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json_dict(cls, obj) -> "SLOObjective":
+        if not isinstance(obj, dict):
+            raise ValueError(
+                f"slo objective must be a JSON object, got "
+                f"{type(obj).__name__}"
+            )
+        unknown = set(obj) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(f"unknown slo objective keys "
+                             f"{sorted(unknown)}")
+        if "slo" not in obj:
+            raise ValueError("slo objective is missing 'slo'")
+        return cls(**obj)
+
+
+def _default_objectives() -> tuple:
+    # Deliberately generous: a healthy smoke bench on a CPU host must
+    # fire ZERO alerts (the false-positive gate the bench bank pins).
+    return (
+        SLOObjective(slo="interactive", ttft_p95_s=5.0,
+                     tpot_p95_s=2.0, e2e_p95_s=60.0),
+        SLOObjective(slo="batch", ttft_p95_s=30.0,
+                     tpot_p95_s=5.0, e2e_p95_s=300.0),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """The fleet's alerting policy: per-class objectives plus the
+    burn-rate evaluation knobs shared by every rule.
+
+    ``windows_s`` is (fast, slow); a rule breaches only when BOTH
+    windows burn past their threshold (``burn_thresholds``, same
+    order) — the fast window bounds detection delay, the slow window
+    keeps one spike from paging. ``pending_for_s`` / ``resolve_after_s``
+    are the state-machine dwell times (fire only after a sustained
+    breach; resolve only after sustained health)."""
+
+    objectives: tuple = dataclasses.field(
+        default_factory=_default_objectives
+    )
+    windows_s: tuple = (60.0, 300.0)
+    burn_thresholds: tuple = (10.0, 2.0)
+    pending_for_s: float = 2.0
+    resolve_after_s: float = 5.0
+
+    def __post_init__(self):
+        objs = tuple(
+            o if isinstance(o, SLOObjective)
+            else SLOObjective.from_json_dict(o)
+            for o in self.objectives
+        )
+        if not objs:
+            raise ValueError("SLOConfig needs at least one objective")
+        seen: set = set()
+        for o in objs:
+            if o.slo in seen:
+                raise ValueError(f"duplicate objective for slo "
+                                 f"{o.slo!r}")
+            seen.add(o.slo)
+        object.__setattr__(self, "objectives", objs)
+        win = tuple(float(w) for w in self.windows_s)
+        if len(win) != 2 or not 0 < win[0] < win[1]:
+            raise ValueError(
+                f"windows_s must be (fast, slow) with 0 < fast < slow, "
+                f"got {self.windows_s!r}"
+            )
+        object.__setattr__(self, "windows_s", win)
+        thr = tuple(float(t) for t in self.burn_thresholds)
+        if len(thr) != 2 or any(t <= 0 for t in thr):
+            raise ValueError(
+                f"burn_thresholds must be two positive rates, got "
+                f"{self.burn_thresholds!r}"
+            )
+        object.__setattr__(self, "burn_thresholds", thr)
+        for name in ("pending_for_s", "resolve_after_s"):
+            v = float(getattr(self, name))
+            object.__setattr__(self, name, v)
+            if v < 0:
+                raise ValueError(f"{name} must be >= 0, got {v!r}")
+
+    def objective(self, slo: str) -> SLOObjective | None:
+        for o in self.objectives:
+            if o.slo == slo:
+                return o
+        return None
+
+    # -------------------------------------------------- serialization
+
+    def to_json_dict(self) -> dict:
+        return {
+            "objectives": [o.to_json_dict() for o in self.objectives],
+            "windows_s": list(self.windows_s),
+            "burn_thresholds": list(self.burn_thresholds),
+            "pending_for_s": self.pending_for_s,
+            "resolve_after_s": self.resolve_after_s,
+        }
+
+    @classmethod
+    def from_json_dict(cls, obj) -> "SLOConfig":
+        if not isinstance(obj, dict):
+            raise ValueError(
+                f"slo config must be a JSON object, got "
+                f"{type(obj).__name__}"
+            )
+        unknown = set(obj) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(f"unknown slo config keys {sorted(unknown)}")
+        kw = dict(obj)
+        if "objectives" in kw:
+            kw["objectives"] = tuple(kw["objectives"])
+        return cls(**kw)
+
+    def save(self, path: str, *, extra=None) -> None:
+        """Atomic write of ``{"version", "config", **extra}`` — the
+        ``slo.json`` the serving CLIs auto-load (the sharding.json
+        precedent)."""
+        doc = {
+            "version": SLO_JSON_VERSION,
+            "config": self.to_json_dict(),
+        }
+        if extra:
+            doc.update(extra)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "SLOConfig":
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: not a JSON object")
+        if "config" in doc:
+            version = doc.get("version")
+            if version != SLO_JSON_VERSION:
+                raise ValueError(
+                    f"{path}: slo.json version {version!r} (this build "
+                    f"reads {SLO_JSON_VERSION})"
+                )
+            return cls.from_json_dict(doc["config"])
+        # A bare config object (hand-written, no wrapper) also loads.
+        return cls.from_json_dict(doc)
+
+
+# --------------------------------------------------------------- engine
+
+
+class _Rule:
+    """One alert rule's event ring + state machine (engine-internal;
+    all mutation happens under the engine lock)."""
+
+    __slots__ = ("name", "slo", "kind", "budget", "threshold",
+                 "state", "breach_since", "healthy_since", "fired",
+                 "events", "last_burn", "last_remaining")
+
+    def __init__(self, name: str, slo: str, kind: str, budget: float,
+                 threshold: float):
+        self.name = name
+        self.slo = slo
+        self.kind = kind          # "ttft" | "tpot" | "e2e" | "errors"
+        #                           | "probe"
+        self.budget = budget      # allowed bad-event fraction
+        self.threshold = threshold  # latency ceiling (0 for errors/probe)
+        self.state = "ok"         # "ok" | "pending" | "firing"
+        self.breach_since: float | None = None
+        self.healthy_since: float | None = None
+        self.fired = 0
+        # (t, bad, value, trace_id, replica)
+        self.events: collections.deque = collections.deque(
+            maxlen=_MAX_EVENTS_PER_RULE
+        )
+        self.last_burn = (0.0, 0.0)
+        self.last_remaining = 1.0
+
+
+class AlertEngine:
+    """Error-budget accounting + multi-window burn-rate alerting.
+
+    Call :meth:`observe` per finished request, :meth:`observe_probe`
+    per synthetic probe, :meth:`evaluate` on the stats cadence; read
+    :meth:`stats` (the four v14 serving-line keys), :meth:`payload`
+    (the ``GET /alerts`` body), and the ``kind="alert"`` JSONL sink.
+    """
+
+    def __init__(self, config: SLOConfig | None = None, *,
+                 registry=None, path: str | None = None,
+                 now=None):
+        self.config = config or SLOConfig()
+        self.registry = (
+            registry if registry is not None else default_registry()
+        )
+        self._now = now or time.time
+        self._lock = threading.Lock()
+        self._t_session = self._now()
+        self._rules: dict[str, _Rule] = {}
+        for o in self.config.objectives:
+            for kind, thr in (("ttft", o.ttft_p95_s),
+                              ("tpot", o.tpot_p95_s),
+                              ("e2e", o.e2e_p95_s)):
+                if thr > 0:
+                    self._add_rule(f"{kind}_{o.slo}", o.slo, kind,
+                                   o.error_budget, thr)
+            self._add_rule(f"errors_{o.slo}", o.slo, "errors",
+                           o.error_budget, 0.0)
+            self._add_rule(f"probe_{o.slo}", o.slo, "probe",
+                           max(1.0 - o.availability, 1e-9), 0.0)
+        self._file = open(path, "a") if path else None
+        self.path = path
+
+    def _add_rule(self, name, slo, kind, budget, threshold):
+        self._rules[name] = _Rule(name, slo, kind, budget, threshold)
+
+    # ----------------------------------------------------------- feed
+
+    def observe(self, slo: str, *, ttft_s: float | None = None,
+                tpot_s: float | None = None,
+                e2e_s: float | None = None, error: bool = False,
+                trace_id: str | None = None,
+                replica: str | None = None,
+                now: float | None = None) -> None:
+        """One finished ORGANIC request: classify it against its
+        class's objectives and append good/bad events to the class's
+        rules. Unknown SLO classes are ignored (no objective, no
+        budget)."""
+        o = self.config.objective(slo)
+        if o is None:
+            return
+        t = self._now() if now is None else float(now)
+        with self._lock:
+            for kind, value in (("ttft", ttft_s), ("tpot", tpot_s),
+                                ("e2e", e2e_s)):
+                rule = self._rules.get(f"{kind}_{slo}")
+                if rule is None or value is None:
+                    continue
+                bad = float(value) > rule.threshold
+                rule.events.append(
+                    (t, bad, float(value), trace_id, replica)
+                )
+            rule = self._rules[f"errors_{slo}"]
+            rule.events.append(
+                (t, bool(error), 1.0 if error else 0.0, trace_id,
+                 replica)
+            )
+
+    def observe_probe(self, *, slo: str, ok: bool, replica: str,
+                      ttft_s: float | None = None,
+                      trace_id: str | None = None,
+                      now: float | None = None) -> None:
+        """One synthetic canary probe result (serving/prober.py). A
+        failed probe burns the availability budget; a slow-but-ok
+        probe burns the class's TTFT budget like organic traffic."""
+        o = self.config.objective(slo)
+        if o is None:
+            return
+        t = self._now() if now is None else float(now)
+        with self._lock:
+            rule = self._rules[f"probe_{slo}"]
+            rule.events.append(
+                (t, not ok, 0.0 if ok else 1.0, trace_id, replica)
+            )
+            if ok and ttft_s is not None:
+                lat = self._rules.get(f"ttft_{slo}")
+                if lat is not None:
+                    lat.events.append(
+                        (t, float(ttft_s) > lat.threshold,
+                         float(ttft_s), trace_id, replica)
+                    )
+
+    # ------------------------------------------------------- evaluate
+
+    @staticmethod
+    def _window(rule: _Rule, now: float, win: float):
+        """(total, bad, worst-bad-event) over [now - win, now]."""
+        total = bad = 0
+        worst = None  # (value, trace_id, replica)
+        for t, is_bad, value, trace_id, replica in rule.events:
+            if t < now - win:
+                continue
+            total += 1
+            if is_bad:
+                bad += 1
+                if worst is None or value > worst[0]:
+                    worst = (value, trace_id, replica)
+        return total, bad, worst
+
+    def evaluate(self, *, now: float | None = None) -> list[dict]:
+        """One alerting tick: recompute every rule's burn rates, walk
+        the state machines, and return (and sink) the transitions that
+        happened — each a v14 ``alert`` object dict."""
+        t = self._now() if now is None else float(now)
+        fast_w, slow_w = self.config.windows_s
+        fast_thr, slow_thr = self.config.burn_thresholds
+        cfg = self.config
+        reg = self.registry
+        reg.counter("alert/evaluations_total").inc()
+        transitions: list[dict] = []
+        with self._lock:
+            for rule in self._rules.values():
+                # Prune far outside the slow window so rings stay small
+                # on long runs regardless of the count cap.
+                horizon = t - 2 * slow_w
+                while rule.events and rule.events[0][0] < horizon:
+                    rule.events.popleft()
+                total_f, bad_f, worst_f = self._window(rule, t, fast_w)
+                total_s, bad_s, worst_s = self._window(rule, t, slow_w)
+                burn_f = (
+                    (bad_f / total_f) / rule.budget if total_f else 0.0
+                )
+                burn_s = (
+                    (bad_s / total_s) / rule.budget if total_s else 0.0
+                )
+                rule.last_burn = (burn_f, burn_s)
+                rule.last_remaining = (
+                    max(0.0, 1.0 - (bad_s / total_s) / rule.budget)
+                    if total_s else 1.0
+                )
+                breached = (
+                    total_f > 0 and total_s > 0
+                    and burn_f >= fast_thr and burn_s >= slow_thr
+                )
+                worst = worst_f or worst_s
+                if rule.state == "ok":
+                    if breached:
+                        rule.state = "pending"
+                        rule.breach_since = t
+                elif rule.state == "pending":
+                    if not breached:
+                        rule.state = "ok"
+                        rule.breach_since = None
+                    elif t - rule.breach_since >= cfg.pending_for_s:
+                        rule.state = "firing"
+                        rule.healthy_since = None
+                        rule.fired += 1
+                        reg.counter("alert/firing_total").inc()
+                        transitions.append(self._transition(
+                            rule, "firing", t, worst
+                        ))
+                elif rule.state == "firing":
+                    if breached:
+                        rule.healthy_since = None
+                    else:
+                        if rule.healthy_since is None:
+                            rule.healthy_since = t
+                        if t - rule.healthy_since >= cfg.resolve_after_s:
+                            rule.state = "ok"
+                            rule.breach_since = None
+                            rule.healthy_since = None
+                            reg.counter("alert/resolved_total").inc()
+                            transitions.append(self._transition(
+                                rule, "resolved", t, worst
+                            ))
+            firing = sum(
+                1 for r in self._rules.values() if r.state == "firing"
+            )
+        reg.gauge("alert/firing").set(firing)
+        reg.gauge("alert/error_budget_remaining").set(
+            self.stats()["error_budget_remaining"]
+        )
+        for tr in transitions:
+            self._write_line(tr)
+        return transitions
+
+    def _transition(self, rule: _Rule, state: str, t: float,
+                    worst) -> dict:
+        """Build one v14 alert object. Severity: a fast burn hot
+        enough to exhaust the budget in well under the slow window
+        pages; anything else is a ticket."""
+        fast_thr, _slow_thr = self.config.burn_thresholds
+        alert = {
+            "name": rule.name,
+            "slo": rule.slo,
+            "state": state,
+            "severity": (
+                "page" if rule.last_burn[0] >= 2 * fast_thr
+                else "ticket"
+            ),
+            "burn_rate": rule.last_burn[0],
+            "budget_remaining": rule.last_remaining,
+            "since_unix": rule.breach_since
+            if rule.breach_since is not None else t,
+            "window_s": self.config.windows_s[0],
+        }
+        if rule.threshold > 0:
+            alert["threshold"] = rule.threshold
+        if worst is not None:
+            value, trace_id, replica = worst
+            alert["value"] = value
+            # The worst offender's trace: the alert -> trace_report
+            # copy-paste (ISSUE 18's exemplar discipline).
+            if trace_id:
+                alert["trace_id"] = str(trace_id)
+            if replica:
+                alert["replica"] = str(replica)
+        return alert
+
+    def _write_line(self, alert: dict) -> None:
+        if self._file is None:
+            return
+        from tensorflow_examples_tpu.telemetry import schema
+
+        line = {
+            "schema_version": schema.SERVING_SCHEMA_VERSION,
+            "kind": "alert",
+            "step": 0,
+            "time_unix": self._now(),
+            "session_start_unix": self._t_session,
+            "host": 0,
+            "metrics": {},
+            "counters": {},
+            "gauges": {},
+            "derived": {},
+            "alert": alert,
+        }
+        with self._lock:
+            if self._file is None:
+                return
+            # One transition per line, flushed and fsynced per append
+            # (the PR-2 sink discipline): a crash tears at most the
+            # tail line, which readers tolerate.
+            self._file.write(json.dumps(line) + "\n")
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    # ----------------------------------------------------------- read
+
+    def firing(self) -> list[dict]:
+        """The currently-firing alerts (payload()["firing"])."""
+        return self.payload()["firing"]
+
+    def stats(self) -> dict:
+        """The v14 serving-line keys (the router's stats_line stamps
+        exactly these)."""
+        with self._lock:
+            firing = sum(
+                1 for r in self._rules.values() if r.state == "firing"
+            )
+            fired = sum(r.fired for r in self._rules.values())
+            remaining = min(
+                (r.last_remaining for r in self._rules.values()),
+                default=1.0,
+            )
+            probe_total = probe_bad = 0
+            for r in self._rules.values():
+                if r.kind != "probe":
+                    continue
+                t_now = self._now()
+                total, bad, _ = self._window(
+                    r, t_now, self.config.windows_s[1]
+                )
+                probe_total += total
+                probe_bad += bad
+        return {
+            "alerts_firing": firing,
+            "error_budget_remaining": remaining,
+            "probe_success_rate": (
+                (probe_total - probe_bad) / probe_total
+                if probe_total else 1.0
+            ),
+            "alert_count": fired,
+        }
+
+    def payload(self) -> dict:
+        """The ``GET /alerts`` JSON body: every rule's live burn rates
+        and state, the firing subset with exemplars, the config that
+        produced them, and the v14 summary."""
+        t = self._now()
+        firing: list[dict] = []
+        rules: dict[str, dict] = {}
+        with self._lock:
+            for rule in self._rules.values():
+                entry = {
+                    "slo": rule.slo,
+                    "kind": rule.kind,
+                    "state": rule.state,
+                    "burn_rate_fast": rule.last_burn[0],
+                    "burn_rate_slow": rule.last_burn[1],
+                    "budget_remaining": rule.last_remaining,
+                    "fired": rule.fired,
+                }
+                if rule.threshold > 0:
+                    entry["threshold"] = rule.threshold
+                rules[rule.name] = entry
+                if rule.state == "firing":
+                    _tot, _bad, worst = self._window(
+                        rule, t, self.config.windows_s[1]
+                    )
+                    firing.append(
+                        self._transition(rule, "firing", t, worst)
+                    )
+        out = {"firing": firing, "rules": rules,
+               "config": self.config.to_json_dict()}
+        out.update(self.stats())
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            f, self._file = self._file, None
+        if f is not None:
+            f.close()
+
+
+def read_alerts(path: str) -> list[dict]:
+    """Load an alert JSONL sink into a list of alert objects (each
+    with its line's ``time_unix`` attached as ``"_time_unix"``).
+    Torn-tail tolerant: an unparseable line — the one a crash can
+    tear — is skipped, never raised."""
+    out: list[dict] = []
+    try:
+        f = open(path)
+    except OSError:
+        return out
+    with f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(line, dict) or line.get("kind") != "alert":
+                continue
+            alert = line.get("alert")
+            if not isinstance(alert, dict):
+                continue
+            alert = dict(alert)
+            alert["_time_unix"] = line.get("time_unix")
+            out.append(alert)
+    return out

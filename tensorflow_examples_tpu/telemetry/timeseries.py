@@ -1,0 +1,178 @@
+"""Bounded in-process time-series store (ISSUE 19).
+
+The metrics registry answers "what is the value NOW"; every question an
+alerting layer or an operator eyeballing a regression actually asks is
+"what was it over the last N minutes". This module is the smallest
+store that closes that gap without a database: one named ring per
+series (a ``deque(maxlen=capacity)`` of ``(time_unix, value)`` points),
+fed by :meth:`TimeSeriesStore.sample` on the EXISTING stats cadence
+(the serving stats loop / the router's ``--stats-every`` tick — no new
+thread, no new clock), and scraped as JSON via ``GET /series`` on both
+the router and replica frontends.
+
+One ``sample()`` call walks the registry snapshot:
+
+* every counter becomes a series of its cumulative value (consumers
+  difference adjacent points for a rate);
+* every gauge becomes a series of its instantaneous value;
+* every histogram becomes THREE series — ``<name>.p50`` / ``.p95`` /
+  ``.p99`` over the histogram's bounded sample window at sample time —
+  so tail latency is a curve, not a single scrape-time number.
+
+Memory is bounded by construction: ``capacity`` points per series,
+series count bounded by the registry's instrument count. At the
+default 720-point capacity and a 2 s stats cadence one ring holds
+24 minutes — enough to see a burn-rate window develop, small enough
+to never matter.
+
+Locking: the registry snapshot is taken BEFORE the store lock is
+acquired and holders never call out while holding it, so the store is
+a leaf in the lock order (scrape threads and the stats thread contend
+only with each other, never with the batcher or router locks).
+
+Stdlib only; no device, no network.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+__all__ = ["TimeSeriesStore"]
+
+# Histogram percentile suffixes sampled into their own series.
+_HIST_QS = (("p50", 50.0), ("p95", 95.0), ("p99", 99.0))
+
+
+def _nearest_rank(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    rank = max(int(-(-(q / 100.0 * len(sorted_vals)) // 1)) - 1, 0)
+    return sorted_vals[min(rank, len(sorted_vals) - 1)]
+
+
+class TimeSeriesStore:
+    """Ring-buffered ``{series name: [(time_unix, value), ...]}``."""
+
+    def __init__(self, registry=None, *, capacity: int = 720):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.registry = registry
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._series: dict[str, collections.deque] = {}  # guard: _lock
+        self.samples_taken = 0  # guard: _lock
+
+    # ----------------------------------------------------------- write
+
+    def record(self, name: str, value: float, *,
+               now: float | None = None) -> None:
+        """Append one point to one named series (probers and engines
+        that track values the registry has no instrument for)."""
+        t = time.time() if now is None else float(now)
+        v = float(value)
+        with self._lock:
+            ring = self._series.get(name)
+            if ring is None:
+                ring = self._series[name] = collections.deque(
+                    maxlen=self.capacity
+                )
+            ring.append((t, v))
+
+    def sample(self, *, now: float | None = None) -> int:
+        """Take one fixed-cadence sample of the attached registry;
+        returns the number of points appended. No-op without a
+        registry (a record()-only store is legal)."""
+        if self.registry is None:
+            return 0
+        t = time.time() if now is None else float(now)
+        # Snapshot OUTSIDE the store lock: the registry has its own
+        # locks and this ordering keeps the store a lock-order leaf.
+        counters = self.registry.counter_values()
+        gauges = self.registry.gauge_values()
+        hists = self.registry.histogram_summaries()
+        points: list[tuple[str, float]] = []
+        for k, v in counters.items():
+            points.append((k, float(v)))
+        for k, v in gauges.items():
+            points.append((k, float(v)))
+        for hname, summ in hists.items():
+            for suffix, _q in _HIST_QS:
+                v = summ.get(suffix)
+                if v is not None:
+                    points.append((f"{hname}.{suffix}", float(v)))
+        with self._lock:
+            for name, v in points:
+                ring = self._series.get(name)
+                if ring is None:
+                    ring = self._series[name] = collections.deque(
+                        maxlen=self.capacity
+                    )
+                ring.append((t, v))
+            self.samples_taken += 1
+        return len(points)
+
+    # ------------------------------------------------------------ read
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series(self, name: str, *, last: int | None = None) -> list:
+        """One series' points, oldest first (``last`` trims to the most
+        recent N). Unknown names return []."""
+        with self._lock:
+            ring = self._series.get(name)
+            pts = list(ring) if ring is not None else []
+        if last is not None and last >= 0:
+            pts = pts[-last:]
+        return pts
+
+    def rollup(self, name: str) -> dict:
+        """p50/p95/p99 (plus count/min/max/last) over everything the
+        ring currently holds for ``name`` — the store-level rollup an
+        operator reads when the histogram's own window has already
+        rotated past the incident."""
+        vals = sorted(v for _t, v in self.series(name))
+        out = {
+            "count": len(vals),
+            "min": vals[0] if vals else None,
+            "max": vals[-1] if vals else None,
+            "last": None,
+        }
+        pts = self.series(name, last=1)
+        if pts:
+            out["last"] = pts[-1][1]
+        for suffix, q in _HIST_QS:
+            out[suffix] = _nearest_rank(vals, q)
+        return out
+
+    def to_payload(self, *, last: int | None = None) -> dict:
+        """The ``GET /series`` JSON body: every series' points (each a
+        ``[time_unix, value]`` pair, oldest first) plus per-series
+        rollups and the store's own accounting."""
+        with self._lock:
+            names = sorted(self._series)
+            rings = {n: list(self._series[n]) for n in names}
+            taken = self.samples_taken
+        if last is not None and last >= 0:
+            rings = {n: pts[-last:] for n, pts in rings.items()}
+        payload = {
+            "capacity": self.capacity,
+            "samples_taken": taken,
+            "series": {
+                n: [[t, v] for t, v in pts] for n, pts in rings.items()
+            },
+            "rollups": {},
+        }
+        for n, pts in rings.items():
+            vals = sorted(v for _t, v in pts)
+            payload["rollups"][n] = {
+                "count": len(vals),
+                "last": pts[-1][1] if pts else None,
+                "p50": _nearest_rank(vals, 50.0),
+                "p95": _nearest_rank(vals, 95.0),
+                "p99": _nearest_rank(vals, 99.0),
+            }
+        return payload

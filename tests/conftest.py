@@ -152,6 +152,7 @@ _LOCKORDER_MODULES = (
     "test_router.py",
     "test_overload.py",
     "test_journal.py",
+    "test_slo.py",
 )
 _THREAD_GUARD_MODULES = _LOCKORDER_MODULES + ("test_serving.py",)
 
@@ -166,6 +167,7 @@ _OWNED_THREAD_NAMES = (
     "router-probe",
     "router-frontend",
     "router-standby",
+    "canary-prober",
     "replica-supervisor",
     "fleet-autoscaler",
     "telemetry-metrics-server",

@@ -1192,7 +1192,7 @@ class TestTakeoverGolden:
             # the whole story (shared registry survives the switch).
             line = json.loads(json.dumps(pair.standby.stats_line()))
             assert schema.validate_line(line) == []
-            assert line["schema_version"] == 13
+            assert line["schema_version"] == 14
             serving = line["serving"]
             assert serving["takeover_total"] == 1
             assert serving["journal_appends"] >= 2 * n
@@ -1209,4 +1209,113 @@ class TestTakeoverGolden:
             assert status == 503 and body.get("fenced") is True
         finally:
             pair.close()
+            fleet.close()
+
+
+class TestAlertGolden:
+    """ISSUE 19's chaos acceptance golden: inject a latency fault into
+    one replica of a healthy fleet -> the SLO engine walks pending ->
+    firing with an alert that names the SLO class and carries a
+    resolvable worst-offender exemplar whose trace names the sick
+    replica -> clear the fault -> the alert resolves after sustained
+    health. The whole episode lands in the v14 alert sink."""
+
+    @pytest.mark.timeout(300)
+    def test_latency_fault_fires_then_resolves(
+        self, serve_faults, tmp_path
+    ):
+        from tensorflow_examples_tpu.telemetry.slo import (
+            AlertEngine,
+            SLOConfig,
+            SLOObjective,
+        )
+
+        # Replica 0 sleeps 0.25 s at EVERY decode step: ~0.75 s per
+        # 3-token request against a 0.2 s e2e ceiling.
+        serve_faults("slowrep@0:0.25")
+        fleet = _fake_fleet(2, router_cfg=RouterConfig(
+            probe_interval_s=0.05, retry_budget_s=20.0, max_retries=4,
+            eject_after=4, eject_cooldown_s=0.5,
+            trace_sample_fraction=1.0,
+        ))
+        path = str(tmp_path / "alerts.jsonl")
+        # Chaos-tier windows: seconds, not minutes, and no dwell on the
+        # firing edge (two evaluate ticks suffice).
+        fleet.router.alerts = AlertEngine(
+            SLOConfig(
+                objectives=(SLOObjective(slo="interactive",
+                                         e2e_p95_s=0.2,
+                                         error_budget=0.1),),
+                windows_s=(0.5, 2.0), burn_thresholds=(2.0, 1.0),
+                pending_for_s=0.0, resolve_after_s=0.2,
+            ),
+            registry=fleet.router.registry, path=path,
+        )
+        rfront = RouterFrontend(fleet.router, port=0).start()
+        try:
+            url = rfront.url("/generate")
+            deadline = time.time() + 90
+            fired = None
+            while fired is None and time.time() < deadline:
+                for i in range(4):
+                    status, _ = _post(
+                        url, {"prompt": [i + 2], "max_new_tokens": 3}
+                    )
+                    assert status == 200
+                for a in fleet.router.alerts.evaluate():
+                    if (a["name"] == "e2e_interactive"
+                            and a["state"] == "firing"):
+                        fired = a
+            assert fired is not None, "alert never fired under fault"
+            # The alert names the SLO class and carries the exemplar.
+            assert fired["slo"] == "interactive"
+            assert fired["severity"] in ("page", "ticket")
+            assert fired["burn_rate"] >= 2.0
+            assert fired["value"] > 0.2  # the worst offender's e2e
+            tid = fired.get("trace_id")
+            assert isinstance(tid, str) and tid
+            # The exemplar RESOLVES: the recorder holds the trace, and
+            # its dispatch leg names the sick replica — alert ->
+            # trace_report --trace-id is one copy-paste.
+            tdoc = fleet.router.recorder.get(tid)
+            assert tdoc is not None and not tdoc.get("open")
+            legs = [
+                s for s in tdoc["spans"]
+                if (s.get("tags") or {}).get("replica")
+            ]
+            assert legs, tdoc["spans"]
+            assert legs[-1]["tags"]["replica"] == fleet.replicas[0].url
+            # Clear the fault: organic traffic goes healthy, the burn
+            # drains out of the fast window, and the rule resolves.
+            faults_mod.serve_clear()
+            resolved = None
+            deadline = time.time() + 90
+            while resolved is None and time.time() < deadline:
+                for i in range(4):
+                    _post(url, {"prompt": [i + 2],
+                                "max_new_tokens": 3})
+                time.sleep(0.1)
+                for a in fleet.router.alerts.evaluate():
+                    if (a["name"] == "e2e_interactive"
+                            and a["state"] == "resolved"):
+                        resolved = a
+            assert resolved is not None, "alert never resolved"
+            stats = fleet.router.alerts.stats()
+            assert stats["alerts_firing"] == 0
+            assert stats["alert_count"] >= 1
+            # The episode is durable: firing AND resolved transitions
+            # in the sink, every line schema-v14 valid.
+            with open(path) as f:
+                lines = [json.loads(ln) for ln in f if ln.strip()]
+            states = [ln["alert"]["state"] for ln in lines]
+            assert "firing" in states and "resolved" in states
+            for ln in lines:
+                assert ln["schema_version"] == 14
+                assert schema.validate_line(ln) == [], ln
+            # Zero post-warmup recompiles fleet-wide (the standing
+            # serving acceptance bar).
+            for rep in fleet.replicas:
+                assert rep.engine.post_warmup_recompiles() == 0
+        finally:
+            rfront.close()
             fleet.close()

@@ -517,7 +517,7 @@ class TestSchemaV12:
     router's line still validates."""
 
     def test_v12_key_tuple_pinned(self):
-        assert schema.SERVING_SCHEMA_VERSION == 13
+        assert schema.SERVING_SCHEMA_VERSION == 14
         assert schema.SERVING_KEYS_V12 == (
             "journal_appends", "takeover_total", "resumed_streams",
             "dedup_hits", "takeover_latency_s",
@@ -550,7 +550,7 @@ class TestSchemaV12:
         router = Router(["http://127.0.0.1:9"], journal=journal)
         try:
             line = json.loads(json.dumps(router.stats_line()))
-            assert line["schema_version"] == 13
+            assert line["schema_version"] == 14
             assert schema.validate_line(line) == []
             for key in schema.SERVING_KEYS_V12:
                 assert key in line["serving"], key
@@ -562,7 +562,7 @@ class TestSchemaV12:
         router = Router(["http://127.0.0.1:9"])
         try:
             line = json.loads(json.dumps(router.stats_line()))
-            assert line["schema_version"] == 13
+            assert line["schema_version"] == 14
             assert schema.validate_line(line) == []
         finally:
             router.close()

@@ -591,7 +591,7 @@ class TestSchemaV10:
         b = ContinuousBatcher(eng)
         line = json.loads(json.dumps(b.stats_line()))
         assert line["schema_version"] == \
-            schema.SERVING_SCHEMA_VERSION == 13
+            schema.SERVING_SCHEMA_VERSION == 14
         assert schema.validate_line(line) == []
         assert line["serving"]["brownout_level"] == 0
         assert line["serving"]["shed_interactive"] == 0

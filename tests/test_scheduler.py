@@ -1071,7 +1071,7 @@ class TestSchemaV9:
         batcher = ContinuousBatcher(engine)
         line = json.loads(json.dumps(batcher.stats_line()))
         assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION
-        assert line["schema_version"] == 13
+        assert line["schema_version"] == 14
         assert schema.validate_line(line) == []
         assert line["serving"]["prefix_blocks"] == 0
         assert line["serving"]["prefix_chains"] == 0

@@ -346,7 +346,7 @@ class TestSchemaV8:
             line = json.loads(json.dumps(batcher.stats_line()))
         finally:
             batcher.close(drain=True)
-        assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION == 13
+        assert line["schema_version"] == schema.SERVING_SCHEMA_VERSION == 14
         assert schema.validate_line(line) == []
         serving = line["serving"]
         assert serving["spec_k"] == 3

@@ -1580,6 +1580,39 @@ class TestServeBench:
             assert isinstance(rec[key], (int, float)) and rec[key] > 0, key
 
     @pytest.mark.timeout(300)
+    def test_smoke_slo_healthy_fires_zero_alerts(self, tmp_path):
+        """ISSUE 19 CI satellite: a healthy smoke under ``--slo`` banks
+        alert_count == 0 (the false-positive gate: generous default
+        objectives must never fire on a healthy CPU run), full canary
+        probe success, and an untouched error budget. The record is
+        assembled BEFORE the probe phase, so probe traffic cannot
+        pollute the banked percentiles."""
+        import serve_bench
+
+        out = tmp_path / "slo_record.json"
+        rc = serve_bench.main(
+            ["--smoke", "--requests", "12", "--out", str(out), "--slo"]
+        )
+        assert rc == 0
+        with open(out) as f:
+            rec = json.load(f)
+        assert rec["ok"] is True
+        assert rec["requests"] == 12 and rec["completed"] == 12
+        assert rec["alert_count"] == 0
+        assert rec["alerts_firing"] == 0
+        assert rec["probe_success_rate"] == 1.0
+        assert rec["error_budget_remaining"] == 1.0
+        # The probe phase re-checks the zero-recompile bar: synthetic
+        # probes ride the SAME warmed ladder.
+        assert rec["post_warmup_recompiles"] == 0
+        # --slo needs the HTTP frontend (black-box probes): --inproc
+        # and the special modes refuse it loudly.
+        with pytest.raises(SystemExit):
+            serve_bench.main(["--smoke", "--inproc", "--slo"])
+        with pytest.raises(SystemExit):
+            serve_bench.main(["--smoke", "--chaos", "--slo"])
+
+    @pytest.mark.timeout(300)
     def test_smoke_trace_out_validates_and_renders(self, tmp_path, capsys):
         """ISSUE 18 CI satellite: ``--smoke --trace-out`` banks >= 1
         ``kind="trace"`` line that validates against schema v13, the
@@ -1981,6 +2014,95 @@ class TestTpuWatchMetrics:
         # not a stall verdict.
         assert "exit reason is in the run dir" in out
         assert "STALLED" not in out
+
+
+class TestSloWatch:
+    """ISSUE 19 satellite: ``tools/slo_watch.py`` against a live router
+    frontend. Pinned: the exit-code contract a deploy pipeline gates on
+    (0 healthy, 1 while firing, 2 unreachable) and the rendered view —
+    per-rule burn rates, and every firing alert with its severity and
+    copy-paste exemplar command."""
+
+    def _router(self):
+        from tensorflow_examples_tpu.serving.router import (
+            Router,
+            RouterFrontend,
+        )
+
+        # No probe loop (start() not called): the hand-probed fake
+        # replica stays eligible; the watcher only GETs /alerts +
+        # /series, so no engine is needed behind the URL.
+        router = Router(["http://127.0.0.1:9/"])
+        router.replicas[0].probed = True
+        rfront = RouterFrontend(router, port=0).start()
+        return router, rfront
+
+    @pytest.mark.timeout(120)
+    def test_once_healthy_exits_zero(self, capsys):
+        import slo_watch
+
+        router, rfront = self._router()
+        try:
+            # One point in a default-series ring: the rollup tail
+            # renders instruments the SLO rules burn on.
+            router.series.record("router/e2e.p95", 0.01)
+            rc = slo_watch.main(
+                [f"127.0.0.1:{rfront.port}", "--once"]
+            )
+        finally:
+            rfront.close()
+            router.close()
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "slo: 0 firing" in out
+        assert "ok" in out and "FIRING" not in out
+        assert "series" in out  # the /series rollup tail rendered
+
+    @pytest.mark.timeout(120)
+    def test_once_firing_exits_one_with_exemplar(self, capsys):
+        import slo_watch
+
+        from tensorflow_examples_tpu.telemetry.slo import (
+            AlertEngine,
+            SLOConfig,
+            SLOObjective,
+        )
+
+        router, rfront = self._router()
+        router.alerts = AlertEngine(
+            SLOConfig(
+                objectives=(SLOObjective(slo="interactive",
+                                         e2e_p95_s=0.01,
+                                         error_budget=0.01),),
+                pending_for_s=0.0,
+            ),
+            registry=router.registry,
+        )
+        try:
+            for _ in range(5):
+                router.alerts.observe("interactive", e2e_s=1.0,
+                                      trace_id="t-worst")
+            router.alerts.evaluate()  # ok -> pending
+            router.alerts.evaluate()  # pending -> firing (no dwell)
+            rc = slo_watch.main(
+                [f"http://127.0.0.1:{rfront.port}", "--once"]
+            )
+        finally:
+            rfront.close()
+            router.close()
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "FIRING e2e_interactive" in out
+        assert "--trace-id t-worst" in out  # the exemplar copy-paste
+
+    @pytest.mark.timeout(120)
+    def test_unreachable_exits_two(self, capsys):
+        import slo_watch
+
+        rc = slo_watch.main(
+            ["127.0.0.1:9", "--once", "--timeout", "2"]
+        )
+        assert rc == 2
 
 
 def test_readme_test_count_is_current():

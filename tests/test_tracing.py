@@ -333,7 +333,7 @@ class TestTraceSink:
                  open(tmp_path / "traces.jsonl") if x.strip()]
         assert len(lines) == 1
         (line,) = lines
-        assert line["schema_version"] == 13
+        assert line["schema_version"] == 14
         assert line["kind"] == "trace"
         assert schema.validate_line(line) == []
         assert line["trace"]["trace_id"] == ctx.trace_id
@@ -375,13 +375,14 @@ class TestSchemaV13Ritual:
     predates them."""
 
     def test_v13_pins(self):
-        assert schema.SERVING_SCHEMA_VERSION == 13
+        assert schema.SERVING_SCHEMA_VERSION == 14  # v14: ISSUE 19
         assert schema.SERVING_KEYS_V13 == (
             "traces_kept", "traces_dropped", "trace_coverage",
             "slow_trace_count",
         )
         assert schema.KINDS_V12 == schema.KINDS_V3 + ("serving",)
-        assert schema.KINDS == schema.KINDS_V12 + ("trace",)
+        assert schema.KINDS_V13 == schema.KINDS_V12 + ("trace",)
+        assert schema.KINDS == schema.KINDS_V13 + ("alert",)
         assert "trace/" in schema.INSTRUMENT_PREFIXES
 
     def _trace_line(self, **over):

@@ -134,6 +134,14 @@ RECORD_KEYS: dict[str, str] = {
     # crossing their class threshold, before any p95 floor moves).
     "trace_coverage": "min",
     "slow_trace_count": "max",
+    # SLO alerting (ISSUE 19): serve_bench --slo banks the AlertEngine
+    # summary — alerts fired over the run pinned as a maximum (a
+    # healthy smoke's floor file says 0: ANY firing alert fails CI) and
+    # the canary probe success rate as a minimum (a replica that 200s
+    # organic traffic but flunks the known-answer probe fails here
+    # before users find it). Floorless until a floor file pins them.
+    "alert_count": "max",
+    "probe_success_rate": "min",
 }
 
 

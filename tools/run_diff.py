@@ -103,6 +103,10 @@ DIFF_KEYS: tuple[tuple[str, str, str, float], ...] = (
     # ---- distributed-tracing records (ISSUE 18) ----
     ("trace_coverage", "higher", "", 1.0),
     ("slow_trace_count", "lower", "", 1.0),
+    # ---- SLO alerting records (ISSUE 19) ----
+    ("alert_count", "lower", "", 1.0),
+    ("error_budget_remaining", "higher", "", 1.0),
+    ("probe_success_rate", "higher", "", 1.0),
 )
 
 # The candidate keys flattened into the --json doc for bench_gate
@@ -143,6 +147,9 @@ GATE_KEYS = (
     # distributed-tracing gate keys (ISSUE 18)
     "trace_coverage",
     "slow_trace_count",
+    # SLO alerting gate keys (ISSUE 19)
+    "alert_count",
+    "probe_success_rate",
 )
 
 # Relative change below this is "unchanged" (run-to-run wobble, not a

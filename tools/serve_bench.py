@@ -121,6 +121,15 @@ Modes:
 ``--inproc`` skips the HTTP hop (batcher futures driven directly) to
 separate transport cost from engine cost; ``--out`` banks the record
 as a JSON file next to the BENCH_r*.json trajectory.
+
+``--slo`` (ISSUE 19, plain + ``--router`` modes) runs the SLO
+AlertEngine over the organic traffic plus a known-answer canary probe
+sweep after the drive; the record banks ``alert_count`` (gated max by
+``bench_gate``), ``probe_success_rate`` (gated min) and
+``error_budget_remaining``, and ``ok`` additionally requires
+``alert_count == 0`` — the healthy smoke's zero-alerts claim. Probe
+traffic is excluded from the banked percentiles and counters (the
+record / counter snapshot is taken first).
 """
 
 from __future__ import annotations
@@ -633,6 +642,25 @@ def run_router_bench(args) -> dict:
                     f"{reply[1]['tokens']} != reference {ref}",
                     file=sys.stderr,
                 )
+        # Snapshot the router counters BEFORE the --slo probe phase:
+        # probes ride the router too and must not inflate the banked
+        # router_dispatched (ISSUE 19 exclusion contract).
+        router_counters = router.registry.counter_values()
+        if args.slo:
+            # The organic traffic already fed router.alerts through
+            # the trace path; the prober adds the black-box
+            # availability sweep (router + every replica directly).
+            from tensorflow_examples_tpu.serving.prober import (
+                CanaryProber,
+                fleet_targets,
+            )
+
+            prober = CanaryProber(
+                fleet_targets(f"http://127.0.0.1:{rfront.port}", urls),
+                alerts=router.alerts,
+            )
+            for _ in range(3):
+                prober.probe_once()
     finally:
         rfront.close()
         router.close()
@@ -669,7 +697,6 @@ def run_router_bench(args) -> dict:
     recompiles = sum(
         e.post_warmup_recompiles() for e, _, _, _ in replicas
     )
-    router_counters = router.registry.counter_values()
     rec = {
         "bench": "serve_router",
         "backend": jax.default_backend(),
@@ -732,6 +759,11 @@ def run_router_bench(args) -> dict:
     rec["ok"] = bool(
         len(done) == len(replies) and verify_ok and recompiles == 0
     )
+    if args.slo:
+        # Healthy fleet smoke banks alert_count=0 and
+        # probe_success_rate=1.0 (the ISSUE 19 acceptance golden).
+        rec.update(router.alerts.stats())
+        rec["ok"] = bool(rec["ok"] and rec["alert_count"] == 0)
     return rec
 
 
@@ -2415,11 +2447,24 @@ def main(argv=None) -> int:
                          "schema-v13 kind=\"trace\" JSONL here "
                          "(plain + --router modes); the record banks "
                          "trace_coverage / slow_trace_count either way")
+    ap.add_argument("--slo", action="store_true",
+                    help="ISSUE 19: run the SLO AlertEngine + canary "
+                         "prober over the run (plain + --router "
+                         "modes); the record banks alert_count / "
+                         "probe_success_rate / error_budget_remaining "
+                         "and ok additionally requires alert_count==0")
     args = ap.parse_args(argv)
     if not args.smoke and not args.workdir:
         ap.error("pick a target: --smoke or --workdir DIR")
     if args.affinity == "ab" and not args.router:
         ap.error("--affinity ab is a --router A/B mode")
+    if args.slo and args.inproc:
+        ap.error("--slo needs the HTTP frontend for black-box probes "
+                 "(drop --inproc)")
+    if args.slo and (args.chaos or args.traffic or args.weight_dtype
+                     or args.spec_decode > 0 or args.affinity == "ab"):
+        ap.error("--slo composes with the plain and --router modes "
+                 "only")
     modes = [name for name, on in (
         ("--weight-dtype", bool(args.weight_dtype)),
         ("--spec-decode", args.spec_decode > 0),
@@ -2584,16 +2629,50 @@ def main(argv=None) -> int:
                     f"!= reference {ref}",
                     file=sys.stderr,
                 )
+        # The record is assembled BEFORE the --slo probe phase: probe
+        # traffic must never pollute the banked percentiles (ISSUE 19).
+        rec = bench_record(
+            engine, registry, outcome, prompts,
+            concurrency=args.concurrency, verified=min(verify, n),
+            verify_ok=verify_ok, backend=jax.default_backend(),
+        )
+        if args.slo:
+            from tensorflow_examples_tpu.serving.prober import (
+                CanaryProber,
+            )
+            from tensorflow_examples_tpu.telemetry.slo import AlertEngine
+
+            # The SLO stack owns its own registry so probe/ and
+            # alert/ instruments never mix into the bench record's.
+            alerts = AlertEngine(registry=MetricsRegistry())
+            for r in outcome["replies"]:  # organic feed first
+                body = r[1] if r is not None and r[0] == 200 else {}
+                alerts.observe(
+                    "interactive",
+                    ttft_s=body.get("ttft_s"),
+                    e2e_s=body.get("total_s"),
+                    error=(r is None or r[0] >= 500),
+                )
+            prober = CanaryProber(
+                {"replica": frontend.url("")},
+                alerts=alerts, registry=alerts.registry,
+            )
+            for _ in range(3):
+                prober.probe_once()
+            rec.update(alerts.stats())
+            # Probes ride the warmed buckets: a probe-induced
+            # recompile fails the record, same as an organic one.
+            rec["post_warmup_recompiles"] = engine.post_warmup_recompiles()
+            rec["ok"] = bool(
+                rec["ok"]
+                and rec["post_warmup_recompiles"] == 0
+                and rec["alert_count"] == 0
+            )
     finally:
         batcher.close(drain=True)
         frontend.close()
         recorder.close()
 
-    rec = bench_record(
-        engine, registry, outcome, prompts,
-        concurrency=args.concurrency, verified=min(verify, n),
-        verify_ok=verify_ok, backend=jax.default_backend(),
-    )
     rec["warmup_s"] = round(warmup_s, 3)
     rec["transport"] = "inproc" if args.inproc else "http"
     rec.update(recorder.stats())  # trace_coverage / slow_trace_count

@@ -71,6 +71,16 @@ SIGTERM to the router itself closes the listening port and exits 0
 (replicas are not touched — they drain on their own schedule). A
 schema-v6 ``kind="serving"`` stats line is appended to ``--stats-out``
 every ``--stats-every`` seconds.
+
+SLO watching (ISSUE 19): the router always runs an AlertEngine
+(``--slo slo.json`` loads declared objectives; the built-in defaults
+are generous) doing error-budget burn-rate alerting — firing/resolve
+transitions append schema-v14 ``kind="alert"`` lines to
+``--alerts-out``, live state is ``GET /alerts``, ring-buffered
+instrument history is ``GET /series``, and
+``--synthetic-probe-every S`` runs the known-answer canary prober
+through the router and each replica so a sick replica alerts ahead of
+organic traffic (``tools/slo_watch.py`` is the terminal view).
 """
 
 from __future__ import annotations
@@ -178,6 +188,19 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat-miss", type=float, default=2.0,
                     help="standby: promote after the primary's lease "
                          "heartbeat is stale this many seconds")
+    ap.add_argument("--slo", default="",
+                    help="ISSUE 19: SLO config JSON (slo.json) for the "
+                         "router's AlertEngine (default: built-in "
+                         "generous objectives)")
+    ap.add_argument("--alerts-out", default="",
+                    help="append schema-v14 kind=\"alert\" firing/"
+                         "resolve lines here (JSONL, fsync per line)")
+    ap.add_argument("--synthetic-probe-every", type=float, default=0.0,
+                    help="ISSUE 19: >0 runs the canary prober — "
+                         "deterministic known-answer requests through "
+                         "the router AND each replica frontend at this "
+                         "cadence (seconds), feeding the AlertEngine "
+                         "ahead of organic traffic; 0 disables")
     ap.add_argument("--no-affinity", action="store_true",
                     help="disable prefix-affinity dispatch (ISSUE 12; "
                          "on by default — the router prefers the "
@@ -202,6 +225,9 @@ def main(argv=None) -> int:
     if args.standby and (args.canary or args.autoscale):
         ap.error("--standby does not compose with --canary/--autoscale "
                  "yet (the pair owns router lifecycle)")
+    if args.standby and (args.slo or args.alerts_out):
+        ap.error("--standby does not compose with --slo/--alerts-out "
+                 "yet (the pair constructs both routers itself)")
 
     from tensorflow_examples_tpu.serving.router import (
         Router,
@@ -295,8 +321,19 @@ def main(argv=None) -> int:
 
             journal = RequestJournal(args.journal)
             journal.refresh()
+        slo_cfg = None
+        if args.slo:
+            from tensorflow_examples_tpu.telemetry.slo import SLOConfig
+
+            slo_cfg = SLOConfig.load(args.slo)
+            print(
+                f"slo: {len(slo_cfg.objectives)} objective(s) from "
+                f"{args.slo}",
+                file=sys.stderr,
+            )
         router = Router(
-            replica_urls, canary=args.canary, cfg=cfg, journal=journal
+            replica_urls, canary=args.canary, cfg=cfg, journal=journal,
+            slo_cfg=slo_cfg, alert_path=args.alerts_out or None,
         ).start()
         if journal is not None:
             replayed = router.replay_incomplete()
@@ -337,6 +374,7 @@ def main(argv=None) -> int:
             router,
             supervisor,
             spawn_replica,
+            alerts=router.alerts,  # firing SLO alerts = advisory hot
             cfg=AutoscalerConfig(
                 min_replicas=args.min_replicas,
                 max_replicas=args.max_replicas,
@@ -379,6 +417,32 @@ def main(argv=None) -> int:
         + ("off" if args.no_affinity else "on"),
         file=sys.stderr,
     )
+    prober = None
+    if args.synthetic_probe_every > 0:
+        # ISSUE 19: black-box canary probes through the router (the
+        # client path) and against every replica directly (a router
+        # would mask a single sick replica by failing over around it).
+        # Probes carry the "probe" tag, so they never enter the
+        # journal dedupe window or the organic counters; failures feed
+        # the router's AlertEngine on the probe cadence.
+        from tensorflow_examples_tpu.serving.prober import (
+            CanaryProber,
+            fleet_targets,
+        )
+
+        prober = CanaryProber(
+            fleet_targets(
+                f"http://127.0.0.1:{frontend.port}", replica_urls
+            ),
+            alerts=router.alerts,
+            registry=router.registry,
+            interval_s=args.synthetic_probe_every,
+        ).start()
+        print(
+            f"canary prober on: {len(prober.targets)} target(s) every "
+            f"{args.synthetic_probe_every:.1f}s",
+            file=sys.stderr,
+        )
 
     stop = []
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -404,6 +468,8 @@ def main(argv=None) -> int:
                 emit_stats()
                 last_stats = time.monotonic()
     finally:
+        if prober is not None:
+            prober.close()
         frontend.close()
         if autoscaler is not None:
             autoscaler.close()

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Terminal SLO watcher: poll a router's /alerts + /series (ISSUE 19).
+
+    python tools/slo_watch.py http://127.0.0.1:9000            # loop
+    python tools/slo_watch.py http://127.0.0.1:9000 --once     # one poll
+
+Every poll renders the AlertEngine's live state — each rule's
+fast/slow burn rates and budget remaining, plus every FIRING alert
+with its severity and worst-offender exemplar so the responder's next
+command is a copy-paste:
+
+    FIRING ttft_interactive [page] burn 14.2x/3.1x budget 12% left
+      -> python tools/trace_report.py traces.jsonl --trace-id tr-ab12..
+
+and a compact tail of the time-series ring (``GET /series`` rollups)
+for the instruments behind the burn.
+
+Exit code is the CI/script contract: ``--once`` (and a loop ended by
+``--polls N``) exits **1 while any alert is firing**, 0 when healthy,
+2 when the endpoint is unreachable — a deploy pipeline can gate a
+rollout step on ``slo_watch --once`` exactly like a test. A looping
+watch that loses the endpoint after a healthy poll reports "endpoint
+gone" and exits with the LAST poll's verdict (the run ended; its
+alerts are the verdict that matters).
+
+Works against the router frontend (fleet view: organic + canary
+probes) and any replica frontend's ``/series`` (``/alerts`` is
+router-side). Stdlib + repo only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tensorflow_examples_tpu.serving.router import _get_json  # noqa: E402
+
+# Series rendered in the --series tail when present (the instruments
+# the default SLO rules burn on), before any --series globs.
+_DEFAULT_SERIES = (
+    "router/e2e.p95",
+    "router/requests_total",
+    "probe/ttft.p95",
+    "probe/failed_total",
+)
+
+
+def fetch(base: str, timeout: float) -> tuple[dict | None, dict | None]:
+    """(alerts payload, series payload) — either may be None (a replica
+    frontend serves /series but not /alerts; a gone endpoint serves
+    neither)."""
+    status, alerts = _get_json(base + "/alerts", timeout)
+    if status != 200 or not isinstance(alerts, dict):
+        alerts = None
+    status, series = _get_json(base + "/series", timeout)
+    if status != 200 or not isinstance(series, dict):
+        series = None
+    return alerts, series
+
+
+def render(alerts: dict | None, series: dict | None,
+           series_names: list[str]) -> tuple[str, int]:
+    """(text, firing count) for one poll."""
+    out = []
+    firing = 0
+    if alerts is not None:
+        firing = int(alerts.get("alerts_firing", 0))
+        out.append(
+            f"slo: {firing} firing, budget remaining "
+            f"{alerts.get('error_budget_remaining', 1.0):.1%}, probe "
+            f"success {alerts.get('probe_success_rate', 1.0):.1%}, "
+            f"{alerts.get('alert_count', 0)} fired total"
+        )
+        for name, rule in sorted(
+            (alerts.get("rules") or {}).items()
+        ):
+            mark = "FIRING" if rule.get("state") == "firing" else (
+                "pending" if rule.get("state") == "pending" else "ok"
+            )
+            out.append(
+                f"  {mark:<7} {name:<24} burn "
+                f"{rule.get('burn_rate_fast', 0.0):.1f}x/"
+                f"{rule.get('burn_rate_slow', 0.0):.1f}x  budget "
+                f"{rule.get('budget_remaining', 1.0):.1%}"
+            )
+        for a in alerts.get("firing") or []:
+            line = (
+                f"FIRING {a.get('name')} [{a.get('severity')}] "
+                f"slo={a.get('slo')} burn {a.get('burn_rate', 0.0):.1f}x"
+            )
+            if a.get("replica"):
+                line += f" replica={a['replica']}"
+            out.append(line)
+            if a.get("trace_id"):
+                # The exemplar copy-paste (ISSUE 18 discipline).
+                out.append(
+                    "  -> python tools/trace_report.py <traces.jsonl> "
+                    f"--trace-id {a['trace_id']}"
+                )
+    if series is not None:
+        rollups = series.get("rollups") or {}
+        names = [n for n in _DEFAULT_SERIES if n in rollups]
+        names += [
+            n for n in sorted(rollups)
+            if any(pat in n for pat in series_names) and n not in names
+        ]
+        for n in names:
+            r = rollups[n]
+            out.append(
+                f"  series {n:<28} last={r.get('last')} "
+                f"p50={r.get('p50')} p95={r.get('p95')} "
+                f"p99={r.get('p99')} n={r.get('count')}"
+            )
+    return "\n".join(out), firing
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("endpoint",
+                    help="router (or replica) frontend base URL, e.g. "
+                         "http://127.0.0.1:9000")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls (loop mode)")
+    ap.add_argument("--once", action="store_true",
+                    help="one poll, then exit (1 while firing)")
+    ap.add_argument("--polls", type=int, default=0,
+                    help=">0: stop after N polls (loop mode)")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-GET timeout (seconds)")
+    ap.add_argument("--series", action="append", default=[],
+                    metavar="SUBSTR",
+                    help="also render /series rollups whose name "
+                         "contains SUBSTR (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw /alerts payload per poll "
+                         "instead of the rendered view")
+    args = ap.parse_args(argv)
+    base = args.endpoint.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+
+    last_firing = 0
+    seen_healthy = False
+    polls = 0
+    while True:
+        alerts, series = fetch(base, args.timeout)
+        if alerts is None and series is None:
+            if seen_healthy:
+                print("endpoint gone: run ended", file=sys.stderr)
+                return 1 if last_firing else 0
+            print(f"unreachable: {base}", file=sys.stderr)
+            return 2
+        seen_healthy = True
+        if args.json:
+            print(json.dumps(alerts if alerts is not None else series))
+            last_firing = int((alerts or {}).get("alerts_firing", 0))
+        else:
+            text, last_firing = render(alerts, series, args.series)
+            print(f"-- {time.strftime('%H:%M:%S')} {base}")
+            print(text)
+        sys.stdout.flush()
+        polls += 1
+        if args.once or (args.polls and polls >= args.polls):
+            return 1 if last_firing else 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,11 @@ span timeline), and prints:
   a per-host table and the slowest host is flagged; the last
   ``kind="fleet"`` line's skew/straggler verdict is rendered either
   way. Single-shard dirs report exactly as before.
+* SLO alert facts (schema v14, ISSUE 19): when the run dir holds an
+  ``alerts.jsonl`` sink (serve_fleet ``--alerts-out``), the firing /
+  resolved episode count, per-episode durations, the worst remaining
+  error budget, and the exemplar trace ids (ready for ``trace_report
+  --trace-id``) are summarized. Dirs without a sink omit the section.
 
 ``--json`` additionally writes one machine-readable record with the
 same numbers — shaped for dropping into future BENCH_*.json entries.
@@ -311,6 +316,67 @@ def host_shard_records(telemetry_dir: str) -> list[dict]:
     return out
 
 
+def alert_summary(run_dir: str) -> dict | None:
+    """ISSUE 19 satellite: summarize the run dir's schema-v14
+    ``kind="alert"`` firing/resolve JSONL (``alerts.jsonl``, the
+    AlertEngine sink serve_fleet's ``--alerts-out`` lands) — how many
+    alerts fired, how long each episode lasted (firing -> resolved,
+    paired by alert name), how much error budget the worst rule had
+    left, and the exemplar trace ids a responder would feed to
+    ``trace_report --trace-id``. None when the run has no alert sink."""
+    cand = [
+        os.path.join(run_dir, "alerts.jsonl"),
+        os.path.join(run_dir, "telemetry", "alerts.jsonl"),
+    ]
+    path = next((p for p in cand if os.path.isfile(p)), None)
+    if path is None:
+        return None
+    from tensorflow_examples_tpu.telemetry import slo
+
+    alerts = slo.read_alerts(path)
+    if not alerts:
+        return None
+    firings = [a for a in alerts if a.get("state") == "firing"]
+    open_since: dict[str, float] = {}
+    episodes = []
+    for a in alerts:
+        name = a.get("name")
+        t = a.get("_time_unix")
+        if a.get("state") == "firing":
+            if name not in open_since and t is not None:
+                open_since[name] = t
+        elif a.get("state") == "resolved" and name in open_since:
+            start = open_since.pop(name)
+            episodes.append(
+                {
+                    "name": name,
+                    "slo": a.get("slo"),
+                    "duration_s": (
+                        round(t - start, 3) if t is not None else None
+                    ),
+                }
+            )
+    budgets = [
+        a["budget_remaining"]
+        for a in alerts
+        if isinstance(a.get("budget_remaining"), (int, float))
+        and not isinstance(a.get("budget_remaining"), bool)
+    ]
+    return {
+        "path": path,
+        "firings": len(firings),
+        "resolved": sum(1 for a in alerts if a.get("state") == "resolved"),
+        "still_firing": sorted(open_since),
+        "episodes": episodes,
+        "min_budget_remaining": min(budgets) if budgets else None,
+        "exemplar_trace_ids": [
+            a["trace_id"]
+            for a in firings
+            if isinstance(a.get("trace_id"), str)
+        ][:5],
+    }
+
+
 def build_record(arg: str) -> tuple[dict | None, int, str]:
     """(record, skipped-line count, error) for a run-dir argument — the
     shared entry point for main() and tools/run_diff.py. ``record`` is
@@ -337,6 +403,12 @@ def build_record(arg: str) -> tuple[dict | None, int, str]:
         except json.JSONDecodeError:
             print(f"WARNING: unreadable trace {trace_file}", file=sys.stderr)
     record = summarize(lines, trace)
+    # ISSUE 19: a run dir that landed an alert sink gets the SLO
+    # section; dirs without one simply omit it.
+    record["alerts"] = (
+        alert_summary(arg if os.path.isdir(arg) else os.path.dirname(arg))
+        or alert_summary(os.path.dirname(path))
+    )
     hosts = host_shard_records(os.path.dirname(path))
     record["hosts"] = hosts or None
     p95s = [
@@ -513,6 +585,30 @@ def render(record: dict, skipped: int) -> str:
         if fl.get("emergency"):
             line += " (emergency snapshot)"
         out.append(line)
+    # ----- schema-v14 SLO alert section (omitted without a sink) -----
+    al = record.get("alerts")
+    if al:
+        line = (
+            f"slo alerts: {al['firings']} firing / {al['resolved']} "
+            f"resolved event(s)"
+        )
+        if al.get("min_budget_remaining") is not None:
+            line += (
+                "; worst error budget remaining "
+                + _fmt(al["min_budget_remaining"] * 100, "%", nd=1)
+            )
+        if al.get("still_firing"):
+            line += f"; STILL FIRING: {', '.join(al['still_firing'])}"
+        out.append(line)
+        for ep in al.get("episodes", [])[:5]:
+            out.append(
+                f"  {ep['name']} ({ep.get('slo')}): fired for "
+                + _fmt(ep.get("duration_s"), "s")
+            )
+        for tid in al.get("exemplar_trace_ids", []):
+            out.append(
+                f"  exemplar: trace_report --trace-id {tid}"
+            )
     if "trace_phases" in record:
         out.append("host time by span (from trace.json):")
         for name, p in record["trace_phases"].items():
